@@ -1,0 +1,14 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Real TPU hardware is never required by the test suite (SURVEY.md §4: the engine
+must be testable with zero real accelerators). Multi-chip sharding paths are
+exercised on 8 virtual CPU devices via --xla_force_host_platform_device_count.
+Must run before jax initializes any backend, hence module-level in conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

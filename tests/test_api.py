@@ -1,0 +1,243 @@
+"""API layer tests: defaulting + validation.
+
+Parity model: reference pkg/apis/kubeflow.org/v1/pytorch_defaults_test.go,
+mpi_validation_test.go, and pkg/webhooks/* table-driven tests.
+"""
+
+import pytest
+
+from training_operator_tpu.api.common import (
+    Container,
+    JobConditionType,
+    JobStatus,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RestartPolicy,
+    update_job_conditions,
+    is_finished,
+    has_condition,
+)
+from training_operator_tpu.api.defaults import default_job
+from training_operator_tpu.api.jobs import (
+    ElasticPolicy,
+    JAXJob,
+    MPIJob,
+    ObjectMeta,
+    PyTorchJob,
+    TFJob,
+    TPUPolicy,
+)
+from training_operator_tpu.api.validation import ValidationError, validate_job
+
+
+def make_jaxjob(name="jax-test", workers=2, image="jax:latest"):
+    return JAXJob(
+        metadata=ObjectMeta(name=name),
+        replica_specs={
+            "Worker": ReplicaSpec(
+                replicas=workers,
+                template=PodTemplateSpec(containers=[Container(name="jax", image=image)]),
+            )
+        },
+    )
+
+
+class TestDefaults:
+    def test_replicas_default_to_one(self):
+        job = JAXJob(
+            metadata=ObjectMeta(name="j"),
+            replica_specs={
+                "Worker": ReplicaSpec(
+                    template=PodTemplateSpec(containers=[Container(name="jax", image="i")])
+                )
+            },
+        )
+        default_job(job)
+        assert job.replica_specs["Worker"].replicas == 1
+
+    def test_restart_policy_defaulted(self):
+        job = make_jaxjob()
+        default_job(job)
+        assert job.replica_specs["Worker"].restart_policy == RestartPolicy.ON_FAILURE
+
+    def test_default_port_injected(self):
+        job = make_jaxjob()
+        default_job(job)
+        c = job.replica_specs["Worker"].template.main_container("jax")
+        assert c.ports["jaxjob-port"] == 6666
+
+    def test_uid_and_creation_time_set(self):
+        job = default_job(make_jaxjob())
+        assert job.uid
+        assert job.metadata.creation_time is not None
+
+    def test_elastic_policy_defaults(self):
+        job = PyTorchJob(
+            metadata=ObjectMeta(name="pt"),
+            replica_specs={
+                "Worker": ReplicaSpec(
+                    replicas=4,
+                    template=PodTemplateSpec(containers=[Container(name="pytorch", image="i")]),
+                )
+            },
+            elastic_policy=ElasticPolicy(),
+        )
+        default_job(job)
+        assert job.elastic_policy.max_restarts == 10
+        assert job.elastic_policy.min_replicas == 4
+        assert job.elastic_policy.max_replicas == 4
+
+    def test_idempotent(self):
+        job = default_job(make_jaxjob())
+        uid = job.uid
+        default_job(job)
+        assert job.uid == uid
+
+
+class TestValidation:
+    def test_valid_job_passes(self):
+        validate_job(default_job(make_jaxjob()))
+
+    def test_bad_name_rejected(self):
+        job = default_job(make_jaxjob(name="Bad_Name"))
+        with pytest.raises(ValidationError, match="RFC1035"):
+            validate_job(job)
+
+    def test_missing_image_rejected(self):
+        job = default_job(make_jaxjob(image=""))
+        with pytest.raises(ValidationError, match="image"):
+            validate_job(job)
+
+    def test_missing_replica_specs_rejected(self):
+        job = JAXJob(metadata=ObjectMeta(name="j"))
+        with pytest.raises(ValidationError, match="at least one replica type"):
+            validate_job(job)
+
+    def test_wrong_replica_type_rejected(self):
+        job = make_jaxjob()
+        job.replica_specs["Master"] = job.replica_specs["Worker"]
+        with pytest.raises(ValidationError, match="invalid replica type"):
+            validate_job(default_job(job))
+
+    def test_wrong_container_name_rejected(self):
+        job = JAXJob(
+            metadata=ObjectMeta(name="j"),
+            replica_specs={
+                "Worker": ReplicaSpec(
+                    template=PodTemplateSpec(containers=[Container(name="main", image="i")])
+                )
+            },
+        )
+        with pytest.raises(ValidationError, match="container named 'jax'"):
+            validate_job(job)
+
+    def test_mpi_requires_single_launcher(self):
+        job = MPIJob(
+            metadata=ObjectMeta(name="m"),
+            replica_specs={
+                "Launcher": ReplicaSpec(
+                    replicas=2,
+                    template=PodTemplateSpec(containers=[Container(name="mpi", image="i")]),
+                ),
+                "Worker": ReplicaSpec(
+                    replicas=2,
+                    template=PodTemplateSpec(containers=[Container(name="mpi", image="i")]),
+                ),
+            },
+        )
+        with pytest.raises(ValidationError, match="Launcher"):
+            validate_job(default_job(job))
+
+    def test_tf_chief_and_master_conflict(self):
+        job = TFJob(
+            metadata=ObjectMeta(name="tf"),
+            replica_specs={
+                t: ReplicaSpec(
+                    replicas=1,
+                    template=PodTemplateSpec(containers=[Container(name="tensorflow", image="i")]),
+                )
+                for t in ("Chief", "Master", "Worker")
+            },
+        )
+        with pytest.raises(ValidationError, match="Chief/Master"):
+            validate_job(default_job(job))
+
+    def test_elastic_min_max_ordering(self):
+        job = PyTorchJob(
+            metadata=ObjectMeta(name="pt"),
+            replica_specs={
+                "Worker": ReplicaSpec(
+                    replicas=2,
+                    template=PodTemplateSpec(containers=[Container(name="pytorch", image="i")]),
+                )
+            },
+            elastic_policy=ElasticPolicy(min_replicas=4, max_replicas=2),
+        )
+        with pytest.raises(ValidationError, match="maxReplicas"):
+            validate_job(job)
+
+    def test_tpu_policy_mesh_axes_must_match_chips(self):
+        job = make_jaxjob()
+        job.tpu_policy = TPUPolicy(accelerator="v5e-8", mesh_axes={"data": 2, "tensor": 2})
+        with pytest.raises(ValidationError, match="meshAxes"):
+            validate_job(default_job(job))
+
+    def test_tpu_policy_valid(self):
+        job = make_jaxjob()
+        job.tpu_policy = TPUPolicy(
+            accelerator="v5e-8", topology="2x4", mesh_axes={"data": 2, "tensor": 4}
+        )
+        validate_job(default_job(job))
+        assert job.tpu_policy.total_chips() == 8
+
+
+class TestConditions:
+    def test_condition_transitions(self):
+        st = JobStatus()
+        update_job_conditions(st, JobConditionType.CREATED, True, "JobCreated", "created", now=1.0)
+        update_job_conditions(st, JobConditionType.RUNNING, True, "JobRunning", "running", now=2.0)
+        assert has_condition(st, JobConditionType.RUNNING)
+        assert not is_finished(st)
+        update_job_conditions(st, JobConditionType.SUCCEEDED, True, "JobSucceeded", "done", now=3.0)
+        assert is_finished(st)
+        # Running cleared when terminal condition set.
+        assert not has_condition(st, JobConditionType.RUNNING)
+
+    def test_restarting_clears_running(self):
+        st = JobStatus()
+        update_job_conditions(st, JobConditionType.RUNNING, True, "JobRunning", "", now=1.0)
+        update_job_conditions(st, JobConditionType.RESTARTING, True, "Restart", "", now=2.0)
+        assert not has_condition(st, JobConditionType.RUNNING)
+        update_job_conditions(st, JobConditionType.RUNNING, True, "JobRunning", "", now=3.0)
+        assert not has_condition(st, JobConditionType.RESTARTING)
+
+    def test_duplicate_update_bumps_time_only(self):
+        st = JobStatus()
+        update_job_conditions(st, JobConditionType.CREATED, True, "JobCreated", "", now=1.0)
+        update_job_conditions(st, JobConditionType.CREATED, True, "JobCreated", "", now=5.0)
+        assert len(st.conditions) == 1
+        assert st.conditions[0].last_update_time == 5.0
+        assert st.conditions[0].last_transition_time == 1.0
+
+
+class TestSerialization:
+    def test_status_roundtrip(self):
+        st = JobStatus()
+        update_job_conditions(st, JobConditionType.CREATED, True, "JobCreated", "msg", now=1.0)
+        d = st.to_dict()
+        st2 = JobStatus.from_dict(d)
+        assert st2.conditions[0].type == JobConditionType.CREATED
+        assert st2.conditions[0].status is True
+
+    def test_replica_spec_roundtrip(self):
+        rs = ReplicaSpec(
+            replicas=3,
+            template=PodTemplateSpec(
+                containers=[Container(name="jax", image="i", env={"A": "1"}, ports={"p": 1})]
+            ),
+            restart_policy=RestartPolicy.EXIT_CODE,
+        )
+        rs2 = ReplicaSpec.from_dict(rs.to_dict())
+        assert rs2.replicas == 3
+        assert rs2.restart_policy == RestartPolicy.EXIT_CODE
+        assert rs2.template.containers[0].env == {"A": "1"}

@@ -1,0 +1,174 @@
+"""Virtual cluster substrate tests: API server semantics, watch echo,
+scheduler binding, kubelet lifecycle, inventory topology."""
+
+import pytest
+
+from training_operator_tpu.api.common import Container, PodTemplateSpec
+from training_operator_tpu.api.jobs import ObjectMeta
+from training_operator_tpu.cluster.apiserver import (
+    AlreadyExistsError,
+    APIServer,
+    ConflictError,
+    NotFoundError,
+)
+from training_operator_tpu.cluster.inventory import (
+    TPU_RESOURCE,
+    make_cpu_pool,
+    make_gpu_pool,
+    make_tpu_pool,
+)
+from training_operator_tpu.cluster.objects import Pod, PodPhase
+from training_operator_tpu.cluster.runtime import (
+    ANNOTATION_SIM_DURATION,
+    Cluster,
+    DefaultScheduler,
+    SimKubelet,
+    VirtualClock,
+)
+
+
+def make_pod(name, cpu=1.0, labels=None, **kw):
+    return Pod(
+        metadata=ObjectMeta(name=name, labels=labels or {}),
+        spec=PodTemplateSpec(
+            containers=[Container(name="main", image="img", resources={"cpu": cpu})], **kw
+        ),
+    )
+
+
+class TestAPIServer:
+    def test_create_get_delete(self):
+        api = APIServer()
+        api.create(make_pod("p1"))
+        assert api.get("Pod", "default", "p1").name == "p1"
+        api.delete("Pod", "default", "p1")
+        with pytest.raises(NotFoundError):
+            api.get("Pod", "default", "p1")
+
+    def test_duplicate_create_rejected(self):
+        api = APIServer()
+        api.create(make_pod("p1"))
+        with pytest.raises(AlreadyExistsError):
+            api.create(make_pod("p1"))
+
+    def test_optimistic_concurrency(self):
+        api = APIServer()
+        pod = api.create(make_pod("p1"))
+        import copy
+
+        stale = copy.deepcopy(pod)
+        api.update(pod)  # bumps rv
+        with pytest.raises(ConflictError):
+            api.update(stale)
+
+    def test_watch_events_are_queued_not_synchronous(self):
+        api = APIServer()
+        w = api.watch(["Pod"])
+        api.create(make_pod("p1"))
+        api.create(make_pod("p2"))
+        evs = w.drain()
+        assert [e.type for e in evs] == ["Added", "Added"]
+        assert w.drain() == []
+
+    def test_watch_kind_filter(self):
+        api = APIServer()
+        w = api.watch(["Service"])
+        api.create(make_pod("p1"))
+        assert w.drain() == []
+
+    def test_list_with_label_selector(self):
+        api = APIServer()
+        api.create(make_pod("a", labels={"job": "x"}))
+        api.create(make_pod("b", labels={"job": "y"}))
+        assert [p.name for p in api.list("Pod", "default", {"job": "x"})] == ["a"]
+
+    def test_admission_hook_rejects(self):
+        api = APIServer()
+
+        def deny(obj):
+            raise ValueError("nope")
+
+        api.register_admission("Pod", deny)
+        with pytest.raises(ValueError):
+            api.create(make_pod("p1"))
+
+
+class TestInventory:
+    def test_tpu_slice_topology(self):
+        nodes = make_tpu_pool(num_slices=2, slice_topology="4x4", chips_per_host=4)
+        assert len(nodes) == 8  # 16 chips / 4 per host x 2 slices
+        n0 = nodes[0]
+        assert n0.capacity[TPU_RESOURCE] == 4.0
+        assert n0.accelerator.tpu_slice == "slice-0"
+        assert n0.accelerator.ici_coords == [0, 0]
+        assert nodes[1].accelerator.ici_coords == [1, 0]
+        assert nodes[3].accelerator.ici_coords == [3, 0]
+
+    def test_gpu_nvlink_domains(self):
+        nodes = make_gpu_pool(num_nodes=8, nodes_per_nvlink_domain=4)
+        assert nodes[0].accelerator.nvlink_domain == "nvl-0"
+        assert nodes[4].accelerator.nvlink_domain == "nvl-1"
+
+    def test_bad_topology_rejected(self):
+        with pytest.raises(ValueError):
+            make_tpu_pool(1, slice_topology="3x3", chips_per_host=4)
+
+
+class TestSchedulerAndKubelet:
+    def test_pod_binds_and_runs(self):
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_cpu_pool(2))
+        DefaultScheduler(cluster)
+        SimKubelet(cluster, start_latency=0.5)
+        pod = make_pod("p1")
+        cluster.api.create(pod)
+        assert cluster.run_until(
+            lambda: cluster.api.get("Pod", "default", "p1").status.phase == PodPhase.RUNNING,
+            timeout=10,
+        )
+        assert pod.node_name.startswith("cpu-")
+        assert pod.status.start_time is not None
+
+    def test_node_selector_respected(self):
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_cpu_pool(2))
+        DefaultScheduler(cluster)
+        pod = make_pod("p1", node_selector={"kubernetes.io/hostname": "cpu-1"})
+        cluster.api.create(pod)
+        cluster.run_until(lambda: pod.node_name != "", timeout=5)
+        assert pod.node_name == "cpu-1"
+
+    def test_resource_exhaustion_leaves_pod_pending(self):
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_cpu_pool(1, cpu_per_node=2.0))
+        DefaultScheduler(cluster)
+        SimKubelet(cluster)
+        cluster.api.create(make_pod("big1", cpu=2.0))
+        cluster.api.create(make_pod("big2", cpu=2.0))
+        cluster.run_for(1.0)
+        pods = {p.name: p for p in cluster.api.list("Pod")}
+        bound = [p for p in pods.values() if p.node_name]
+        assert len(bound) == 1
+
+    def test_sim_duration_completes_pod(self):
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_cpu_pool(1))
+        DefaultScheduler(cluster)
+        SimKubelet(cluster)
+        pod = make_pod("p1")
+        pod.spec.annotations[ANNOTATION_SIM_DURATION] = "1.0"
+        cluster.api.create(pod)
+        assert cluster.run_until(lambda: pod.status.phase == PodPhase.SUCCEEDED, timeout=30)
+        assert pod.status.container_statuses[0].exit_code == 0
+
+    def test_failed_pod_releases_resources(self):
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_cpu_pool(1, cpu_per_node=2.0))
+        DefaultScheduler(cluster)
+        SimKubelet(cluster)
+        p1 = make_pod("p1", cpu=2.0)
+        p1.spec.annotations[ANNOTATION_SIM_DURATION] = "0.5"
+        cluster.api.create(p1)
+        p2 = make_pod("p2", cpu=2.0)
+        cluster.api.create(p2)
+        assert cluster.run_until(lambda: p2.status.phase == PodPhase.RUNNING, timeout=30)

@@ -1,0 +1,27 @@
+"""tpu-training-operator: a TPU-native distributed-training orchestration framework.
+
+A brand-new framework with the capability set of the Kubeflow Training Operator
+(reference: gavrissh/training-operator v1.8.x), re-architected TPU-first:
+
+- Declarative job APIs for multiple ML frameworks (JAX-first; Torch, TensorFlow,
+  XGBoost, Paddle, MPI; plus the v2-style TrainJob/TrainingRuntime model).
+- A shared reconcile engine (replica diffing, expectations cache, restart/backoff/
+  deadline/suspend semantics, status conditions).
+- A pluggable runtime framework (EnforceMLPolicy / EnforcePodGroupPolicy /
+  ComponentBuilder extension points).
+- Gang scheduling with a JAX/XLA placement engine ("tpu-packer") that batch-solves
+  topology-aware bin-packing: ICI-mesh contiguity for TPU slices, NVLink locality
+  for GPUs.
+- A TPU trainer data plane: SPMD transformer training over a jax.sharding.Mesh
+  (dp/fsdp/tp/sp axes), ring attention for long context, checkpoint/resume.
+- A Python client SDK and dataset/model initializers.
+
+Layer map mirrors SURVEY.md; reference parity citations live in module docstrings.
+"""
+
+__version__ = "0.1.0"
+
+OPERATOR_NAME = "tpu-training-operator"
+API_GROUP = "training.tpu.dev"
+API_VERSION_V1 = "v1"
+API_VERSION_V2 = "v2alpha1"

@@ -1,0 +1,196 @@
+"""In-process API server: object store + watch streams + optimistic concurrency.
+
+This is the substrate the reconcile engine writes to, standing in for the
+Kubernetes API server. Two properties matter and are reproduced faithfully:
+
+1. **Asynchronous watch echo.** Writes return immediately, but watch events are
+   *queued* and only observed when the consumer drains its informer queue.
+   This is exactly the window the reference's expectations cache exists for
+   (expectation/expectation.go:29-40): between `CreatePod` returning and the
+   informer seeing the new pod, a naive reconcile would create duplicates.
+
+2. **Optimistic concurrency.** Every write bumps `resourceVersion`; an update
+   carrying a stale version conflicts (like k8s), which the engine's status
+   writer must retry (reference UpdateJobStatusInApiServer path).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Deque, Dict, Iterable, List, Optional, Tuple
+
+from training_operator_tpu.cluster.objects import Event
+
+
+class ConflictError(Exception):
+    """Stale resourceVersion on update."""
+
+
+class AlreadyExistsError(Exception):
+    pass
+
+
+class NotFoundError(Exception):
+    pass
+
+
+@dataclass
+class WatchEvent:
+    type: str  # Added | Modified | Deleted
+    kind: str
+    obj: Any
+
+
+class WatchQueue:
+    """A subscriber's pending-event queue (an informer's delta FIFO)."""
+
+    def __init__(self, kinds: Optional[Iterable[str]] = None):
+        self.kinds = set(kinds) if kinds else None
+        self._q: Deque[WatchEvent] = deque()
+
+    def push(self, ev: WatchEvent) -> None:
+        if self.kinds is None or ev.kind in self.kinds:
+            self._q.append(ev)
+
+    def drain(self) -> List[WatchEvent]:
+        out = list(self._q)
+        self._q.clear()
+        return out
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+
+class APIServer:
+    """Typed object store keyed by (kind, namespace, name)."""
+
+    def __init__(self) -> None:
+        self._objects: Dict[Tuple[str, str, str], Any] = {}
+        self._rv = itertools.count(1)
+        self._watchers: List[WatchQueue] = []
+        self._events: List[Event] = []
+        self._lock = threading.RLock()
+        # Admission hooks: kind -> [callable(obj) raising on rejection]
+        self._admission: Dict[str, List[Callable[[Any], None]]] = {}
+
+    # -- admission ---------------------------------------------------------
+
+    def register_admission(self, kind: str, fn: Callable[[Any], None]) -> None:
+        self._admission.setdefault(kind, []).append(fn)
+
+    # -- watch -------------------------------------------------------------
+
+    def watch(self, kinds: Optional[Iterable[str]] = None) -> WatchQueue:
+        wq = WatchQueue(kinds)
+        with self._lock:
+            self._watchers.append(wq)
+        return wq
+
+    def _notify(self, ev_type: str, obj: Any) -> None:
+        ev = WatchEvent(ev_type, obj.KIND, obj)
+        for w in self._watchers:
+            w.push(ev)
+
+    # -- CRUD --------------------------------------------------------------
+
+    @staticmethod
+    def _key(obj: Any) -> Tuple[str, str, str]:
+        ns = getattr(obj.metadata, "namespace", "") or ""
+        return (obj.KIND, ns, obj.metadata.name)
+
+    def create(self, obj: Any) -> Any:
+        with self._lock:
+            for fn in self._admission.get(obj.KIND, []):
+                fn(obj)
+            key = self._key(obj)
+            if key in self._objects:
+                raise AlreadyExistsError(f"{key} already exists")
+            obj.metadata.ensure_uid(obj.KIND)
+            obj.metadata.resource_version = next(self._rv)
+            self._objects[key] = obj
+            self._notify("Added", obj)
+            return obj
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        with self._lock:
+            try:
+                return self._objects[(kind, namespace or "", name)]
+            except KeyError:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found") from None
+
+    def try_get(self, kind: str, namespace: str, name: str) -> Optional[Any]:
+        with self._lock:
+            return self._objects.get((kind, namespace or "", name))
+
+    def update(self, obj: Any, check_version: bool = True) -> Any:
+        with self._lock:
+            key = self._key(obj)
+            current = self._objects.get(key)
+            if current is None:
+                raise NotFoundError(f"{key} not found")
+            if check_version and current is not obj and (
+                obj.metadata.resource_version != current.metadata.resource_version
+            ):
+                raise ConflictError(
+                    f"{key}: stale resourceVersion {obj.metadata.resource_version} "
+                    f"!= {current.metadata.resource_version}"
+                )
+            obj.metadata.resource_version = next(self._rv)
+            self._objects[key] = obj
+            self._notify("Modified", obj)
+            return obj
+
+    def delete(self, kind: str, namespace: str, name: str) -> Any:
+        with self._lock:
+            key = (kind, namespace or "", name)
+            obj = self._objects.pop(key, None)
+            if obj is None:
+                raise NotFoundError(f"{key} not found")
+            self._notify("Deleted", obj)
+            return obj
+
+    def try_delete(self, kind: str, namespace: str, name: str) -> Optional[Any]:
+        try:
+            return self.delete(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Any]:
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in self._objects.items():
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if label_selector:
+                    labels = obj.metadata.labels
+                    if not all(labels.get(lk) == lv for lk, lv in label_selector.items()):
+                        continue
+                out.append(obj)
+            return out
+
+    # -- events ------------------------------------------------------------
+
+    def record_event(self, event: Event) -> None:
+        with self._lock:
+            self._events.append(event)
+
+    def events(
+        self, object_name: Optional[str] = None, reason: Optional[str] = None
+    ) -> List[Event]:
+        with self._lock:
+            return [
+                e
+                for e in self._events
+                if (object_name is None or e.object_name == object_name)
+                and (reason is None or e.reason == reason)
+            ]

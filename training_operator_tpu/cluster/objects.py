@@ -1,0 +1,219 @@
+"""Substrate object model: Pod, Service, Node, PodGroup, ConfigMap, Event.
+
+These mirror the Kubernetes objects the reference's engine manipulates
+(pods/services via pkg/controller.v1/control, PodGroups via
+control/podgroup_control.go, ConfigMaps in the MPI controller), reduced to the
+fields the reconcile engine and placement engine actually consume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from training_operator_tpu.api.common import PodTemplateSpec, RestartPolicy
+from training_operator_tpu.api.jobs import ObjectMeta
+
+
+class PodPhase(str, enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+
+
+@dataclass
+class ContainerStatus:
+    name: str
+    restart_count: int = 0
+    exit_code: Optional[int] = None
+    running: bool = False
+
+
+@dataclass
+class PodStatus:
+    phase: PodPhase = PodPhase.PENDING
+    container_statuses: List[ContainerStatus] = field(default_factory=list)
+    start_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    scheduled_time: Optional[float] = None
+    message: str = ""
+
+    def restart_count(self) -> int:
+        return sum(cs.restart_count for cs in self.container_statuses)
+
+    def exit_code(self, container: str) -> Optional[int]:
+        for cs in self.container_statuses:
+            if cs.name == container:
+                return cs.exit_code
+        return None
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+    node_name: str = ""  # set by a scheduler binding
+
+    KIND = "Pod"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def is_terminal(self) -> bool:
+        return self.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+
+    def resources(self) -> Dict[str, float]:
+        return self.spec.resources()
+
+    def effective_restart_policy(self) -> RestartPolicy:
+        return self.spec.restart_policy or RestartPolicy.ON_FAILURE
+
+
+@dataclass
+class Service:
+    """Headless service: one per replica, named <job>-<type>-<index>, giving the
+    stable DNS identity used for rendezvous (reference pkg/core/service.go)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: Dict[str, int] = field(default_factory=dict)
+
+    KIND = "Service"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def dns_name(self, cluster_domain: str = "cluster.local") -> str:
+        return f"{self.metadata.name}.{self.metadata.namespace}.svc.{cluster_domain}"
+
+
+@dataclass
+class AcceleratorInfo:
+    """Physical accelerator topology of a node.
+
+    TPU nodes: `tpu_slice` names the slice this node's chips belong to;
+    `ici_coords` gives the node's position in the slice's chip grid as the
+    coordinates of its first chip; `chips` counts chips on this node.
+    GPU nodes: `nvlink_domain` identifies the NVLink island.
+    """
+
+    kind: str = ""  # "tpu" | "gpu" | ""
+    chips: int = 0
+    tpu_type: str = ""  # e.g. "v5e"
+    tpu_slice: str = ""  # slice id, e.g. "slice-0"
+    slice_topology: str = ""  # full slice chip grid, e.g. "4x4"
+    ici_coords: Optional[List[int]] = None  # node origin within slice grid
+    nvlink_domain: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    capacity: Dict[str, float] = field(default_factory=dict)
+    accelerator: AcceleratorInfo = field(default_factory=AcceleratorInfo)
+    unschedulable: bool = False
+
+    KIND = "Node"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def allocatable(self) -> Dict[str, float]:
+        return dict(self.capacity)
+
+    def matches_selector(self, selector: Dict[str, str]) -> bool:
+        return all(self.metadata.labels.get(k) == v for k, v in selector.items())
+
+
+class PodGroupPhase(str, enum.Enum):
+    """Gang-scheduling lifecycle, modeled on Volcano's PodGroup phases
+    (reference control/podgroup_control.go:81 gates pod creation on Inqueue)."""
+
+    PENDING = "Pending"
+    INQUEUE = "Inqueue"
+    RUNNING = "Running"
+    UNSCHEDULABLE = "Unschedulable"
+
+
+@dataclass
+class PodGroup:
+    """Gang-scheduling unit: min_member pods admitted all-or-nothing.
+
+    `placement` is the tpu-packer output: pod-name -> node-name assignments
+    plus the chosen slice/topology, which the engine turns into per-pod
+    node_selector patches (the north-star seam).
+    """
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    min_member: int = 0
+    min_resources: Dict[str, float] = field(default_factory=dict)
+    queue: str = ""
+    priority_class: str = ""
+    schedule_timeout_seconds: Optional[int] = None
+    topology_request: Optional[str] = None  # e.g. "2x4" ICI mesh ask
+    num_slices: int = 1
+    phase: PodGroupPhase = PodGroupPhase.PENDING
+    placement: Dict[str, str] = field(default_factory=dict)  # pod name -> node name
+    placement_score: float = 0.0
+    creation_attempts: int = 0
+
+    KIND = "PodGroup"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class ConfigMap:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+
+    KIND = "ConfigMap"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class Event:
+    """Lifecycle event (reference emits k8s Events for every action,
+    e.g. common/pod.go:346,364)."""
+
+    object_kind: str = ""
+    object_name: str = ""
+    namespace: str = ""
+    event_type: str = "Normal"  # Normal | Warning
+    reason: str = ""
+    message: str = ""
+    timestamp: float = 0.0
+
+    KIND = "Event"

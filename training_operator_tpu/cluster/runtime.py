@@ -1,0 +1,286 @@
+"""Cluster runtime: clock, timer heap, tick loop, default scheduler, kubelet.
+
+Single-threaded and event-driven by design. Controllers, schedulers, and the
+virtual kubelet register as *tickers*; each `Cluster.step()` drains due timers
+then runs every ticker once. Watch events queue between ticks, which faithfully
+reproduces the informer-echo asynchrony the reference's expectations cache
+exists to absorb (expectation/expectation.go:29-40) while keeping every test
+deterministic — the "envtest with no kubelet" strategy from SURVEY.md §4, with
+the option of a real kubelet (`SimKubelet`) that actually runs pod lifecycles.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import time as _time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from training_operator_tpu.cluster.apiserver import APIServer
+from training_operator_tpu.cluster.objects import (
+    ContainerStatus,
+    Node,
+    Pod,
+    PodPhase,
+)
+
+ANNOTATION_SIM_DURATION = "sim.tpu.dev/run-seconds"
+ANNOTATION_SIM_EXIT_CODE = "sim.tpu.dev/exit-code"
+
+
+class Clock:
+    """Real wall clock."""
+
+    def now(self) -> float:
+        return _time.monotonic()
+
+    def is_virtual(self) -> bool:
+        return False
+
+
+class VirtualClock(Clock):
+    """Manually-advanced clock for deterministic TTL/backoff/deadline tests."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = start
+
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, dt: float) -> None:
+        self._now += dt
+
+    def set(self, t: float) -> None:
+        self._now = max(self._now, t)
+
+    def is_virtual(self) -> bool:
+        return True
+
+
+class Cluster:
+    """The substrate runtime tying APIServer + nodes + scheduler + kubelet."""
+
+    def __init__(self, clock: Optional[Clock] = None):
+        self.clock = clock or Clock()
+        self.api = APIServer()
+        self._tickers: List[Callable[[], None]] = []
+        self._timers: List[Tuple[float, int, Callable[[], None]]] = []
+        self._timer_seq = itertools.count()
+
+    # -- topology ----------------------------------------------------------
+
+    def add_nodes(self, nodes: List[Node]) -> None:
+        for n in nodes:
+            self.api.create(n)
+
+    def nodes(self) -> List[Node]:
+        return self.api.list("Node")
+
+    # -- scheduling of work ------------------------------------------------
+
+    def add_ticker(self, fn: Callable[[], None]) -> None:
+        self._tickers.append(fn)
+
+    def schedule_at(self, t: float, fn: Callable[[], None]) -> None:
+        heapq.heappush(self._timers, (t, next(self._timer_seq), fn))
+
+    def schedule_after(self, dt: float, fn: Callable[[], None]) -> None:
+        self.schedule_at(self.clock.now() + dt, fn)
+
+    def next_timer_at(self) -> Optional[float]:
+        return self._timers[0][0] if self._timers else None
+
+    def step(self) -> None:
+        """One tick: run due timers, then every ticker once."""
+        now = self.clock.now()
+        while self._timers and self._timers[0][0] <= now:
+            _, _, fn = heapq.heappop(self._timers)
+            fn()
+        for fn in list(self._tickers):
+            fn()
+
+    def run_until(
+        self,
+        predicate: Callable[[], bool],
+        timeout: float = 30.0,
+        max_steps: int = 1_000_000,
+    ) -> bool:
+        """Step until predicate holds. With a VirtualClock, idle time jumps to
+        the next timer; with a real clock, idle ticks sleep briefly.
+
+        The deadline check happens *after* stepping so a timer due exactly at
+        the deadline still fires before we give up.
+        """
+        deadline = self.clock.now() + timeout
+        for _ in range(max_steps):
+            if predicate():
+                return True
+            self.step()
+            if predicate():
+                return True
+            if self.clock.now() >= deadline:
+                return False
+            if isinstance(self.clock, VirtualClock):
+                nxt = self.next_timer_at()
+                if nxt is not None and nxt > self.clock.now():
+                    self.clock.set(min(nxt, deadline))
+                else:
+                    self.clock.advance(0.01)
+            else:
+                _time.sleep(0.0005)
+        return False
+
+    def run_for(self, seconds: float) -> None:
+        end = self.clock.now() + seconds
+        self.run_until(lambda: False, timeout=seconds)
+        if isinstance(self.clock, VirtualClock):
+            self.clock.set(end)
+
+
+class NodeAllocations:
+    """Tracks committed resources per node from bound, non-terminal pods."""
+
+    def __init__(self, api: APIServer):
+        self.api = api
+
+    def used(self) -> Dict[str, Dict[str, float]]:
+        used: Dict[str, Dict[str, float]] = {}
+        for pod in self.api.list("Pod"):
+            if not pod.node_name or pod.is_terminal():
+                continue
+            bucket = used.setdefault(pod.node_name, {})
+            for k, v in pod.resources().items():
+                bucket[k] = bucket.get(k, 0.0) + v
+        return used
+
+    def free(self) -> Dict[str, Dict[str, float]]:
+        used = self.used()
+        free: Dict[str, Dict[str, float]] = {}
+        for node in self.api.list("Node"):
+            if node.unschedulable:
+                continue
+            u = used.get(node.name, {})
+            free[node.name] = {
+                k: cap - u.get(k, 0.0) for k, cap in node.capacity.items()
+            }
+        return free
+
+    @staticmethod
+    def fits(request: Dict[str, float], avail: Dict[str, float]) -> bool:
+        return all(avail.get(k, 0.0) >= v for k, v in request.items())
+
+
+class DefaultScheduler:
+    """First-fit bind of pending pods — the reference's "default-scheduler"
+    baseline (BASELINE.md config 1). Skips pods that opt into gang scheduling
+    (scheduler_name set to a gang scheduler) — those are bound by the gang
+    scheduler component."""
+
+    def __init__(self, cluster: Cluster, handles_scheduler_names: Tuple[str, ...] = ("", "default-scheduler")):
+        self.cluster = cluster
+        self.alloc = NodeAllocations(cluster.api)
+        self.handles = set(handles_scheduler_names)
+        cluster.add_ticker(self.tick)
+
+    def tick(self) -> None:
+        pending = [
+            p
+            for p in self.cluster.api.list("Pod")
+            if p.status.phase == PodPhase.PENDING
+            and not p.node_name
+            and p.spec.scheduler_name in self.handles
+        ]
+        if not pending:
+            return
+        free = self.alloc.free()
+        nodes = {n.name: n for n in self.cluster.api.list("Node")}
+        for pod in pending:
+            req = pod.resources()
+            for name, node in nodes.items():
+                if node.unschedulable or name not in free:
+                    continue
+                if pod.spec.node_selector and not node.matches_selector(pod.spec.node_selector):
+                    continue
+                if NodeAllocations.fits(req, free[name]):
+                    bind_pod(self.cluster.api, pod, name, now=self.cluster.clock.now())
+                    for k, v in req.items():
+                        free[name][k] = free[name].get(k, 0.0) - v
+                    break
+
+
+def bind_pod(api: APIServer, pod: Pod, node_name: str, now: Optional[float] = None) -> None:
+    pod.node_name = node_name
+    if pod.status.scheduled_time is None and now is not None:
+        pod.status.scheduled_time = now
+    api.update(pod, check_version=False)
+
+
+class SimKubelet:
+    """Virtual kubelet: starts bound pods after a latency, optionally completes
+    them after an annotated duration with an annotated exit code.
+
+    Tests that want envtest-style manual phase control simply don't attach a
+    kubelet (or never annotate durations) and mutate pod phases directly.
+    """
+
+    def __init__(self, cluster: Cluster, start_latency: float = 0.0):
+        self.cluster = cluster
+        self.start_latency = start_latency
+        self._starting: set = set()
+        cluster.add_ticker(self.tick)
+
+    def tick(self) -> None:
+        for pod in self.cluster.api.list("Pod"):
+            if (
+                pod.node_name
+                and pod.status.phase == PodPhase.PENDING
+                and pod.metadata.uid not in self._starting
+            ):
+                self._starting.add(pod.metadata.uid)
+                if pod.status.scheduled_time is None:
+                    pod.status.scheduled_time = self.cluster.clock.now()
+                self.cluster.schedule_after(self.start_latency, self._make_starter(pod.metadata.uid, pod.namespace, pod.name))
+
+    def _make_starter(self, uid: str, namespace: str, name: str):
+        def start():
+            pod = self.cluster.api.try_get("Pod", namespace, name)
+            if pod is None or pod.metadata.uid != uid or pod.status.phase != PodPhase.PENDING:
+                self._starting.discard(uid)
+                return
+            pod.status.phase = PodPhase.RUNNING
+            pod.status.start_time = self.cluster.clock.now()
+            pod.status.container_statuses = [
+                ContainerStatus(name=c.name, running=True) for c in pod.spec.containers
+            ]
+            self.cluster.api.update(pod, check_version=False)
+            self._starting.discard(uid)
+            dur = pod.spec.annotations.get(ANNOTATION_SIM_DURATION)
+            if dur is not None:
+                code = int(pod.spec.annotations.get(ANNOTATION_SIM_EXIT_CODE, "0"))
+                self.cluster.schedule_after(
+                    float(dur), self._make_finisher(uid, namespace, name, code)
+                )
+
+        return start
+
+    def _make_finisher(self, uid: str, namespace: str, name: str, exit_code: int):
+        def finish():
+            pod = self.cluster.api.try_get("Pod", namespace, name)
+            if pod is None or pod.metadata.uid != uid or pod.status.phase != PodPhase.RUNNING:
+                return
+            mark_pod_finished(self.cluster.api, pod, exit_code, now=self.cluster.clock.now())
+
+        return finish
+
+
+def mark_pod_finished(api: APIServer, pod: Pod, exit_code: int, now: float = 0.0) -> None:
+    pod.status.phase = PodPhase.SUCCEEDED if exit_code == 0 else PodPhase.FAILED
+    pod.status.finish_time = now
+    for cs in pod.status.container_statuses:
+        cs.running = False
+        cs.exit_code = exit_code
+    if not pod.status.container_statuses:
+        pod.status.container_statuses = [
+            ContainerStatus(name=c.name, exit_code=exit_code) for c in pod.spec.containers
+        ]
+    api.update(pod, check_version=False)
