@@ -230,7 +230,10 @@ def update_job_conditions(
         _filter_out(status, JobConditionType.SUSPENDED)
         if cond_type != JobConditionType.RUNNING:
             _filter_out(status, JobConditionType.RUNNING)
-    if cond_status and cond_type == JobConditionType.RESTARTING:
+    if cond_status and cond_type in (
+        JobConditionType.RESTARTING,
+        JobConditionType.SUSPENDED,
+    ):
         _filter_out(status, JobConditionType.RUNNING)
 
     existing = get_condition(status, cond_type)

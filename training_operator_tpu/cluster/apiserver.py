@@ -16,7 +16,6 @@ Kubernetes API server. Two properties matter and are reproduced faithfully:
 
 from __future__ import annotations
 
-import itertools
 import threading
 from collections import deque
 from dataclasses import dataclass
@@ -42,6 +41,10 @@ class WatchEvent:
     type: str  # Added | Modified | Deleted
     kind: str
     obj: Any
+    # True for writes that only touched .status (controllers' own writes);
+    # managers skip re-enqueueing these to avoid self-echo reconcile storms
+    # (the role GenerationChangedPredicate plays in controller-runtime).
+    status_only: bool = False
 
 
 class WatchQueue:
@@ -69,7 +72,7 @@ class APIServer:
 
     def __init__(self) -> None:
         self._objects: Dict[Tuple[str, str, str], Any] = {}
-        self._rv = itertools.count(1)
+        self._rv_value = 0
         self._watchers: List[WatchQueue] = []
         self._events: List[Event] = []
         self._lock = threading.RLock()
@@ -89,8 +92,17 @@ class APIServer:
             self._watchers.append(wq)
         return wq
 
-    def _notify(self, ev_type: str, obj: Any) -> None:
-        ev = WatchEvent(ev_type, obj.KIND, obj)
+    def _next_rv(self) -> int:
+        self._rv_value += 1
+        return self._rv_value
+
+    def version(self) -> int:
+        """Global write counter — lets the cluster loop detect quiescence."""
+        with self._lock:
+            return self._rv_value
+
+    def _notify(self, ev_type: str, obj: Any, status_only: bool = False) -> None:
+        ev = WatchEvent(ev_type, obj.KIND, obj, status_only=status_only)
         for w in self._watchers:
             w.push(ev)
 
@@ -109,7 +121,7 @@ class APIServer:
             if key in self._objects:
                 raise AlreadyExistsError(f"{key} already exists")
             obj.metadata.ensure_uid(obj.KIND)
-            obj.metadata.resource_version = next(self._rv)
+            obj.metadata.resource_version = self._next_rv()
             self._objects[key] = obj
             self._notify("Added", obj)
             return obj
@@ -125,7 +137,7 @@ class APIServer:
         with self._lock:
             return self._objects.get((kind, namespace or "", name))
 
-    def update(self, obj: Any, check_version: bool = True) -> Any:
+    def update(self, obj: Any, check_version: bool = True, status_only: bool = False) -> Any:
         with self._lock:
             key = self._key(obj)
             current = self._objects.get(key)
@@ -138,9 +150,9 @@ class APIServer:
                     f"{key}: stale resourceVersion {obj.metadata.resource_version} "
                     f"!= {current.metadata.resource_version}"
                 )
-            obj.metadata.resource_version = next(self._rv)
+            obj.metadata.resource_version = self._next_rv()
             self._objects[key] = obj
-            self._notify("Modified", obj)
+            self._notify("Modified", obj, status_only=status_only)
             return obj
 
     def delete(self, kind: str, namespace: str, name: str) -> Any:

@@ -109,23 +109,31 @@ class Cluster:
         the next timer; with a real clock, idle ticks sleep briefly.
 
         The deadline check happens *after* stepping so a timer due exactly at
-        the deadline still fires before we give up.
+        the deadline still fires before we give up. With a VirtualClock, time
+        only jumps forward when the system is quiescent (no API writes during
+        the last step and no timers already due) — otherwise cascading work
+        (scheduler binding -> kubelet start -> controller reconcile) would be
+        skipped over by an early timer jump.
         """
         deadline = self.clock.now() + timeout
         for _ in range(max_steps):
             if predicate():
                 return True
+            version_before = self.api.version()
             self.step()
             if predicate():
                 return True
             if self.clock.now() >= deadline:
                 return False
             if isinstance(self.clock, VirtualClock):
+                if self.api.version() != version_before:
+                    continue  # activity this step; let cascades settle first
                 nxt = self.next_timer_at()
-                if nxt is not None and nxt > self.clock.now():
-                    self.clock.set(min(nxt, deadline))
-                else:
+                if nxt is None:
                     self.clock.advance(0.01)
+                elif nxt > self.clock.now():
+                    self.clock.set(min(nxt, deadline))
+                # due timers fire on the next step at the current instant
             else:
                 _time.sleep(0.0005)
         return False
@@ -254,19 +262,43 @@ class SimKubelet:
             ]
             self.cluster.api.update(pod, check_version=False)
             self._starting.discard(uid)
-            dur = pod.spec.annotations.get(ANNOTATION_SIM_DURATION)
-            if dur is not None:
-                code = int(pod.spec.annotations.get(ANNOTATION_SIM_EXIT_CODE, "0"))
-                self.cluster.schedule_after(
-                    float(dur), self._make_finisher(uid, namespace, name, code)
-                )
+            self._schedule_finish(pod, uid)
 
         return start
+
+    def _schedule_finish(self, pod: Pod, uid: str) -> None:
+        """Arm the completion timer from the pod's sim annotations (if any)."""
+        dur = pod.spec.annotations.get(ANNOTATION_SIM_DURATION)
+        if dur is None:
+            return
+        code = int(pod.spec.annotations.get(ANNOTATION_SIM_EXIT_CODE, "0"))
+        self.cluster.schedule_after(
+            float(dur), self._make_finisher(uid, pod.namespace, pod.name, code)
+        )
 
     def _make_finisher(self, uid: str, namespace: str, name: str, exit_code: int):
         def finish():
             pod = self.cluster.api.try_get("Pod", namespace, name)
             if pod is None or pod.metadata.uid != uid or pod.status.phase != PodPhase.RUNNING:
+                return
+            # Honor pod-level restart policy the way the kubelet does:
+            # Always restarts in place on any exit; OnFailure on exit != 0;
+            # Never (and OnFailure with exit 0) surfaces the terminal phase.
+            # In-place restarts bump restart_count — the signal
+            # past_backoff_limit sums (reference core/job.go:95).
+            from training_operator_tpu.api.common import RestartPolicy
+
+            policy = pod.effective_restart_policy()
+            should_restart = policy == RestartPolicy.ALWAYS or (
+                policy == RestartPolicy.ON_FAILURE and exit_code != 0
+            )
+            if should_restart:
+                for cs in pod.status.container_statuses:
+                    cs.restart_count += 1
+                    cs.exit_code = exit_code
+                    cs.running = True
+                self.cluster.api.update(pod, check_version=False)
+                self._schedule_finish(pod, uid)
                 return
             mark_pod_finished(self.cluster.api, pod, exit_code, now=self.cluster.clock.now())
 

@@ -1,0 +1,12 @@
+"""Per-kind controllers implementing the ControllerInterface contract.
+
+Parity target: reference pkg/controller.v1/{jax,pytorch,tensorflow,xgboost,
+paddlepaddle,mpi} — each kind supplies its distributed-bootstrap env injection
+(SetClusterSpec), master-role semantics, and framework-specific status logic
+on top of the shared JobController engine.
+"""
+
+from training_operator_tpu.controllers.base import BaseController
+from training_operator_tpu.controllers.manager import OperatorManager
+
+__all__ = ["BaseController", "OperatorManager"]

@@ -1,0 +1,61 @@
+"""JAXJob controller — the primary, TPU-native path.
+
+Parity target: reference pkg/controller.v1/jax (envvar.go:37-77,
+jaxjob_controller.go:443 SetClusterSpec). Worker-0 is the coordinator; every
+worker gets the bootstrap env that maps 1:1 onto
+`jax.distributed.initialize(coordinator_address, num_processes, process_id)`:
+
+    COORDINATOR_ADDRESS  <job>-worker-0 headless service DNS name
+    COORDINATOR_PORT     job's coordinator port (default 6666)
+    NUM_PROCESSES        total worker replicas
+    PROCESS_ID           this replica's index
+    PYTHONUNBUFFERED     1
+
+TPU-first extension: when the job carries a TPUPolicy, the mesh geometry is
+also exported (TPU_MESH_AXES/TPU_SLICE_TOPOLOGY/TPU_NUM_SLICES) so the trainer
+runtime can build its jax.sharding.Mesh without out-of-band config.
+"""
+
+from __future__ import annotations
+
+from training_operator_tpu.api.jobs import JAXJob, Job, REPLICA_WORKER
+from training_operator_tpu.controllers.base import BaseController
+from training_operator_tpu.engine.core import gen_general_name
+
+
+class JAXController(BaseController):
+    kind = "JAXJob"
+    master_types = ()  # worker-only; worker-0 is the coordinator
+    leader_priority = (REPLICA_WORKER,)
+
+    def is_master_role(self, job: Job, rtype: str, index: int) -> bool:
+        return rtype == REPLICA_WORKER and index == 0
+
+    def set_cluster_spec(self, job: Job, template, rtype: str, index: int) -> None:
+        assert isinstance(job, JAXJob)
+        coordinator_addr = gen_general_name(job.name, REPLICA_WORKER, 0)
+        port = job.coordinator_port
+        worker_spec = job.replica_specs.get(REPLICA_WORKER)
+        if worker_spec is not None:
+            c = worker_spec.template.main_container(self.default_container_name())
+            if c is not None and c.ports:
+                port = next(iter(c.ports.values()))
+        total = job.total_replicas()
+        env = {
+            "PYTHONUNBUFFERED": "1",
+            "COORDINATOR_PORT": str(port),
+            "COORDINATOR_ADDRESS": coordinator_addr,
+            "NUM_PROCESSES": str(total),
+            "PROCESS_ID": str(index),
+        }
+        if job.tpu_policy is not None:
+            tp = job.tpu_policy
+            env["TPU_ACCELERATOR"] = tp.accelerator
+            env["TPU_NUM_SLICES"] = str(tp.num_slices)
+            if tp.topology:
+                env["TPU_SLICE_TOPOLOGY"] = tp.topology
+            if tp.mesh_axes:
+                env["TPU_MESH_AXES"] = ",".join(f"{k}={v}" for k, v in tp.mesh_axes.items())
+        for c in template.containers:
+            for k, v in env.items():
+                c.env.setdefault(k, v)

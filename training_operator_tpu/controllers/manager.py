@@ -1,0 +1,148 @@
+"""OperatorManager: the controller-runtime equivalent.
+
+Wires watch streams -> expectations observation -> rate-limited workqueue ->
+per-kind reconcilers, as a cluster ticker. Parity target: the manager setup in
+cmd/training-operator.v1/main.go:134-223 plus the watch predicates in
+pkg/common/util/reconciler.go:67 (OnDependentFuncs: pod/service events observe
+expectations and enqueue the owning job).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Tuple
+
+from training_operator_tpu.api.common import (
+    JOB_KIND_LABEL,
+    JOB_NAME_LABEL,
+    REPLICA_TYPE_LABEL,
+)
+from training_operator_tpu.api.defaults import default_job
+from training_operator_tpu.api.jobs import Job
+from training_operator_tpu.api.validation import validate_job
+from training_operator_tpu.cluster.runtime import Cluster
+from training_operator_tpu.engine.controller import JobController
+from training_operator_tpu.engine.expectations import gen_expectation_key
+from training_operator_tpu.engine.workqueue import RateLimitingQueue
+from training_operator_tpu.utils import metrics
+
+log = logging.getLogger(__name__)
+
+
+class OperatorManager:
+    """Runs all registered job-kind controllers against one cluster."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        gang_enabled: bool = False,
+        reconciles_per_tick: int = 256,
+    ):
+        self.cluster = cluster
+        self.api = cluster.api
+        self.gang_enabled = gang_enabled
+        self.reconciles_per_tick = reconciles_per_tick
+        self.queue = RateLimitingQueue()
+        self.controllers: Dict[str, Tuple[object, JobController]] = {}
+        self._watch = self.api.watch()
+        cluster.add_ticker(self.tick)
+
+    # ------------------------------------------------------------------
+
+    def register(self, controller) -> None:
+        kind = controller.kind
+        jc = JobController(
+            self.api,
+            controller,
+            now_fn=self.cluster.clock.now,
+            gang_enabled=self.gang_enabled,
+            # The engine passes bare "ns/name"; prefix the kind so requeues
+            # land in the same key space as event enqueues.
+            requeue_after=lambda job_key, delay: self._requeue_after(
+                f"{kind}|{job_key}", delay
+            ),
+            delete_job=self._delete_job,
+        )
+        self.controllers[controller.kind] = (controller, jc)
+        self.api.register_admission(controller.kind, validate_job)
+
+    def submit(self, job: Job) -> Job:
+        """Client entry: default + validate + create (the admission path)."""
+        default_job(job, now=self.cluster.clock.now())
+        return self.api.create(job)
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _key(kind: str, namespace: str, name: str) -> str:
+        return f"{kind}|{namespace}/{name}"
+
+    def _requeue_after(self, key: str, delay: float) -> None:
+        self.cluster.schedule_after(delay, lambda: self.queue.add(key))
+
+    def _delete_job(self, job: Job) -> None:
+        """TTL garbage collection (reference CleanupJob)."""
+        self.api.try_delete(job.kind, job.namespace, job.name)
+
+    # ------------------------------------------------------------------
+
+    def tick(self) -> None:
+        for ev in self._watch.drain():
+            self._handle_event(ev)
+        for key in self.queue.drain(limit=self.reconciles_per_tick):
+            self._process(key)
+
+    def _handle_event(self, ev) -> None:
+        kind = ev.kind
+        obj = ev.obj
+        if kind in self.controllers:
+            if ev.status_only:
+                return  # our own status write echoing back; no work to do
+            key = self._key(kind, obj.namespace, obj.name)
+            if ev.type == "Deleted":
+                metrics.jobs_deleted.inc(obj.namespace, kind)
+                _, jc = self.controllers[kind]
+                for rtype in obj.replica_specs:
+                    jc.expectations.delete_expectations(
+                        gen_expectation_key(obj.key(), rtype, "pods")
+                    )
+                    jc.expectations.delete_expectations(
+                        gen_expectation_key(obj.key(), rtype, "services")
+                    )
+            else:
+                self.queue.add(key)
+        elif kind in ("Pod", "Service"):
+            labels = obj.metadata.labels
+            job_kind = labels.get(JOB_KIND_LABEL)
+            job_name = labels.get(JOB_NAME_LABEL)
+            if not job_kind or not job_name or job_kind not in self.controllers:
+                return
+            job_key = f"{obj.namespace}/{job_name}"
+            rtype = labels.get(REPLICA_TYPE_LABEL, "")
+            _, jc = self.controllers[job_kind]
+            exp_key = gen_expectation_key(job_key, rtype, "pods" if kind == "Pod" else "services")
+            if ev.type == "Added":
+                jc.expectations.creation_observed(exp_key)
+            elif ev.type == "Deleted":
+                jc.expectations.deletion_observed(exp_key)
+            self.queue.add(self._key(job_kind, obj.namespace, job_name))
+        elif kind == "PodGroup":
+            job_kind = obj.metadata.labels.get("job-kind")
+            if job_kind in self.controllers:
+                self.queue.add(self._key(job_kind, obj.namespace, obj.name))
+
+    def _process(self, key: str) -> None:
+        kind, nsname = key.split("|", 1)
+        ns, name = nsname.split("/", 1)
+        entry = self.controllers.get(kind)
+        if entry is None:
+            return
+        _, jc = entry
+        try:
+            jc.reconcile(ns, name)
+        except Exception:
+            log.exception("reconcile failed for %s", key)
+            delay = self.queue.failure_delay(key)
+            self.cluster.schedule_after(delay, lambda: self.queue.add(key))
+        else:
+            self.queue.forget(key)

@@ -1,0 +1,82 @@
+"""Expectations cache: the informer-race correctness mechanism.
+
+Parity target: reference pkg/controller.v1/expectation/expectation.go:71-220.
+
+Between a successful `CreatePod` API write and the watch event echoing that pod
+back into the informer cache, a reconcile listing pods sees fewer than it
+created and would create duplicates. The expectations cache records "I expect
+to observe N adds / M deletes for job-key/replica-type/kind"; reconciles are
+only allowed to mutate once expectations are satisfied (all echoes observed),
+or after a TTL expiry (5 min, reference expectation.go:40) in case events were
+dropped.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+EXPECTATION_TIMEOUT_SECONDS = 300.0  # reference ExpectationsTimeout = 5 * time.Minute
+
+
+def gen_expectation_key(job_key: str, replica_type: str, kind: str) -> str:
+    """kind is "pods" or "services" (reference GenExpectationPodsKey/...ServicesKey)."""
+    return f"{job_key}/{replica_type.lower()}/{kind}"
+
+
+@dataclass
+class _Expectation:
+    adds: int = 0
+    deletes: int = 0
+    timestamp: float = field(default=0.0)
+
+    def fulfilled(self) -> bool:
+        return self.adds <= 0 and self.deletes <= 0
+
+
+class ControllerExpectations:
+    """Per-key add/delete expectation counters with TTL.
+
+    `now_fn` is injectable so TTL expiry is testable with a virtual clock.
+    """
+
+    def __init__(self, now_fn: Optional[Callable[[], float]] = None):
+        self._store: Dict[str, _Expectation] = {}
+        self._now = now_fn or _time.monotonic
+
+    def expect_creations(self, key: str, count: int) -> None:
+        self._store[key] = _Expectation(adds=count, deletes=0, timestamp=self._now())
+
+    def expect_deletions(self, key: str, count: int) -> None:
+        self._store[key] = _Expectation(adds=0, deletes=count, timestamp=self._now())
+
+    def raise_expectations(self, key: str, adds: int, deletes: int) -> None:
+        exp = self._store.setdefault(key, _Expectation(timestamp=self._now()))
+        exp.adds += adds
+        exp.deletes += deletes
+
+    def creation_observed(self, key: str) -> None:
+        exp = self._store.get(key)
+        if exp is not None and exp.adds > 0:
+            exp.adds -= 1
+
+    def deletion_observed(self, key: str) -> None:
+        exp = self._store.get(key)
+        if exp is not None and exp.deletes > 0:
+            exp.deletes -= 1
+
+    def satisfied_expectations(self, key: str) -> bool:
+        """True if fulfilled, expired, or never set (reference
+        SatisfiedExpectations: a brand-new controller must sync)."""
+        exp = self._store.get(key)
+        if exp is None:
+            return True
+        if exp.fulfilled():
+            return True
+        if self._now() - exp.timestamp > EXPECTATION_TIMEOUT_SECONDS:
+            return True
+        return False
+
+    def delete_expectations(self, key: str) -> None:
+        self._store.pop(key, None)

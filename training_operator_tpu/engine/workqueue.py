@@ -1,0 +1,64 @@
+"""Rate-limiting work queue with per-key exponential backoff.
+
+Parity target: client-go's workqueue as used by controller-runtime (the
+reference's queueing substrate; pkg/common/util/fake_workqueue.go exists
+precisely because controller-runtime owns the real one). Keys are
+namespace/name strings; a key present in the queue is deduplicated, and
+`requeue_after` integrates with the cluster timer heap for delayed retries
+(backoff/TTL/deadline requeues, reference common/job.go:176-214).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+
+class RateLimitingQueue:
+    """Deduplicating FIFO with per-key failure counts for backoff.
+
+    base_delay/max_delay mirror client-go's DefaultItemBasedRateLimiter
+    (5ms .. 1000s exponential).
+    """
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 300.0):
+        self._queue: "OrderedDict[str, None]" = OrderedDict()
+        self._failures: Dict[str, int] = {}
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+
+    def add(self, key: str) -> None:
+        if key not in self._queue:
+            self._queue[key] = None
+
+    def get(self) -> Optional[str]:
+        if not self._queue:
+            return None
+        key, _ = self._queue.popitem(last=False)
+        return key
+
+    def drain(self, limit: int = 0) -> List[str]:
+        out = []
+        while self._queue and (not limit or len(out) < limit):
+            out.append(self.get())
+        return out
+
+    def __len__(self) -> int:
+        return len(self._queue)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._queue
+
+    # -- rate limiting -----------------------------------------------------
+
+    def num_requeues(self, key: str) -> int:
+        return self._failures.get(key, 0)
+
+    def failure_delay(self, key: str) -> float:
+        """Record a failure and return the backoff delay before retrying."""
+        n = self._failures.get(key, 0)
+        self._failures[key] = n + 1
+        return min(self.base_delay * (2**n), self.max_delay)
+
+    def forget(self, key: str) -> None:
+        self._failures.pop(key, None)

@@ -1,0 +1,133 @@
+"""Prometheus-style counter/gauge registry.
+
+Parity target: reference pkg/common/metrics.go:25-61 (jobs created/deleted/
+successful/failed/restarted by namespace+framework) plus the pod/service/
+podgroup counters in common/pod.go:57-70 and common/job_controller.go:51-58.
+Metric names are kept compatible where sensible so dashboards translate.
+
+Implemented standalone (no prometheus_client dependency); `render()` emits
+text exposition format for scraping/export.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str, label_names: Tuple[str, ...]):
+        self.name = name
+        self.help = help_text
+        self.label_names = label_names
+        self._values: Dict[Tuple[str, ...], float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    def inc(self, *label_values: str, amount: float = 1.0) -> None:
+        if len(label_values) != len(self.label_names):
+            raise ValueError(f"{self.name}: expected labels {self.label_names}")
+        with self._lock:
+            self._values[tuple(label_values)] += amount
+
+    def value(self, *label_values: str) -> float:
+        return self._values.get(tuple(label_values), 0.0)
+
+    def total(self) -> float:
+        return sum(self._values.values())
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for labels, v in sorted(self._values.items()):
+            label_str = ",".join(
+                f'{n}="{val}"' for n, val in zip(self.label_names, labels)
+            )
+            lines.append(f"{self.name}{{{label_str}}} {v}")
+        return lines
+
+
+class Gauge(Counter):
+    def set(self, *label_values: str, value: float = 0.0) -> None:
+        with self._lock:
+            self._values[tuple(label_values)] = value
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for labels, v in sorted(self._values.items()):
+            label_str = ",".join(
+                f'{n}="{val}"' for n, val in zip(self.label_names, labels)
+            )
+            lines.append(f"{self.name}{{{label_str}}} {v}")
+        return lines
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._metrics: Dict[str, Counter] = {}
+
+    def counter(self, name: str, help_text: str = "", labels: Tuple[str, ...] = ()) -> Counter:
+        if name not in self._metrics:
+            self._metrics[name] = Counter(name, help_text, labels)
+        return self._metrics[name]
+
+    def gauge(self, name: str, help_text: str = "", labels: Tuple[str, ...] = ()) -> Gauge:
+        if name not in self._metrics:
+            self._metrics[name] = Gauge(name, help_text, labels)
+        return self._metrics[name]
+
+    def render(self) -> str:
+        out: List[str] = []
+        for m in self._metrics.values():
+            out.extend(m.render())
+        return "\n".join(out) + "\n"
+
+
+# Global registry + the reference's counter families.
+registry = MetricsRegistry()
+
+jobs_created = registry.counter(
+    "training_operator_jobs_created_total",
+    "Counts number of jobs created",
+    ("job_namespace", "framework"),
+)
+jobs_deleted = registry.counter(
+    "training_operator_jobs_deleted_total",
+    "Counts number of jobs deleted",
+    ("job_namespace", "framework"),
+)
+jobs_successful = registry.counter(
+    "training_operator_jobs_successful_total",
+    "Counts number of jobs successful",
+    ("job_namespace", "framework"),
+)
+jobs_failed = registry.counter(
+    "training_operator_jobs_failed_total",
+    "Counts number of jobs failed",
+    ("job_namespace", "framework", "reason"),
+)
+jobs_restarted = registry.counter(
+    "training_operator_jobs_restarted_total",
+    "Counts number of jobs restarted",
+    ("job_namespace", "framework"),
+)
+created_pods = registry.counter(
+    "training_operator_created_pods_total", "The number of created pods", ()
+)
+deleted_pods = registry.counter(
+    "training_operator_deleted_pods_total", "The number of deleted pods", ()
+)
+restarted_pods = registry.counter(
+    "training_operator_restarted_pods_total", "The number of restarted pods", ()
+)
+created_services = registry.counter(
+    "training_operator_created_services_total", "The number of created services", ()
+)
+deleted_services = registry.counter(
+    "training_operator_deleted_services_total", "The number of deleted services", ()
+)
+created_podgroups = registry.counter(
+    "training_operator_created_podgroups_total", "The number of created podgroups", ()
+)
+deleted_podgroups = registry.counter(
+    "training_operator_deleted_podgroups_total", "The number of deleted podgroups", ()
+)
