@@ -1,0 +1,319 @@
+"""Per-kind controller tests: env-injection contracts + status semantics.
+
+Parity model: reference pod_test.go (cluster-spec env assertions),
+tfjob_controller_test.go (success policy), pytorchjob_controller_test.go
+(elastic/HPA), mpijob_controller_test.go (hostfile/launcher gating).
+"""
+
+import json
+
+import training_operator_tpu.api.common as capi
+from training_operator_tpu.api.common import (
+    Container,
+    JobConditionType,
+    PodTemplateSpec,
+    ReplicaSpec,
+)
+from training_operator_tpu.api.jobs import (
+    ElasticPolicy,
+    MPIJob,
+    ObjectMeta,
+    PaddleJob,
+    PyTorchJob,
+    RDZVBackend,
+    SuccessPolicy,
+    TFJob,
+    XGBoostJob,
+)
+from training_operator_tpu.cluster import Cluster
+from training_operator_tpu.cluster.inventory import make_cpu_pool
+from training_operator_tpu.cluster.runtime import (
+    ANNOTATION_SIM_DURATION,
+    Cluster,
+    DefaultScheduler,
+    SimKubelet,
+    VirtualClock,
+    mark_pod_finished,
+)
+from training_operator_tpu.controllers import OperatorManager, register_all
+
+
+def make_env(kubelet=True):
+    cluster = Cluster(VirtualClock())
+    cluster.add_nodes(make_cpu_pool(8))
+    DefaultScheduler(cluster)
+    if kubelet:
+        SimKubelet(cluster)
+    mgr = OperatorManager(cluster)
+    register_all(mgr)
+    return cluster, mgr
+
+
+def tmpl(cname, image="img", cpu=0.5, **annotations):
+    t = PodTemplateSpec(containers=[Container(name=cname, image=image, resources={"cpu": cpu})])
+    t.annotations.update(annotations)
+    return t
+
+
+def pods_of(cluster, name, rtype=None):
+    sel = {capi.JOB_NAME_LABEL: name}
+    if rtype:
+        sel[capi.REPLICA_TYPE_LABEL] = rtype
+    return sorted(cluster.api.list("Pod", "default", sel), key=lambda p: p.name)
+
+
+class TestPyTorch:
+    def test_master_worker_env(self):
+        cluster, mgr = make_env()
+        job = PyTorchJob(
+            metadata=ObjectMeta(name="pt"),
+            replica_specs={
+                "Master": ReplicaSpec(replicas=1, template=tmpl("pytorch")),
+                "Worker": ReplicaSpec(replicas=2, template=tmpl("pytorch")),
+            },
+        )
+        mgr.submit(job)
+        assert cluster.run_until(lambda: len(pods_of(cluster, "pt")) == 3, timeout=30)
+        master = pods_of(cluster, "pt", "Master")[0]
+        env = master.spec.containers[0].env
+        assert env["MASTER_ADDR"] == "pt-master-0"
+        assert env["MASTER_PORT"] == "23456"
+        assert env["WORLD_SIZE"] == "3"
+        assert env["RANK"] == "0"
+        assert env["PET_NNODES"] == "3"
+        workers = pods_of(cluster, "pt", "Worker")
+        for i, w in enumerate(workers):
+            assert w.spec.containers[0].env["RANK"] == str(i + 1)  # master offset
+            assert w.spec.containers[0].env["PET_NODE_RANK"] == str(i + 1)
+            # workers wait on the master service
+            assert w.spec.init_containers[0].name == "pytorch-init"
+            assert "pt-master-0" in " ".join(w.spec.init_containers[0].command)
+        assert not master.spec.init_containers
+
+    def test_elastic_env_and_hpa(self):
+        cluster, mgr = make_env()
+        job = PyTorchJob(
+            metadata=ObjectMeta(name="el"),
+            replica_specs={"Worker": ReplicaSpec(replicas=2, template=tmpl("pytorch"))},
+            elastic_policy=ElasticPolicy(
+                min_replicas=1, max_replicas=4, rdzv_backend=RDZVBackend.C10D, max_restarts=3
+            ),
+        )
+        mgr.submit(job)
+        assert cluster.run_until(lambda: len(pods_of(cluster, "el")) == 2, timeout=30)
+        env = pods_of(cluster, "el")[0].spec.containers[0].env
+        assert env["PET_RDZV_ENDPOINT"] == "el-worker-0:23456"
+        assert env["PET_RDZV_BACKEND"] == "c10d"
+        assert env["PET_NNODES"] == "1:4"
+        assert env["PET_MAX_RESTARTS"] == "3"
+        assert "MASTER_ADDR" not in env  # no master spec
+        hpa = cluster.api.try_get("HorizontalPodAutoscaler", "default", "el")
+        assert hpa is not None and hpa.min_replicas == 1 and hpa.max_replicas == 4
+
+    def test_nproc_per_node_world_size(self):
+        cluster, mgr = make_env()
+        job = PyTorchJob(
+            metadata=ObjectMeta(name="np"),
+            replica_specs={
+                "Master": ReplicaSpec(replicas=1, template=tmpl("pytorch")),
+                "Worker": ReplicaSpec(replicas=1, template=tmpl("pytorch")),
+            },
+            nproc_per_node=4,
+        )
+        mgr.submit(job)
+        assert cluster.run_until(lambda: len(pods_of(cluster, "np")) == 2, timeout=30)
+        env = pods_of(cluster, "np", "Master")[0].spec.containers[0].env
+        assert env["WORLD_SIZE"] == "8"  # 2 replicas x 4 procs
+        assert env["PET_NPROC_PER_NODE"] == "4"
+
+
+class TestTensorFlow:
+    def job(self, name="tf", dynamic=False, policy=SuccessPolicy.DEFAULT, chief=True):
+        specs = {
+            "Worker": ReplicaSpec(replicas=2, template=tmpl("tensorflow")),
+            "PS": ReplicaSpec(replicas=1, template=tmpl("tensorflow")),
+        }
+        if chief:
+            specs["Chief"] = ReplicaSpec(replicas=1, template=tmpl("tensorflow"))
+        return TFJob(
+            metadata=ObjectMeta(name=name),
+            replica_specs=specs,
+            success_policy=policy,
+            enable_dynamic_worker=dynamic,
+        )
+
+    def test_tf_config(self):
+        cluster, mgr = make_env()
+        mgr.submit(self.job())
+        assert cluster.run_until(lambda: len(pods_of(cluster, "tf")) == 4, timeout=30)
+        w1 = pods_of(cluster, "tf", "Worker")[1]
+        cfg = json.loads(w1.spec.containers[0].env["TF_CONFIG"])
+        assert cfg["task"] == {"type": "worker", "index": 1}
+        assert cfg["environment"] == "cloud"
+        assert cfg["cluster"]["worker"] == [
+            "tf-worker-0.default.svc:2222",
+            "tf-worker-1.default.svc:2222",
+        ]
+        assert cfg["cluster"]["ps"] == ["tf-ps-0.default.svc:2222"]
+        assert cfg["cluster"]["chief"] == ["tf-chief-0.default.svc:2222"]
+
+    def test_sparse_tf_config_dynamic_worker(self):
+        cluster, mgr = make_env()
+        mgr.submit(self.job(name="tfd", dynamic=True, chief=False))
+        assert cluster.run_until(lambda: len(pods_of(cluster, "tfd")) == 3, timeout=30)
+        w1 = pods_of(cluster, "tfd", "Worker")[1]
+        cfg = json.loads(w1.spec.containers[0].env["TF_CONFIG"])
+        assert cfg["cluster"]["worker"] == {"1": "tfd-worker-1.default.svc:2222"}
+        assert cfg["cluster"]["ps"] == ["tfd-ps-0.default.svc:2222"]
+
+    def test_chief_success_ends_job(self):
+        cluster, mgr = make_env(kubelet=False)
+        mgr.submit(self.job(name="tfc"))
+        assert cluster.run_until(lambda: len(pods_of(cluster, "tfc")) == 4, timeout=30)
+        chief = pods_of(cluster, "tfc", "Chief")[0]
+        mark_pod_finished(cluster.api, chief, 0, cluster.clock.now())
+        assert cluster.run_until(
+            lambda: capi.is_succeeded(cluster.api.get("TFJob", "default", "tfc").status),
+            timeout=30,
+        )
+
+    def test_all_workers_success_policy(self):
+        cluster, mgr = make_env(kubelet=False)
+        mgr.submit(self.job(name="tfa", policy=SuccessPolicy.ALL_WORKERS, chief=False))
+        assert cluster.run_until(lambda: len(pods_of(cluster, "tfa")) == 3, timeout=30)
+        workers = pods_of(cluster, "tfa", "Worker")
+        mark_pod_finished(cluster.api, workers[0], 0, cluster.clock.now())
+        cluster.run_for(1.0)
+        assert not capi.is_succeeded(cluster.api.get("TFJob", "default", "tfa").status)
+        mark_pod_finished(cluster.api, workers[1], 0, cluster.clock.now())
+        assert cluster.run_until(
+            lambda: capi.is_succeeded(cluster.api.get("TFJob", "default", "tfa").status),
+            timeout=30,
+        )
+
+    def test_chiefless_worker0_success(self):
+        cluster, mgr = make_env(kubelet=False)
+        mgr.submit(self.job(name="tfw", chief=False))
+        assert cluster.run_until(lambda: len(pods_of(cluster, "tfw")) == 3, timeout=30)
+        w0 = pods_of(cluster, "tfw", "Worker")[0]
+        mark_pod_finished(cluster.api, w0, 0, cluster.clock.now())
+        assert cluster.run_until(
+            lambda: capi.is_succeeded(cluster.api.get("TFJob", "default", "tfw").status),
+            timeout=30,
+        )
+
+
+class TestXGBoost:
+    def test_rabit_env(self):
+        cluster, mgr = make_env()
+        job = XGBoostJob(
+            metadata=ObjectMeta(name="xgb"),
+            replica_specs={
+                "Master": ReplicaSpec(replicas=1, template=tmpl("xgboost")),
+                "Worker": ReplicaSpec(replicas=2, template=tmpl("xgboost")),
+            },
+        )
+        mgr.submit(job)
+        assert cluster.run_until(lambda: len(pods_of(cluster, "xgb")) == 3, timeout=30)
+        w0 = pods_of(cluster, "xgb", "Worker")[0]
+        env = w0.spec.containers[0].env
+        assert env["MASTER_ADDR"] == "xgb-master-0"
+        assert env["MASTER_PORT"] == "9999"
+        assert env["WORLD_SIZE"] == "3"
+        assert env["RANK"] == "1"  # worker 0 offset by 1 master
+        assert env["WORKER_ADDRS"] == "xgb-worker-0,xgb-worker-1"
+        assert env["WORKER_PORT"] == "9999"
+
+
+class TestPaddle:
+    def test_collective_mode(self):
+        cluster, mgr = make_env()
+        job = PaddleJob(
+            metadata=ObjectMeta(name="pd"),
+            replica_specs={"Worker": ReplicaSpec(replicas=2, template=tmpl("paddle"))},
+        )
+        mgr.submit(job)
+        assert cluster.run_until(lambda: len(pods_of(cluster, "pd")) == 2, timeout=30)
+        env = pods_of(cluster, "pd")[0].spec.containers[0].env
+        assert env["PADDLE_MASTER"] == "pd-worker-0:37777"
+        assert env["PADDLE_NNODES"] == "2"
+        assert env["PADDLE_JOB_ID"] == "pd"
+
+    def test_ps_mode(self):
+        cluster, mgr = make_env()
+        job = PaddleJob(
+            metadata=ObjectMeta(name="pdps"),
+            replica_specs={
+                "Master": ReplicaSpec(replicas=1, template=tmpl("paddle")),
+                "Worker": ReplicaSpec(replicas=2, template=tmpl("paddle")),
+            },
+        )
+        mgr.submit(job)
+        assert cluster.run_until(lambda: len(pods_of(cluster, "pdps")) == 3, timeout=30)
+        m = pods_of(cluster, "pdps", "Master")[0].spec.containers[0].env
+        w = pods_of(cluster, "pdps", "Worker")[0].spec.containers[0].env
+        assert m["PADDLE_MASTER"] == "pdps-master-0:37777"
+        assert m["PADDLE_SERVER_NUM"] == "1"
+        assert w["PADDLE_TRAINER_NUM"] == "1"
+
+
+class TestMPI:
+    def job(self, name="mpi", workers=2, slots=2):
+        return MPIJob(
+            metadata=ObjectMeta(name=name),
+            replica_specs={
+                "Launcher": ReplicaSpec(replicas=1, template=tmpl("mpi")),
+                "Worker": ReplicaSpec(replicas=workers, template=tmpl("mpi")),
+            },
+            slots_per_worker=slots,
+        )
+
+    def test_launcher_gated_on_workers_then_hostfile(self):
+        cluster, mgr = make_env()
+        mgr.submit(self.job())
+        # Workers first; launcher only after they are Running.
+        assert cluster.run_until(
+            lambda: len(pods_of(cluster, "mpi", "Launcher")) == 1, timeout=60
+        )
+        workers = pods_of(cluster, "mpi", "Worker")
+        assert all(p.status.phase.value == "Running" for p in workers)
+
+        cm = cluster.api.get("ConfigMap", "default", "mpi-config")
+        assert cm.data["hostfile"] == "mpi-worker-0 slots=2\nmpi-worker-1 slots=2\n"
+        assert "echo mpi-worker-0" in cm.data["discover_hosts.sh"]
+
+        launcher = pods_of(cluster, "mpi", "Launcher")[0]
+        env = launcher.spec.containers[0].env
+        assert env["OMPI_MCA_orte_default_hostfile"] == "/etc/mpi/hostfile"
+        assert "exec-agent" in env["OMPI_MCA_plm_rsh_agent"]
+        # Workers get no bootstrap env
+        assert "OMPI_MCA_orte_default_hostfile" not in workers[0].spec.containers[0].env
+
+    def test_no_services_created(self):
+        cluster, mgr = make_env()
+        mgr.submit(self.job(name="mpi2"))
+        cluster.run_for(2.0)
+        assert not cluster.api.list("Service", "default", {capi.JOB_NAME_LABEL: "mpi2"})
+
+    def test_launcher_success_completes_job(self):
+        cluster, mgr = make_env()
+        job = self.job(name="mpi3")
+        job.replica_specs["Launcher"].template.annotations[ANNOTATION_SIM_DURATION] = "1.0"
+        mgr.submit(job)
+        assert cluster.run_until(
+            lambda: capi.is_succeeded(cluster.api.get("MPIJob", "default", "mpi3").status),
+            timeout=60,
+        ), "launcher completion must complete the job even with workers running"
+
+    def test_intel_env(self):
+        from training_operator_tpu.api.jobs import MPIImplementation
+
+        cluster, mgr = make_env()
+        job = self.job(name="mpi4")
+        job.mpi_implementation = MPIImplementation.INTEL
+        mgr.submit(job)
+        assert cluster.run_until(
+            lambda: len(pods_of(cluster, "mpi4", "Launcher")) == 1, timeout=60
+        )
+        env = pods_of(cluster, "mpi4", "Launcher")[0].spec.containers[0].env
+        assert env["I_MPI_HYDRA_HOST_FILE"] == "/etc/mpi/hostfile"

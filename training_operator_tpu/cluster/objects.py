@@ -204,6 +204,31 @@ class ConfigMap:
 
 
 @dataclass
+class HorizontalPodAutoscaler:
+    """HPA analogue driving elastic replica counts (reference pytorch/hpa.go:33
+    creates autoscaling/v2 HPAs for elastic PyTorchJobs)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    target_kind: str = ""
+    target_name: str = ""
+    min_replicas: int = 1
+    max_replicas: int = 1
+    metrics: List[Dict[str, Any]] = field(default_factory=list)
+    current_replicas: int = 0
+    desired_replicas: int = 0
+
+    KIND = "HorizontalPodAutoscaler"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
 class Event:
     """Lifecycle event (reference emits k8s Events for every action,
     e.g. common/pod.go:346,364)."""

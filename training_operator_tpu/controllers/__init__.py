@@ -7,6 +7,40 @@ on top of the shared JobController engine.
 """
 
 from training_operator_tpu.controllers.base import BaseController
+from training_operator_tpu.controllers.jax import JAXController
 from training_operator_tpu.controllers.manager import OperatorManager
+from training_operator_tpu.controllers.mpi import MPIController
+from training_operator_tpu.controllers.paddle import PaddleController
+from training_operator_tpu.controllers.pytorch import PyTorchController
+from training_operator_tpu.controllers.tensorflow import TensorFlowController
+from training_operator_tpu.controllers.xgboost import XGBoostController
 
-__all__ = ["BaseController", "OperatorManager"]
+ALL_CONTROLLERS = (
+    JAXController,
+    PyTorchController,
+    TensorFlowController,
+    XGBoostController,
+    PaddleController,
+    MPIController,
+)
+
+
+def register_all(manager: OperatorManager) -> None:
+    """Register every built-in job kind (the reference's
+    SupportedSchemeReconciler map, register_controller.go:36-57)."""
+    for ctrl_cls in ALL_CONTROLLERS:
+        manager.register(ctrl_cls(manager.api))
+
+
+__all__ = [
+    "ALL_CONTROLLERS",
+    "BaseController",
+    "JAXController",
+    "MPIController",
+    "OperatorManager",
+    "PaddleController",
+    "PyTorchController",
+    "TensorFlowController",
+    "XGBoostController",
+    "register_all",
+]

@@ -62,6 +62,12 @@ class BaseController:
     def reconcile_hook(self, job: Job) -> None:
         pass
 
+    def replica_order(self, job: Job):
+        return sorted(job.replica_specs)
+
+    def allow_pod_creation(self, job: Job, rtype: str, pods) -> bool:
+        return True
+
     # -- status semantics ---------------------------------------------------
 
     def leader_type(self, job: Job) -> str:

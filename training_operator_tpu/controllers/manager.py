@@ -84,6 +84,16 @@ class OperatorManager:
         """TTL garbage collection (reference CleanupJob)."""
         self.api.try_delete(job.kind, job.namespace, job.name)
 
+    # Kinds swept when their owning job is deleted — the substrate has no
+    # ownerReference cascade GC like Kubernetes, so the manager provides it.
+    OWNED_KINDS = ("Pod", "Service", "PodGroup", "ConfigMap", "HorizontalPodAutoscaler")
+
+    def _cascade_delete(self, job: Job) -> None:
+        for kind in self.OWNED_KINDS:
+            for obj in self.api.list(kind, job.namespace):
+                if obj.metadata.owner_uid == job.uid:
+                    self.api.try_delete(kind, obj.namespace, obj.name)
+
     # ------------------------------------------------------------------
 
     def tick(self) -> None:
@@ -109,6 +119,7 @@ class OperatorManager:
                     jc.expectations.delete_expectations(
                         gen_expectation_key(obj.key(), rtype, "services")
                     )
+                self._cascade_delete(obj)
             else:
                 self.queue.add(key)
         elif kind in ("Pod", "Service"):

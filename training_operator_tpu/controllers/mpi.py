@@ -1,0 +1,140 @@
+"""MPIJob controller: launcher/worker orchestration with hostfile generation.
+
+Parity target: reference pkg/controller.v1/mpi/mpijob_controller.go — the most
+divergent v1 controller:
+- newConfigMap (:1227): per-job ConfigMap with a `hostfile` listing
+  `<job>-worker-N slots=<slotsPerWorker>` lines.
+- updateDiscoverHostsInConfigMap (:1270): `discover_hosts.sh` regenerated from
+  *running* worker pods for elastic Horovod host discovery.
+- launcher env (:1085-1128): OpenMPI (OMPI_MCA_orte_default_hostfile +
+  rsh agent), Intel (I_MPI_HYDRA_HOST_FILE + bootstrap exec), MPICH
+  (HYDRA_HOST_FILE) variants.
+- workers are created first; the launcher is gated on all workers Running
+  (:391-403), replacing the reference's kubectl-delivery init container wait.
+- No Services: worker identity comes from the hostfile.
+
+TPU-native redesign: the reference's rsh-agent is `kubectl exec` smuggled in
+via a delivered kubectl binary and per-job RBAC (:1301-1393) — pure cluster
+hackery. Here the exec channel is a substrate primitive (`/etc/mpi/exec-agent`
+contract), so no ServiceAccount/Role machinery is needed; hostfile + env
+contracts are preserved so OpenMPI/Intel/MPICH user code runs unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from training_operator_tpu.api.jobs import (
+    Job,
+    MPIImplementation,
+    MPIJob,
+    ObjectMeta,
+    REPLICA_LAUNCHER,
+    REPLICA_WORKER,
+)
+from training_operator_tpu.cluster.objects import ConfigMap, Pod, PodPhase
+from training_operator_tpu.controllers.base import BaseController
+from training_operator_tpu.engine import core
+from training_operator_tpu.engine.core import gen_general_name
+
+CONFIG_SUFFIX = "-config"
+HOSTFILE_MOUNT = "/etc/mpi"
+
+
+class MPIController(BaseController):
+    kind = "MPIJob"
+    master_types = (REPLICA_LAUNCHER,)
+    leader_priority = (REPLICA_LAUNCHER,)
+    service_types = ()  # MPI uses no Services (reference mpi controller)
+
+    def replica_order(self, job: Job) -> Sequence[str]:
+        # Workers first; launcher gated on them running.
+        return [t for t in (REPLICA_WORKER, REPLICA_LAUNCHER) if t in job.replica_specs]
+
+    def allow_pod_creation(self, job: Job, rtype: str, pods) -> bool:
+        if rtype != REPLICA_LAUNCHER:
+            return True
+        worker_spec = job.replica_specs.get(REPLICA_WORKER)
+        expected = worker_spec.replicas or 0 if worker_spec else 0
+        running = sum(
+            1
+            for p in core.filter_pods_for_replica_type(pods, REPLICA_WORKER)
+            if p.status.phase == PodPhase.RUNNING
+        )
+        return running >= expected
+
+    def set_cluster_spec(self, job: Job, template, rtype: str, index: int) -> None:
+        assert isinstance(job, MPIJob)
+        if rtype != REPLICA_LAUNCHER:
+            return  # workers need no bootstrap env; hostfile names them
+        hostfile = f"{HOSTFILE_MOUNT}/hostfile"
+        impl = job.mpi_implementation
+        if impl == MPIImplementation.OPENMPI:
+            env = {
+                "OMPI_MCA_orte_default_hostfile": hostfile,
+                "OMPI_MCA_plm_rsh_agent": f"{HOSTFILE_MOUNT}/exec-agent",
+                "OMPI_MCA_orte_keep_fqdn_hostnames": "true",
+            }
+        elif impl == MPIImplementation.INTEL:
+            env = {
+                "I_MPI_HYDRA_HOST_FILE": hostfile,
+                "I_MPI_HYDRA_BOOTSTRAP_EXEC": f"{HOSTFILE_MOUNT}/exec-agent",
+                "I_MPI_HYDRA_BOOTSTRAP": "exec",
+            }
+        else:  # MPICH
+            env = {
+                "HYDRA_HOST_FILE": hostfile,
+                "HYDRA_LAUNCHER_EXEC": f"{HOSTFILE_MOUNT}/exec-agent",
+                "HYDRA_LAUNCHER": "exec",
+            }
+        for c in template.containers:
+            for k, v in env.items():
+                c.env.setdefault(k, v)
+
+    def reconcile_hook(self, job: Job) -> None:
+        """Maintain the hostfile/discover_hosts ConfigMap."""
+        assert isinstance(job, MPIJob)
+        worker_spec = job.replica_specs.get(REPLICA_WORKER)
+        n = worker_spec.replicas or 0 if worker_spec else 0
+        slots = job.slots_per_worker
+        hostfile_lines = [
+            f"{gen_general_name(job.name, REPLICA_WORKER, i)} slots={slots}" for i in range(n)
+        ]
+
+        from training_operator_tpu.api.common import JOB_NAME_LABEL
+
+        pods = [
+            p
+            for p in self.api.list("Pod", job.namespace, {JOB_NAME_LABEL: job.name})
+            if p.metadata.owner_uid in (None, job.uid)  # exclude foreign leftovers
+        ]
+        running = sorted(
+            p.name
+            for p in core.filter_pods_for_replica_type(pods, REPLICA_WORKER)
+            if p.status.phase == PodPhase.RUNNING
+        )
+        discover = "#!/bin/sh\n" + "\n".join(f"echo {name}" for name in running) + "\n"
+
+        data = {"hostfile": "\n".join(hostfile_lines) + "\n", "discover_hosts.sh": discover}
+        name = job.name + CONFIG_SUFFIX
+        existing = self.api.try_get("ConfigMap", job.namespace, name)
+        if existing is not None and existing.metadata.owner_uid != job.uid:
+            # Stale leftover from a dead same-named job: replace, don't adopt.
+            self.api.try_delete("ConfigMap", job.namespace, name)
+            existing = None
+        if existing is None:
+            self.api.create(
+                ConfigMap(
+                    metadata=ObjectMeta(name=name, namespace=job.namespace, owner_uid=job.uid),
+                    data=data,
+                )
+            )
+        elif existing.data != data:
+            existing.data = data
+            self.api.update(existing, check_version=False)
+
+    def job_running(self, job: Job, pods: Sequence[Pod]) -> bool:
+        """Launcher phase drives the job condition
+        (reference updateMPIJobStatus :414-491)."""
+        typed = core.filter_pods_for_replica_type(pods, REPLICA_LAUNCHER)
+        return any(p.status.phase == PodPhase.RUNNING for p in typed)

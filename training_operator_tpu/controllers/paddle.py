@@ -1,0 +1,54 @@
+"""PaddleJob controller: collective / PS-mode bootstrap.
+
+Parity target: reference pkg/controller.v1/paddlepaddle/envvar.go:25-145 —
+PYTHONUNBUFFERED, PADDLE_JOB_ID, PADDLE_NNODES (total replicas),
+PADDLE_MASTER rendezvous endpoint (collective mode: worker-0 service;
+PS mode: master-0 service), and PADDLE_SERVER_NUM / PADDLE_TRAINER_NUM in PS
+mode. The reference's POD_IP_DUMMY fieldRef hack for rank 0 is dropped: the
+headless service name resolves for self-addressing in this substrate.
+"""
+
+from __future__ import annotations
+
+from training_operator_tpu.api.jobs import Job, PaddleJob, REPLICA_MASTER, REPLICA_WORKER
+from training_operator_tpu.controllers.base import BaseController
+from training_operator_tpu.engine.core import gen_general_name
+
+
+class PaddleController(BaseController):
+    kind = "PaddleJob"
+    master_types = (REPLICA_MASTER,)
+    leader_priority = (REPLICA_MASTER, REPLICA_WORKER)
+
+    def _port(self, job: PaddleJob, rtype: str) -> int:
+        spec = job.replica_specs.get(rtype)
+        if spec is not None:
+            c = spec.template.main_container(self.default_container_name())
+            if c is not None and c.ports:
+                return next(iter(c.ports.values()))
+        return PaddleJob.DEFAULT_PORT
+
+    def set_cluster_spec(self, job: Job, template, rtype: str, index: int) -> None:
+        assert isinstance(job, PaddleJob)
+        total = job.total_replicas()
+        env = {
+            "PYTHONUNBUFFERED": "1",
+            "PADDLE_JOB_ID": job.name,
+            "PADDLE_NNODES": str(total),
+        }
+        ps_mode = job.replica_specs.get(REPLICA_MASTER) is not None
+        if ps_mode:
+            addr = gen_general_name(job.name, REPLICA_MASTER, 0)
+            port = self._port(job, REPLICA_MASTER)
+            env["PADDLE_MASTER"] = f"{addr}:{port}"
+            if rtype == REPLICA_MASTER:
+                env["PADDLE_SERVER_NUM"] = "1"
+            else:
+                env["PADDLE_TRAINER_NUM"] = "1"
+        else:
+            addr = gen_general_name(job.name, REPLICA_WORKER, 0)
+            port = self._port(job, REPLICA_WORKER)
+            env["PADDLE_MASTER"] = f"{addr}:{port}"
+        for c in template.containers:
+            for k, v in env.items():
+                c.env.setdefault(k, v)

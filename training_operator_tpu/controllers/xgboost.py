@@ -1,0 +1,53 @@
+"""XGBoostJob controller: Rabit tracker bootstrap.
+
+Parity target: reference pkg/controller.v1/xgboost/xgboost.go:30-110 —
+MASTER_ADDR (master-0 service) / MASTER_PORT, WORLD_SIZE = total replicas,
+RANK (workers offset by master replica count), PYTHONUNBUFFERED, and for
+multi-replica (LightGBM) jobs WORKER_PORT + WORKER_ADDRS (comma-joined worker
+service names).
+"""
+
+from __future__ import annotations
+
+from training_operator_tpu.api.jobs import Job, REPLICA_MASTER, REPLICA_WORKER, XGBoostJob
+from training_operator_tpu.controllers.base import BaseController
+from training_operator_tpu.engine.core import gen_general_name
+
+
+class XGBoostController(BaseController):
+    kind = "XGBoostJob"
+    master_types = (REPLICA_MASTER,)
+    leader_priority = (REPLICA_MASTER, REPLICA_WORKER)
+
+    def _port(self, job: XGBoostJob, rtype: str) -> int:
+        spec = job.replica_specs.get(rtype)
+        if spec is not None:
+            c = spec.template.main_container(self.default_container_name())
+            if c is not None and c.ports:
+                return next(iter(c.ports.values()))
+        return XGBoostJob.DEFAULT_PORT
+
+    def set_cluster_spec(self, job: Job, template, rtype: str, index: int) -> None:
+        assert isinstance(job, XGBoostJob)
+        total = job.total_replicas()
+        rank = index
+        if rtype == REPLICA_WORKER:
+            master = job.replica_specs.get(REPLICA_MASTER)
+            rank += master.replicas or 0 if master else 0
+        env = {
+            "MASTER_ADDR": gen_general_name(job.name, REPLICA_MASTER, 0),
+            "MASTER_PORT": str(self._port(job, REPLICA_MASTER)),
+            "WORLD_SIZE": str(total),
+            "RANK": str(rank),
+            "PYTHONUNBUFFERED": "1",
+        }
+        if total > 1:
+            worker = job.replica_specs.get(REPLICA_WORKER)
+            n_workers = worker.replicas or 0 if worker else 0
+            env["WORKER_PORT"] = str(self._port(job, REPLICA_WORKER))
+            env["WORKER_ADDRS"] = ",".join(
+                gen_general_name(job.name, REPLICA_WORKER, i) for i in range(n_workers)
+            )
+        for c in template.containers:
+            for k, v in env.items():
+                c.env.setdefault(k, v)

@@ -69,6 +69,14 @@ class ControllerInterface(Protocol):
     def reconcile_hook(self, job: Job) -> None:
         """Kind-specific extra work each pass (e.g. HPA for elastic torch)."""
 
+    def replica_order(self, job: Job) -> Sequence[str]:
+        """Order replica types are reconciled in (MPI: workers first)."""
+
+    def allow_pod_creation(self, job: Job, rtype: str, pods: Sequence[Pod]) -> bool:
+        """Gate *creation* of new pods for a replica type (MPI: launcher waits
+        for workers, reference mpijob_controller.go:391-403). Failed-pod
+        triage, duplicate cleanup, and scale-in always run regardless."""
+
 
 class JobController:
     """The generic engine; per-kind controllers delegate to it.
@@ -189,9 +197,12 @@ class JobController:
 
         # -- per-replica reconcile --------------------------------------
         if not delay_pods:
-            for rtype in sorted(job.replica_specs):
+            for rtype in self.controller.replica_order(job):
                 spec = job.replica_specs[rtype]
-                self.reconcile_pods(job, pods, rtype, spec)
+                self.reconcile_pods(
+                    job, pods, rtype, spec,
+                    allow_create=self.controller.allow_pod_creation(job, rtype, pods),
+                )
                 if self.controller.needs_service(job, rtype):
                     self.reconcile_services(job, services, rtype, spec)
 
@@ -214,7 +225,9 @@ class JobController:
     # Pod / service reconcile
     # ------------------------------------------------------------------
 
-    def reconcile_pods(self, job: Job, pods: Sequence[Pod], rtype: str, spec) -> None:
+    def reconcile_pods(
+        self, job: Job, pods: Sequence[Pod], rtype: str, spec, allow_create: bool = True
+    ) -> None:
         replicas = spec.replicas or 0
         typed = core.filter_pods_for_replica_type(pods, rtype)
         slices = core.get_pod_slices(typed, replicas)
@@ -233,7 +246,8 @@ class JobController:
                     self._delete_pod(exp_key, p, job)
                 continue
             if not bucket:
-                self._create_new_pod(job, rtype, spec, idx, exp_key)
+                if allow_create:
+                    self._create_new_pod(job, rtype, spec, idx, exp_key)
                 continue
 
             pod = bucket[0]
@@ -479,6 +493,8 @@ class JobController:
         job.status.last_reconcile_time = self.now()
         try:
             self.api.update(job, status_only=True)
+        except NotFoundError:
+            return  # job deleted mid-reconcile (e.g. TTL GC in this pass)
         except ConflictError:
             fresh = self.api.try_get(job.kind, job.namespace, job.name)
             if fresh is None:
