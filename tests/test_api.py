@@ -211,12 +211,18 @@ class TestConditions:
         update_job_conditions(st, JobConditionType.RUNNING, True, "JobRunning", "", now=3.0)
         assert not has_condition(st, JobConditionType.RESTARTING)
 
-    def test_duplicate_update_bumps_time_only(self):
+    def test_duplicate_update_is_noop(self):
+        """Identical updates leave the condition untouched (so unchanged
+        reconcile passes produce byte-identical status and skip the API
+        write); a changed message bumps lastUpdateTime but not transition."""
         st = JobStatus()
         update_job_conditions(st, JobConditionType.CREATED, True, "JobCreated", "", now=1.0)
         update_job_conditions(st, JobConditionType.CREATED, True, "JobCreated", "", now=5.0)
         assert len(st.conditions) == 1
-        assert st.conditions[0].last_update_time == 5.0
+        assert st.conditions[0].last_update_time == 1.0
+        assert st.conditions[0].last_transition_time == 1.0
+        update_job_conditions(st, JobConditionType.CREATED, True, "JobCreated", "new", now=9.0)
+        assert st.conditions[0].last_update_time == 9.0
         assert st.conditions[0].last_transition_time == 1.0
 
 

@@ -25,7 +25,6 @@ from training_operator_tpu.api.jobs import (
     TFJob,
     XGBoostJob,
 )
-from training_operator_tpu.cluster import Cluster
 from training_operator_tpu.cluster.inventory import make_cpu_pool
 from training_operator_tpu.cluster.runtime import (
     ANNOTATION_SIM_DURATION,

@@ -1,0 +1,272 @@
+"""Gang scheduler + tpu-packer tests.
+
+Covers: candidate enumeration (ICI sub-mesh validity), snapshot capacity
+accounting (reservations), baseline vs packer placement quality (contiguity,
+best-fit anti-fragmentation), multi-slice gangs, NVLink locality for GPU
+gangs, and the end-to-end gang path through the reconcile engine
+(PodGroup Pending -> Inqueue -> pods bound -> job Succeeded).
+"""
+
+import training_operator_tpu.api.common as capi
+from training_operator_tpu.api.common import Container, JobConditionType, PodTemplateSpec, ReplicaSpec
+from training_operator_tpu.api.jobs import JAXJob, ObjectMeta, PyTorchJob, TPUPolicy
+from training_operator_tpu.cluster.inventory import (
+    TPU_RESOURCE,
+    GPU_RESOURCE,
+    make_cpu_pool,
+    make_gpu_pool,
+    make_tpu_pool,
+)
+from training_operator_tpu.cluster.objects import PodGroupPhase, PodPhase
+from training_operator_tpu.cluster.runtime import (
+    ANNOTATION_SIM_DURATION,
+    Cluster,
+    DefaultScheduler,
+    SimKubelet,
+    VirtualClock,
+)
+from training_operator_tpu.controllers import OperatorManager, register_all
+from training_operator_tpu.scheduler import (
+    BaselinePlacer,
+    ClusterSnapshot,
+    GangScheduler,
+    TPUPacker,
+)
+from training_operator_tpu.scheduler.candidates import enumerate_candidates
+from training_operator_tpu.scheduler.snapshot import build_gang_request
+
+
+def tpu_tmpl(chips=4.0, cpu=1.0, **annotations):
+    t = PodTemplateSpec(
+        containers=[
+            Container(name="jax", image="trainer", resources={"cpu": cpu, TPU_RESOURCE: chips})
+        ]
+    )
+    t.annotations.update(annotations)
+    return t
+
+
+def make_jax_job(name, workers, topology, num_slices=1, accelerator=None, duration=None):
+    if accelerator is None:
+        chips = 1
+        for d in topology.split("x"):
+            chips *= int(d)
+        accelerator = f"v5e-{chips}"
+    ann = {ANNOTATION_SIM_DURATION: str(duration)} if duration else {}
+    return JAXJob(
+        metadata=ObjectMeta(name=name),
+        replica_specs={"Worker": ReplicaSpec(replicas=workers, template=tpu_tmpl(**ann))},
+        tpu_policy=TPUPolicy(accelerator=accelerator, topology=topology, num_slices=num_slices),
+    )
+
+
+def make_gang_env(placer, slices=2, topology="4x4", gpu_nodes=0):
+    cluster = Cluster(VirtualClock())
+    cluster.add_nodes(make_tpu_pool(slices, slice_topology=topology))
+    if gpu_nodes:
+        cluster.add_nodes(make_gpu_pool(gpu_nodes))
+    cluster.add_nodes(make_cpu_pool(2))
+    DefaultScheduler(cluster)
+    SimKubelet(cluster)
+    GangScheduler(cluster, placer)
+    mgr = OperatorManager(cluster, gang_enabled=True)
+    register_all(mgr)
+    return cluster, mgr
+
+
+class TestCandidates:
+    def test_full_slice(self):
+        cs = enumerate_candidates("4x4", 4, "4x4")
+        assert cs is not None and cs.num_candidates == 1
+        assert cs.masks[0] == (True, True, True, True)
+
+    def test_sub_mesh_2x4(self):
+        cs = enumerate_candidates("4x4", 4, "2x4")
+        # Host grid is 4x1: 2x4 chips = 2 adjacent host rows -> origins 0,1,2.
+        assert cs is not None and cs.num_candidates == 3
+        for mask in cs.masks:
+            hosts = [h for h, used in enumerate(mask) if used]
+            assert hosts[1] == hosts[0] + 1  # contiguity
+
+    def test_single_host(self):
+        cs = enumerate_candidates("4x4", 4, "1x4")
+        assert cs is not None and cs.num_candidates == 4
+
+    def test_permuted_request(self):
+        # 4x2 permutes to 2x4 which is host-feasible.
+        cs = enumerate_candidates("4x4", 4, "4x2")
+        assert cs is not None and cs.num_candidates == 3
+
+    def test_infeasible_not_host_aligned(self):
+        assert enumerate_candidates("4x4", 4, "2x2") is None
+
+    def test_8x8_slice_2x4_request(self):
+        # Host grid 8x2 (4-chip hosts on minor axis 8): 2x4 chips = 2x1 host
+        # blocks (the 4x2 orientation doesn't tile whole hosts) -> 7x2 origins.
+        cs = enumerate_candidates("8x8", 4, "2x4")
+        assert cs is not None
+        assert cs.num_candidates == 7 * 2
+
+
+class TestPackerPlacement:
+    def _snapshot_with_busy_hosts(self, cluster, busy):
+        from training_operator_tpu.cluster.objects import Pod
+
+        api = cluster.api
+        for i, node in enumerate(busy):
+            p = Pod(metadata=ObjectMeta(name=f"busy-{i}", namespace="default"))
+            p.spec.containers = [Container(name="c", resources={TPU_RESOURCE: 4.0})]
+            p.node_name = node
+            p.status.phase = PodPhase.RUNNING
+            api.create(p)
+        return ClusterSnapshot(api)
+
+    def test_contiguity_respected(self):
+        """Free-but-scattered hosts must NOT satisfy a 2x4 gang."""
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_tpu_pool(1, slice_topology="4x4"))
+        snap = self._snapshot_with_busy_hosts(
+            cluster, ["slice-0-host-1", "slice-0-host-3"]
+        )  # hosts 0,2 free but not adjacent
+        mgr = OperatorManager(cluster, gang_enabled=True)
+        register_all(mgr)
+        job = make_jax_job("frag", workers=2, topology="2x4")
+        mgr.submit(job)
+        for _ in range(3):
+            cluster.step()
+        pg = cluster.api.get("PodGroup", "default", "frag")
+        req = build_gang_request(cluster.api, pg)
+        placements = TPUPacker().place([req], snap)
+        assert placements[req.key] is None
+
+    def test_best_fit_prefers_tight_slice(self):
+        """Packer packs a 1-host gang into the fuller slice, keeping the empty
+        slice whole for future full-slice gangs (first-fit does not)."""
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_tpu_pool(2, slice_topology="4x4"))
+        snap = self._snapshot_with_busy_hosts(cluster, ["slice-0-host-0"])
+        mgr = OperatorManager(cluster, gang_enabled=True)
+        register_all(mgr)
+        job = make_jax_job("small", workers=1, topology="1x4")
+        mgr.submit(job)
+        for _ in range(3):
+            cluster.step()
+        pg = cluster.api.get("PodGroup", "default", "small")
+        req = build_gang_request(cluster.api, pg)
+        placement = TPUPacker().place([req], snap)[req.key]
+        assert placement is not None
+        (node,) = placement.assignments.values()
+        assert node.startswith("slice-0")  # the partially-used slice
+
+
+class TestGangEndToEnd:
+    def run_one(self, placer):
+        cluster, mgr = make_gang_env(placer, slices=2)
+        job = make_jax_job("train", workers=4, topology="4x4", duration=5)
+        mgr.submit(job)
+        done = cluster.run_until(
+            lambda: capi.is_succeeded(
+                cluster.api.get("JAXJob", "default", "train").status
+            ),
+            timeout=120,
+        )
+        assert done
+        return cluster
+
+    def test_packer_end_to_end(self):
+        cluster = self.run_one(TPUPacker())
+        # All four pods must have landed on one slice's four hosts.
+        pods = cluster.api.list("Pod", "default", {capi.JOB_NAME_LABEL: "train"})
+        slices = {p.node_name.rsplit("-host-", 1)[0] for p in pods}
+        assert len(slices) == 1
+
+    def test_baseline_end_to_end(self):
+        self.run_one(BaselinePlacer())
+
+    def test_multi_slice_gang(self):
+        cluster, mgr = make_gang_env(TPUPacker(), slices=3)
+        job = make_jax_job("multi", workers=8, topology="4x4", num_slices=2, duration=5)
+        mgr.submit(job)
+        assert cluster.run_until(
+            lambda: capi.is_succeeded(cluster.api.get("JAXJob", "default", "multi").status),
+            timeout=120,
+        )
+        pods = cluster.api.list("Pod", "default", {capi.JOB_NAME_LABEL: "multi"})
+        assert len(pods) == 8
+        slices = {p.node_name.rsplit("-host-", 1)[0] for p in pods}
+        assert len(slices) == 2  # distinct whole slices
+
+    def test_gang_all_or_nothing(self):
+        """A gang that cannot fit stays Pending with zero pods created."""
+        cluster, mgr = make_gang_env(TPUPacker(), slices=1)
+        big = make_jax_job("big", workers=8, topology="4x4", num_slices=2)
+        mgr.submit(big)
+        cluster.run_for(5)
+        assert cluster.api.list("Pod", "default", {capi.JOB_NAME_LABEL: "big"}) == []
+        pg = cluster.api.get("PodGroup", "default", "big")
+        assert pg.phase == PodGroupPhase.PENDING
+
+    def test_queued_gang_admitted_when_capacity_frees(self):
+        cluster, mgr = make_gang_env(TPUPacker(), slices=1)
+        first = make_jax_job("first", workers=4, topology="4x4", duration=10)
+        second = make_jax_job("second", workers=4, topology="4x4", duration=10)
+        mgr.submit(first)
+        mgr.submit(second)
+        assert cluster.run_until(
+            lambda: capi.is_succeeded(cluster.api.get("JAXJob", "default", "second").status),
+            timeout=300,
+        )
+        f = cluster.api.get("JAXJob", "default", "first")
+        s = cluster.api.get("JAXJob", "default", "second")
+        # second queued behind first on the single slice.
+        assert s.status.completion_time > f.status.completion_time
+
+    def test_gpu_gang_nvlink_locality(self):
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_gpu_pool(8, gpus_per_node=8, nodes_per_nvlink_domain=4))
+        DefaultScheduler(cluster)
+        SimKubelet(cluster)
+        GangScheduler(cluster, TPUPacker())
+        mgr = OperatorManager(cluster, gang_enabled=True)
+        register_all(mgr)
+        t = PodTemplateSpec(
+            containers=[
+                Container(name="pytorch", image="trainer", resources={"cpu": 1.0, GPU_RESOURCE: 8.0})
+            ]
+        )
+        t.annotations[ANNOTATION_SIM_DURATION] = "5"
+        job = PyTorchJob(
+            metadata=ObjectMeta(name="ddp"),
+            replica_specs={"Worker": ReplicaSpec(replicas=4, template=t)},
+        )
+        mgr.submit(job)
+        assert cluster.run_until(
+            lambda: capi.is_succeeded(cluster.api.get("PyTorchJob", "default", "ddp").status),
+            timeout=120,
+        )
+        pods = cluster.api.list("Pod", "default", {capi.JOB_NAME_LABEL: "ddp"})
+        domains = {
+            cluster.api.get("Node", "", p.node_name).accelerator.nvlink_domain
+            for p in pods
+        }
+        assert len(domains) == 1  # all four 8-GPU nodes in one NVLink domain
+
+
+class TestSnapshotAccounting:
+    def test_admitted_reservation_blocks_double_placement(self):
+        """Two gangs solved in different cycles must not share hosts even
+        before the first gang's pods exist."""
+        cluster, mgr = make_gang_env(TPUPacker(), slices=1)
+        a = make_jax_job("ja", workers=4, topology="4x4")
+        mgr.submit(a)
+        for _ in range(4):
+            cluster.step()
+        pg_a = cluster.api.get("PodGroup", "default", "ja")
+        assert pg_a.phase == PodGroupPhase.INQUEUE
+        # Before any pod of A binds, solve B: must find nothing.
+        b = make_jax_job("jb", workers=4, topology="4x4")
+        mgr.submit(b)
+        for _ in range(4):
+            cluster.step()
+        pg_b = cluster.api.get("PodGroup", "default", "jb")
+        assert pg_b.phase == PodGroupPhase.PENDING

@@ -48,6 +48,20 @@ class BaseController:
 
         return DEFAULT_CONTAINER_NAME.get(self.kind, "trainer")
 
+    def _port(self, job: Job, rtype: str) -> int:
+        """Rendezvous port: first declared port of the replica's main
+        container, else the kind's default (reference GetPortFromPyTorchJob
+        and the per-framework twins)."""
+        spec = job.replica_specs.get(rtype)
+        if spec is not None:
+            c = spec.template.main_container(self.default_container_name())
+            if c is not None and c.ports:
+                return next(iter(c.ports.values()))
+        return self._default_port(job)
+
+    def _default_port(self, job: Job) -> int:
+        return getattr(type(job), "DEFAULT_PORT", 0)
+
     def is_master_role(self, job: Job, rtype: str, index: int) -> bool:
         return rtype in self.master_types
 

@@ -31,15 +31,14 @@ class JAXController(BaseController):
     def is_master_role(self, job: Job, rtype: str, index: int) -> bool:
         return rtype == REPLICA_WORKER and index == 0
 
+    def _default_port(self, job: Job) -> int:
+        assert isinstance(job, JAXJob)
+        return job.coordinator_port  # per-job knob, unlike the other kinds
+
     def set_cluster_spec(self, job: Job, template, rtype: str, index: int) -> None:
         assert isinstance(job, JAXJob)
         coordinator_addr = gen_general_name(job.name, REPLICA_WORKER, 0)
-        port = job.coordinator_port
-        worker_spec = job.replica_specs.get(REPLICA_WORKER)
-        if worker_spec is not None:
-            c = worker_spec.template.main_container(self.default_container_name())
-            if c is not None and c.ports:
-                port = next(iter(c.ports.values()))
+        port = self._port(job, REPLICA_WORKER)
         total = job.total_replicas()
         env = {
             "PYTHONUNBUFFERED": "1",

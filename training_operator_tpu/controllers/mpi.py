@@ -56,12 +56,18 @@ class MPIController(BaseController):
             return True
         worker_spec = job.replica_specs.get(REPLICA_WORKER)
         expected = worker_spec.replicas or 0 if worker_spec else 0
-        running = sum(
+        # Gate on every worker having *started* (any phase past Pending).
+        # Gating on Running would deadlock the job if a worker finished or
+        # failed before the launcher-creation pass: the count could never
+        # reach `expected` again and no terminal condition would ever fire
+        # (the reference creates the launcher unconditionally and lets
+        # mpirun fail, mpijob_controller.go:395 — same effect here).
+        started = sum(
             1
             for p in core.filter_pods_for_replica_type(pods, REPLICA_WORKER)
-            if p.status.phase == PodPhase.RUNNING
+            if p.status.phase != PodPhase.PENDING
         )
-        return running >= expected
+        return started >= expected
 
     def set_cluster_spec(self, job: Job, template, rtype: str, index: int) -> None:
         assert isinstance(job, MPIJob)

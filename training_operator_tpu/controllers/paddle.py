@@ -20,13 +20,6 @@ class PaddleController(BaseController):
     master_types = (REPLICA_MASTER,)
     leader_priority = (REPLICA_MASTER, REPLICA_WORKER)
 
-    def _port(self, job: PaddleJob, rtype: str) -> int:
-        spec = job.replica_specs.get(rtype)
-        if spec is not None:
-            c = spec.template.main_container(self.default_container_name())
-            if c is not None and c.ports:
-                return next(iter(c.ports.values()))
-        return PaddleJob.DEFAULT_PORT
 
     def set_cluster_spec(self, job: Job, template, rtype: str, index: int) -> None:
         assert isinstance(job, PaddleJob)

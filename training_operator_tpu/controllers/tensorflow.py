@@ -30,13 +30,6 @@ class TensorFlowController(BaseController):
     master_types = ("Chief", "Master")
     leader_priority = ("Chief", "Master", "Worker")
 
-    def _port(self, job: TFJob, rtype: str) -> int:
-        spec = job.replica_specs.get(rtype)
-        if spec is not None:
-            c = spec.template.main_container(self.default_container_name())
-            if c is not None and c.ports:
-                return next(iter(c.ports.values()))
-        return TFJob.DEFAULT_PORT
 
     def _cluster_spec(self, job: TFJob):
         """reference genClusterSpec (tensorflow.go:157-188)."""

@@ -16,6 +16,7 @@ Semantics preserved:
 
 from __future__ import annotations
 
+import copy
 import logging
 from typing import Callable, Dict, List, Optional, Protocol, Sequence
 
@@ -123,6 +124,10 @@ class JobController:
         now = self.now()
         pods = self.get_pods_for_job(job)
         services = self.get_services_for_job(job)
+        # Status is written back only if this pass changed it (reference
+        # common/job.go:360 "UpdateJobStatusInApiServer iff changed") —
+        # unconditional writes would re-trigger watches forever.
+        prev_status = copy.deepcopy(job.status)
 
         if not job.status.conditions:
             update_job_conditions(
@@ -134,7 +139,7 @@ class JobController:
         # -- finished: cleanup + TTL ------------------------------------
         if capi.is_finished(job.status):
             self._cleanup_finished(job, pods, services, now)
-            self._write_status(job)
+            self._write_status(job, prev_status)
             return
 
         # -- suspend / resume -------------------------------------------
@@ -147,7 +152,7 @@ class JobController:
                 job.status, JobConditionType.SUSPENDED, True, "JobSuspended",
                 f"{job.kind} {name} is suspended.", now=now,
             )
-            self._write_status(job)
+            self._write_status(job, prev_status)
             return
         if capi.is_suspended(job.status):
             # Resumed: reset StartTime (reference common/job.go:146-173).
@@ -180,7 +185,7 @@ class JobController:
             )
             metrics.jobs_failed.inc(namespace, job.kind, failure_reason)
             self._event(job, "Warning", failure_reason, failure_msg)
-            self._write_status(job)
+            self._write_status(job, prev_status)
             return
 
         # -- gang scheduling: sync PodGroup, maybe delay pods -----------
@@ -219,7 +224,7 @@ class JobController:
             self._cleanup_finished(
                 job, self.get_pods_for_job(job), self.get_services_for_job(job), now
             )
-        self._write_status(job)
+        self._write_status(job, prev_status)
 
     # ------------------------------------------------------------------
     # Pod / service reconcile
@@ -487,9 +492,12 @@ class JobController:
         for s in services:
             self._delete_service(s, job)
 
-    def _write_status(self, job: Job) -> None:
-        """Optimistic-concurrency status write with one re-get retry
-        (reference UpdateJobStatusInApiServer)."""
+    def _write_status(self, job: Job, prev_status: Optional[capi.JobStatus] = None) -> None:
+        """Optimistic-concurrency status write with one re-get retry,
+        skipped when the pass didn't change anything
+        (reference UpdateJobStatusInApiServer, common/job.go:360)."""
+        if prev_status is not None and prev_status == job.status:
+            return
         job.status.last_reconcile_time = self.now()
         try:
             self.api.update(job, status_only=True)

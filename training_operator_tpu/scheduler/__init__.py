@@ -1,0 +1,26 @@
+"""Gang scheduling + the tpu-packer placement engine.
+
+This package fills the seam the reference delegates to Volcano /
+scheduler-plugins (control/podgroup_control.go:36-199, common/job.go:250-335):
+PodGroups are admitted all-or-nothing and their pods bound to nodes. Two
+placers sit behind one interface:
+
+- `BaselinePlacer` — volcano-style FIFO first-fit gang admission (the
+  BASELINE.md comparison target).
+- `TPUPacker` — the north-star JAX placement engine: batches every pending
+  PodGroup into one tensor solve that scores ICI-mesh contiguity and
+  fragmentation on device.
+"""
+
+from training_operator_tpu.scheduler.baseline import BaselinePlacer
+from training_operator_tpu.scheduler.gang import GangScheduler
+from training_operator_tpu.scheduler.packer import TPUPacker
+from training_operator_tpu.scheduler.snapshot import ClusterSnapshot, GangRequest
+
+__all__ = [
+    "BaselinePlacer",
+    "ClusterSnapshot",
+    "GangRequest",
+    "GangScheduler",
+    "TPUPacker",
+]

@@ -1,0 +1,119 @@
+"""BaselinePlacer: volcano-style FIFO first-fit gang admission.
+
+This is the comparison target from BASELINE.md (configs 2 & 5): what you get
+today by pointing the reference at Volcano with slice-type node selectors.
+Per pending group, in creation order, it takes the FIRST feasible placement —
+contiguity-feasible for TPU gangs (so placements are always valid meshes) but
+with no scoring: no best-fit, no fragmentation awareness, no batching. Partial
+gangs land on whichever slice is first in iteration order, which is exactly
+the behavior that strands full slices and inflates p50 for later big jobs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from training_operator_tpu.cluster.inventory import TPU_RESOURCE
+from training_operator_tpu.scheduler.candidates import CandidateCache
+from training_operator_tpu.scheduler.snapshot import (
+    ClusterSnapshot,
+    GangRequest,
+    Placement,
+    request_hosts_per_slice,
+)
+
+
+class BaselinePlacer:
+    name = "baseline-firstfit"
+
+    def __init__(self) -> None:
+        self.candidates = CandidateCache()
+
+    def place(
+        self, requests: List[GangRequest], snapshot: ClusterSnapshot
+    ) -> Dict[str, Optional[Placement]]:
+        out: Dict[str, Optional[Placement]] = {}
+        ordered = sorted(
+            requests, key=lambda r: r.group.metadata.creation_time or 0.0
+        )
+        for req in ordered:
+            if req.is_tpu():
+                out[req.key] = self._place_tpu(req, snapshot)
+            else:
+                out[req.key] = self._place_generic(req, snapshot)
+        return out
+
+    # -- TPU gangs ---------------------------------------------------------
+
+    def _place_tpu(
+        self, req: GangRequest, snapshot: ClusterSnapshot
+    ) -> Optional[Placement]:
+        assignments: Dict[str, str] = {}
+        slices_used: List[str] = []
+        committed: List[tuple] = []
+        pods = sorted(req.pods, key=lambda p: (p.replica_type, p.index))
+        pods_per_slice = len(pods) // req.num_slices if req.num_slices else 0
+        if pods_per_slice * req.num_slices != len(pods):
+            return None
+        cursor = 0
+        for _ in range(req.num_slices):
+            found = False
+            for sl in snapshot.slices.values():
+                if req.tpu_type and sl.tpu_type != req.tpu_type:
+                    continue
+                need = request_hosts_per_slice(req, sl.chips_per_host)
+                if need <= 0 or need != pods_per_slice:
+                    continue
+                cset = self.candidates.get(sl.topology, sl.chips_per_host, req.topology)
+                if cset is None or cset.hosts_per_slice != sl.num_hosts:
+                    continue
+                for mask in cset.masks:  # first feasible candidate wins
+                    hosts = [sl.host_nodes[h] for h, used in enumerate(mask) if used]
+                    if all(
+                        snapshot.host_free(n, sl.chips_per_host) for n in hosts
+                    ):
+                        for pod, node in zip(pods[cursor : cursor + need], hosts):
+                            assignments[pod.name] = node
+                            snapshot.commit(pod.resources, node)
+                            committed.append((pod.resources, node))
+                        slices_used.append(sl.slice_id)
+                        cursor += need
+                        found = True
+                        break
+                if found:
+                    break
+            if not found:
+                self._rollback(snapshot, committed)
+                return None
+        return Placement(assignments=assignments, slices_used=slices_used)
+
+    # -- generic gangs (GPU/CPU) -------------------------------------------
+
+    def _place_generic(
+        self, req: GangRequest, snapshot: ClusterSnapshot
+    ) -> Optional[Placement]:
+        assignments: Dict[str, str] = {}
+        committed: List[tuple] = []
+        node_names = [
+            n for n in snapshot.free
+            if snapshot.nodes[n].accelerator.kind != "tpu"
+        ] or list(snapshot.free)
+        for pod in sorted(req.pods, key=lambda p: (p.replica_type, p.index)):
+            placed = False
+            for name in node_names:  # first fit
+                if snapshot.fits(name, pod.resources):
+                    assignments[pod.name] = name
+                    snapshot.commit(pod.resources, name)
+                    committed.append((pod.resources, name))
+                    placed = True
+                    break
+            if not placed:
+                self._rollback(snapshot, committed)
+                return None
+        return Placement(assignments=assignments)
+
+    @staticmethod
+    def _rollback(snapshot: ClusterSnapshot, committed: List[tuple]) -> None:
+        for res, node in committed:
+            for k, v in res.items():
+                snapshot.free[node][k] = snapshot.free[node].get(k, 0.0) + v

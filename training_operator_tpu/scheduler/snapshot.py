@@ -1,0 +1,257 @@
+"""Cluster snapshot: the (jobs x nodes x devices) view both placers solve over.
+
+The reference's gang path hands Volcano an opaque PodGroup and lets the
+external scheduler see the cluster through the API server. Here the batched
+solve needs an explicit immutable snapshot: free capacity per node (bound pods
+AND admitted-but-not-yet-bound placements both count), the physical TPU slice
+structure, and the pending gangs expanded to per-pod resource requests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from training_operator_tpu.api.jobs import Job
+from training_operator_tpu.cluster.apiserver import APIServer
+from training_operator_tpu.cluster.inventory import TPU_RESOURCE, parse_topology
+from training_operator_tpu.cluster.objects import Node, PodGroup, PodGroupPhase
+from training_operator_tpu.engine.core import gen_general_name
+
+
+@dataclass
+class SliceInfo:
+    """One physical TPU slice: its geometry and member hosts in host-index
+    order (host i owns the i-th contiguous chip block of the slice grid)."""
+
+    slice_id: str
+    tpu_type: str
+    topology: str  # chip grid, e.g. "4x4"
+    chips_per_host: int
+    host_nodes: List[str]  # node names ordered by host index
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.host_nodes)
+
+    def geometry_class(self) -> Tuple[str, str, int]:
+        """Slices with equal geometry share candidate enumerations."""
+        return (self.tpu_type, self.topology, self.chips_per_host)
+
+
+@dataclass
+class PodRequest:
+    name: str
+    replica_type: str
+    index: int
+    resources: Dict[str, float]
+
+
+@dataclass
+class GangRequest:
+    """A pending PodGroup expanded to the granularity the solver needs."""
+
+    group: PodGroup
+    pods: List[PodRequest]
+    # TPU gang: requested ICI topology per slice + slice count; None = generic.
+    topology: Optional[str] = None
+    num_slices: int = 1
+    tpu_type: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"{self.group.namespace}/{self.group.name}"
+
+    def total_chips(self) -> float:
+        return sum(p.resources.get(TPU_RESOURCE, 0.0) for p in self.pods)
+
+    def is_tpu(self) -> bool:
+        return self.topology is not None
+
+
+@dataclass
+class Placement:
+    """Solver output for one gang: pod name -> node name, plus the score the
+    solver assigned (higher = more contiguous / less fragmenting)."""
+
+    assignments: Dict[str, str]
+    score: float = 0.0
+    slices_used: List[str] = field(default_factory=list)
+
+
+class ClusterSnapshot:
+    """Immutable free-capacity view at solve time.
+
+    Free capacity subtracts (a) resources of bound, non-terminal pods and
+    (b) reservations of admitted PodGroups whose placed pods do not yet exist
+    or are not yet bound — without (b) two scheduling cycles could hand the
+    same hosts to two gangs (the same race the reference's expectations cache
+    guards on the pod-creation side).
+    """
+
+    def __init__(self, api: APIServer):
+        self.api = api
+        self.nodes: Dict[str, Node] = {n.name: n for n in api.list("Node")}
+        self.free: Dict[str, Dict[str, float]] = {
+            name: dict(n.capacity)
+            for name, n in self.nodes.items()
+            if not n.unschedulable
+        }
+        self._subtract_bound_pods()
+        self._subtract_admitted_reservations()
+        self.slices = self._build_slices()
+
+    # -- construction ------------------------------------------------------
+
+    def _subtract_bound_pods(self) -> None:
+        for pod in self.api.list("Pod"):
+            if not pod.node_name or pod.is_terminal():
+                continue
+            avail = self.free.get(pod.node_name)
+            if avail is None:
+                continue
+            for k, v in pod.resources().items():
+                avail[k] = avail.get(k, 0.0) - v
+
+    def _subtract_admitted_reservations(self) -> None:
+        bound = {
+            (p.namespace, p.name)
+            for p in self.api.list("Pod")
+            if p.node_name and not p.is_terminal()
+        }
+        for pg in self.api.list("PodGroup"):
+            if pg.phase not in (PodGroupPhase.INQUEUE, PodGroupPhase.RUNNING):
+                continue
+            if not pg.placement:
+                continue
+            job = resolve_owner_job(self.api, pg)
+            per_pod = job_pod_requests(job) if job is not None else {}
+            for pod_name, node_name in pg.placement.items():
+                if (pg.namespace, pod_name) in bound:
+                    continue  # already accounted as a bound pod
+                avail = self.free.get(node_name)
+                if avail is None:
+                    continue
+                for k, v in per_pod.get(pod_name, {}).items():
+                    avail[k] = avail.get(k, 0.0) - v
+
+    def _build_slices(self) -> Dict[str, SliceInfo]:
+        by_slice: Dict[str, List[Node]] = {}
+        for node in self.nodes.values():
+            acc = node.accelerator
+            if acc.kind == "tpu" and acc.tpu_slice:
+                by_slice.setdefault(acc.tpu_slice, []).append(node)
+        slices: Dict[str, SliceInfo] = {}
+        for sid, members in by_slice.items():
+            members.sort(key=lambda n: _host_index(n))
+            first = members[0].accelerator
+            slices[sid] = SliceInfo(
+                slice_id=sid,
+                tpu_type=first.tpu_type,
+                topology=first.slice_topology,
+                chips_per_host=first.chips,
+                host_nodes=[n.name for n in members],
+            )
+        return slices
+
+    # -- queries -----------------------------------------------------------
+
+    def host_free(self, node_name: str, chips: float) -> bool:
+        """A TPU host is usable by a gang only if its full chip block is free
+        (gang pods own whole hosts; fractional-host TPU pods are not a thing
+        on multi-host slices)."""
+        avail = self.free.get(node_name)
+        return avail is not None and avail.get(TPU_RESOURCE, 0.0) >= chips
+
+    def fits(self, node_name: str, req: Dict[str, float]) -> bool:
+        avail = self.free.get(node_name)
+        if avail is None:
+            return False
+        return all(avail.get(k, 0.0) >= v for k, v in req.items())
+
+    def commit(self, req: Dict[str, float], node_name: str) -> None:
+        """Consume capacity inside a solve so later gangs in the same batch
+        see it taken."""
+        avail = self.free.get(node_name)
+        if avail is None:
+            return
+        for k, v in req.items():
+            avail[k] = avail.get(k, 0.0) - v
+
+
+def _host_index(node: Node) -> int:
+    from training_operator_tpu.cluster.inventory import LABEL_TPU_HOST_INDEX
+
+    try:
+        return int(node.metadata.labels.get(LABEL_TPU_HOST_INDEX, "0"))
+    except ValueError:
+        return 0
+
+
+def resolve_owner_job(api: APIServer, pg: PodGroup) -> Optional[Job]:
+    """PodGroups are named after and owned by their job; `job-kind` label says
+    which kind to fetch (set by PodGroupControl.create_podgroup)."""
+    kind = pg.metadata.labels.get("job-kind")
+    if not kind:
+        return None
+    return api.try_get(kind, pg.namespace, pg.name)
+
+
+def job_pod_requests(job: Job) -> Dict[str, Dict[str, float]]:
+    """Per-pod resource requests keyed by the pod name the engine will use."""
+    out: Dict[str, Dict[str, float]] = {}
+    for rtype, spec in job.replica_specs.items():
+        per_pod = spec.template.resources()
+        for i in range(spec.replicas or 0):
+            out[gen_general_name(job.name, rtype, i)] = dict(per_pod)
+    return out
+
+
+def build_gang_request(api: APIServer, pg: PodGroup) -> Optional[GangRequest]:
+    """Expand a PodGroup to a GangRequest. Returns None if the owning job is
+    gone (group will be GC'd by the cascade delete)."""
+    job = resolve_owner_job(api, pg)
+    if job is None:
+        return None
+    pods: List[PodRequest] = []
+    for rtype, spec in sorted(job.replica_specs.items()):
+        per_pod = spec.template.resources()
+        for i in range(spec.replicas or 0):
+            pods.append(
+                PodRequest(
+                    name=gen_general_name(job.name, rtype, i),
+                    replica_type=rtype,
+                    index=i,
+                    resources=dict(per_pod),
+                )
+            )
+    topology = pg.topology_request
+    tpu_type = ""
+    if job.tpu_policy is not None:
+        tpu_type = _accel_family(job.tpu_policy.accelerator)
+        if topology is None:
+            topology = job.tpu_policy.topology
+    return GangRequest(
+        group=pg,
+        pods=pods,
+        topology=topology,
+        num_slices=max(1, pg.num_slices),
+        tpu_type=tpu_type,
+    )
+
+
+def _accel_family(accelerator: str) -> str:
+    """"v5e-8" -> "v5e"."""
+    return accelerator.rsplit("-", 1)[0] if "-" in accelerator else accelerator
+
+
+def request_hosts_per_slice(req: GangRequest, chips_per_host: int) -> int:
+    """How many whole hosts one slice's share of the gang occupies."""
+    if req.topology is None:
+        return 0
+    chips = 1
+    for d in parse_topology(req.topology):
+        chips *= d
+    if chips % chips_per_host:
+        return -1  # request not host-aligned for this slice class
+    return chips // chips_per_host

@@ -1,0 +1,27 @@
+"""The trainer runtime: the TPU compute path jobs scheduled by the operator run.
+
+The reference ships trainer images (sdk/python/kubeflow/trainer/
+hf_llm_training.py — torchrun + transformers.Trainer) that consume the env the
+operator injects. This package is the TPU-native counterpart: it consumes the
+JAXJob bootstrap env (COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID +
+TPU_MESH_AXES) and runs SPMD training over a `jax.sharding.Mesh` with
+data / fsdp / tensor / sequence axes — ring attention for long context,
+jit-compiled train steps, orbax checkpointing.
+"""
+
+from training_operator_tpu.trainer.mesh import MeshSpec, build_mesh, mesh_from_env
+from training_operator_tpu.trainer.model import TransformerConfig, init_params, forward, loss_fn
+from training_operator_tpu.trainer.train import TrainState, make_train_step, train_state_shardings
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "mesh_from_env",
+    "TransformerConfig",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "TrainState",
+    "make_train_step",
+    "train_state_shardings",
+]

@@ -1,0 +1,105 @@
+"""Mesh construction: TPUPolicy.mesh_axes -> jax.sharding.Mesh.
+
+The operator exports the requested logical mesh as TPU_MESH_AXES (see
+controllers/jax.py); the trainer builds the physical mesh here. Axis order is
+fixed so collectives ride the right links: `data` and `fsdp` outermost (their
+all-reduces are the biggest but least frequent), `tensor` innermost (its
+all-gathers/reduce-scatters happen per-layer and must ride the fastest ICI
+hops), `sequence` between (ring attention's ppermute is neighbor-only, so any
+contiguous placement works).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_ORDER = ("data", "fsdp", "sequence", "tensor")
+
+# Batch dims shard over both data-parallel axes; fsdp additionally shards
+# parameters. This is the standard 2D data/weight sharding layout.
+BATCH_AXES = ("data", "fsdp")
+
+
+@dataclass
+class MeshSpec:
+    """Logical mesh request: axis name -> size, in AXIS_ORDER."""
+
+    axes: Dict[str, int] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        for name in self.axes:
+            if name not in AXIS_ORDER:
+                raise ValueError(f"unknown mesh axis {name!r}; valid: {AXIS_ORDER}")
+
+    def size(self) -> int:
+        n = 1
+        for v in self.axes.values():
+            n *= v
+        return n
+
+    def dims(self) -> Tuple[Tuple[str, int], ...]:
+        return tuple((a, self.axes.get(a, 1)) for a in AXIS_ORDER)
+
+    @classmethod
+    def from_string(cls, s: str) -> "MeshSpec":
+        """Parse "data=2,fsdp=2,tensor=2" (the TPU_MESH_AXES wire format)."""
+        axes: Dict[str, int] = {}
+        for part in s.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            k, _, v = part.partition("=")
+            axes[k.strip()] = int(v)
+        return cls(axes)
+
+    @classmethod
+    def for_devices(cls, n: int) -> "MeshSpec":
+        """Default factorization when the job didn't pin axes: fsdp-major
+        (weight sharding scales memory), with a tensor axis once the node
+        count allows it."""
+        if n <= 1:
+            return cls({})
+        tensor = 1
+        while n % 2 == 0 and tensor < 4 and n > 2:
+            tensor *= 2
+            n //= 2
+        return cls({"fsdp": n, "tensor": tensor})
+
+
+def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    need = spec.size()
+    if need > len(devices):
+        raise ValueError(f"mesh needs {need} devices, have {len(devices)}")
+    names = [a for a, _ in spec.dims()]
+    sizes = [s for _, s in spec.dims()]
+    arr = np.array(devices[:need]).reshape(sizes)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+def mesh_from_env(devices: Optional[Sequence] = None) -> Mesh:
+    """Build the mesh a scheduled JAXJob should use, from the env the
+    operator injected (TPU_MESH_AXES), falling back to a sensible
+    factorization of the visible device count."""
+    s = os.environ.get("TPU_MESH_AXES", "")
+    if s:
+        spec = MeshSpec.from_string(s)
+    else:
+        n = len(devices) if devices is not None else len(jax.devices())
+        spec = MeshSpec.for_devices(n)
+    return build_mesh(spec, devices)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Input batches: [batch, seq] sharded over (data x fsdp, sequence)."""
+    return NamedSharding(mesh, P(BATCH_AXES, "sequence"))
+
+
+def axis_size(mesh: Mesh, name: str) -> int:
+    return mesh.shape.get(name, 1)

@@ -61,6 +61,34 @@ class Gauge(Counter):
         return lines
 
 
+class Histogram:
+    """Summary-style observation metric (count/sum/min/max) — enough for the
+    scheduler-latency surface without bucket bookkeeping."""
+
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self.count = 0
+        self.sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.sum += value
+
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def render(self) -> List[str]:
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} summary",
+            f"{self.name}_count {self.count}",
+            f"{self.name}_sum {self.sum}",
+        ]
+
+
 class MetricsRegistry:
     def __init__(self) -> None:
         self._metrics: Dict[str, Counter] = {}
@@ -73,6 +101,11 @@ class MetricsRegistry:
     def gauge(self, name: str, help_text: str = "", labels: Tuple[str, ...] = ()) -> Gauge:
         if name not in self._metrics:
             self._metrics[name] = Gauge(name, help_text, labels)
+        return self._metrics[name]
+
+    def histogram(self, name: str, help_text: str = "") -> Histogram:
+        if name not in self._metrics:
+            self._metrics[name] = Histogram(name, help_text)
         return self._metrics[name]
 
     def render(self) -> str:
@@ -130,4 +163,16 @@ created_podgroups = registry.counter(
 )
 deleted_podgroups = registry.counter(
     "training_operator_deleted_podgroups_total", "The number of deleted podgroups", ()
+)
+podgroups_admitted = registry.counter(
+    "training_operator_podgroups_admitted_total",
+    "The number of podgroups admitted by the gang scheduler", (),
+)
+pods_bound = registry.counter(
+    "training_operator_pods_bound_total",
+    "The number of pods bound by the gang scheduler", (),
+)
+scheduler_solve_seconds = registry.histogram(
+    "training_operator_scheduler_solve_seconds",
+    "Wall time of gang-scheduler placement solves",
 )
