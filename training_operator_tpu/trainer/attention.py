@@ -21,7 +21,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from training_operator_tpu.trainer.mesh import BATCH_AXES, axis_size
 
@@ -94,6 +94,12 @@ def ring_attention(
     """Sequence-parallel attention over the mesh's `sequence` axis."""
     ns = axis_size(mesh, "sequence")
     spec = P(BATCH_AXES, "sequence", "tensor", None)
+    if not isinstance(q, jax.core.Tracer):
+        # Eager call: pin inputs onto the mesh first. shard_map over a mesh
+        # on one platform silently mis-reads buffers resident on another
+        # (observed: TPU-resident inputs into a CPU mesh).
+        sharding = NamedSharding(mesh, spec)
+        q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
     local = functools.partial(
         _ring_attention_local, seq_axis="sequence", num_shards=ns, causal=causal
     )

@@ -1,0 +1,211 @@
+"""Flagship model: a decoder-only transformer LM as pure JAX pytrees.
+
+Plays the role of the reference's HF trainer payload (sdk/python/kubeflow/
+trainer/hf_llm_training.py loads a torch model under torchrun); here the
+model is written TPU-first:
+
+- params are flat pytrees with per-layer tensors STACKED on a leading [L]
+  axis so the decoder runs as one `lax.scan` — one compiled layer body
+  regardless of depth (fast compiles, constant program size);
+- every weight carries a `PartitionSpec` (megatron-style tensor parallel +
+  fsdp sharding of the complementary dim), so `jit` + sharding constraints
+  place all collectives;
+- compute in bfloat16, params + softmax/logits in float32 (MXU-friendly);
+- each scan step is wrapped in `jax.checkpoint` (rematerialization) to trade
+  FLOPs for HBM.
+
+Architecture: pre-RMSNorm, rotary embeddings, GQA-capable attention
+(ring attention when the mesh shards the sequence axis), SwiGLU MLP.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from training_operator_tpu.trainer.attention import attention
+from training_operator_tpu.trainer.mesh import BATCH_AXES
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    d_ff: int = 1408
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def validate(self) -> None:
+        assert self.d_model % self.n_heads == 0
+        assert self.n_heads % self.n_kv_heads == 0
+
+
+def param_specs(config: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpecs per parameter. Megatron TP: QKV/W1/W3 column-parallel
+    (output dim on `tensor`), WO/W2 row-parallel (input dim on `tensor`);
+    `fsdp` shards the complementary dimension. Layer-stacked tensors lead
+    with an unsharded [L] axis. Vocab is tensor-column-parallel in the head
+    (sharded logits feed a sharded-softmax loss)."""
+    return {
+        "embed": P(None, ("fsdp", "tensor")),
+        "layers": {
+            "ln1": P(None, None),
+            "wq": P(None, "fsdp", "tensor"),
+            "wk": P(None, "fsdp", "tensor"),
+            "wv": P(None, "fsdp", "tensor"),
+            "wo": P(None, "tensor", "fsdp"),
+            "ln2": P(None, None),
+            "w1": P(None, "fsdp", "tensor"),
+            "w3": P(None, "fsdp", "tensor"),
+            "w2": P(None, "tensor", "fsdp"),
+        },
+        "ln_f": P(None),
+        "lm_head": P("fsdp", "tensor"),
+    }
+
+
+def param_shardings(config: TransformerConfig, mesh: Mesh):
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(config),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
+    """Scaled-normal init in float32; leading [L] stack on layer weights."""
+    config.validate()
+    c = config
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    dm, dff, hd = c.d_model, c.d_ff, c.head_dim
+    q_dim, kv_dim = c.n_heads * hd, c.n_kv_heads * hd
+
+    def normal(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(jnp.float32)
+
+    ks = jax.random.split(k_layers, 7)
+    std = dm ** -0.5
+    resid_std = std / (2 * c.n_layers) ** 0.5
+    L = c.n_layers
+    return {
+        "embed": normal(k_embed, (c.vocab_size, dm), 1.0),
+        "layers": {
+            "ln1": jnp.ones((L, dm), jnp.float32),
+            "wq": normal(ks[0], (L, dm, q_dim), std),
+            "wk": normal(ks[1], (L, dm, kv_dim), std),
+            "wv": normal(ks[2], (L, dm, kv_dim), std),
+            "wo": normal(ks[3], (L, q_dim, dm), resid_std),
+            "ln2": jnp.ones((L, dm), jnp.float32),
+            "w1": normal(ks[4], (L, dm, dff), std),
+            "w3": normal(ks[5], (L, dm, dff), std),
+            "w2": normal(ks[6], (L, dff, dm), resid_std),
+        },
+        "ln_f": jnp.ones((dm,), jnp.float32),
+        "lm_head": normal(k_head, (dm, c.vocab_size), std),
+    }
+
+
+def _rms_norm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding on [B, S, H, D]; positions [B, S] are GLOBAL token
+    positions (sequence-sharded shards pass their offset slice)."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, D/2]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def _constrain(x: jax.Array, mesh: Optional[Mesh], spec: P) -> jax.Array:
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    config: TransformerConfig,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """tokens [B, S] (S sequence-sharded) -> logits [B, S, V] float32
+    (V tensor-sharded)."""
+    c = config
+    act_spec = P(BATCH_AXES, "sequence", None)
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+
+    x = params["embed"].astype(c.dtype)[tokens]
+    x = _constrain(x, mesh, act_spec)
+
+    def layer(x, lp):
+        h = _rms_norm(x, lp["ln1"])
+        q = (h @ lp["wq"].astype(c.dtype)).reshape(b, s, c.n_heads, c.head_dim)
+        k = (h @ lp["wk"].astype(c.dtype)).reshape(b, s, c.n_kv_heads, c.head_dim)
+        v = (h @ lp["wv"].astype(c.dtype)).reshape(b, s, c.n_kv_heads, c.head_dim)
+        q = _rope(q, positions, c.rope_theta)
+        k = _rope(k, positions, c.rope_theta)
+        if c.n_kv_heads != c.n_heads:
+            rep = c.n_heads // c.n_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        attn = attention(q, k, v, mesh, causal=True)
+        x = x + _constrain(
+            attn.reshape(b, s, c.n_heads * c.head_dim) @ lp["wo"].astype(c.dtype),
+            mesh, act_spec,
+        )
+        h = _rms_norm(x, lp["ln2"])
+        gate = jax.nn.silu(h @ lp["w1"].astype(c.dtype))
+        up = h @ lp["w3"].astype(c.dtype)
+        x = x + _constrain((gate * up) @ lp["w2"].astype(c.dtype), mesh, act_spec)
+        return x, None
+
+    layer_fn = jax.checkpoint(layer) if c.remat else layer
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+
+    x = _rms_norm(x, params["ln_f"])
+    logits = x.astype(jnp.float32) @ params["lm_head"]
+    return _constrain(logits, mesh, P(BATCH_AXES, "sequence", "tensor"))
+
+
+def loss_fn(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    config: TransformerConfig,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Mean next-token cross-entropy; `batch` = {tokens, targets, mask}.
+    Stable log-softmax in float32 over the (possibly tensor-sharded) vocab
+    axis — XLA turns the reductions into reduce-scatters on `tensor`."""
+    logits = forward(params, batch["tokens"], config, mesh)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    target_logit = jnp.take_along_axis(
+        logits, batch["targets"][..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    nll = logz - target_logit
+    mask = batch.get("mask")
+    if mask is None:
+        return nll.mean()
+    mask = mask.astype(jnp.float32)
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
