@@ -1,0 +1,170 @@
+"""SDK + initializer tests (reference training_client_test.py model:
+mocked-server client behavior; here the in-process cluster IS the server)."""
+
+import pytest
+
+from training_operator_tpu.api.common import (
+    Container,
+    JobConditionType,
+    PodTemplateSpec,
+    ReplicaSpec,
+)
+from training_operator_tpu.api.jobs import JAXJob, ObjectMeta
+from training_operator_tpu.api.validation import ValidationError
+from training_operator_tpu.cluster.inventory import make_cpu_pool
+from training_operator_tpu.cluster.runtime import (
+    ANNOTATION_SIM_DURATION,
+    ANNOTATION_SIM_EXIT_CODE,
+    Cluster,
+    DefaultScheduler,
+    SimKubelet,
+    VirtualClock,
+)
+from training_operator_tpu.controllers import OperatorManager, register_all
+from training_operator_tpu.initializers import InitializerConfig, download, get_provider
+from training_operator_tpu.runtime import MLPolicy, ClusterTrainingRuntime
+from training_operator_tpu.runtime.api import (
+    ReplicatedJobTemplate,
+    TrainingRuntimeSpec,
+    TRAINER_NODE,
+)
+from training_operator_tpu.runtime.controller import TrainJobManager
+from training_operator_tpu.sdk import TrainingClient
+from training_operator_tpu.sdk.client import TimeoutException
+
+
+def make_env():
+    cluster = Cluster(VirtualClock())
+    cluster.add_nodes(make_cpu_pool(8))
+    DefaultScheduler(cluster)
+    SimKubelet(cluster)
+    mgr = OperatorManager(cluster)
+    register_all(mgr)
+    v2 = TrainJobManager(cluster)
+    return cluster, TrainingClient(cluster)
+
+
+def jax_job(name, replicas=2, duration="2", exit_code=None):
+    t = PodTemplateSpec(
+        containers=[Container(name="jax", image="img", resources={"cpu": 0.5})]
+    )
+    t.annotations[ANNOTATION_SIM_DURATION] = duration
+    if exit_code:
+        t.annotations[ANNOTATION_SIM_EXIT_CODE] = exit_code
+    return JAXJob(
+        metadata=ObjectMeta(name=name),
+        replica_specs={"Worker": ReplicaSpec(replicas=replicas, template=t)},
+    )
+
+
+class TestTrainingClient:
+    def test_create_wait_succeeded(self):
+        _, client = make_env()
+        client.create_job(jax_job("t1"))
+        job = client.wait_for_job_conditions("t1", timeout=60)
+        assert client.is_job_succeeded("t1")
+        assert job.status.completion_time is not None
+
+    def test_wait_raises_on_failure(self):
+        from training_operator_tpu.api.common import RestartPolicy
+
+        _, client = make_env()
+        job = jax_job("boom", duration="1", exit_code="3")
+        job.replica_specs["Worker"].restart_policy = RestartPolicy.NEVER
+        client.create_job(job)
+        with pytest.raises(RuntimeError, match="failed"):
+            client.wait_for_job_conditions("boom", timeout=60)
+
+    def test_wait_timeout(self):
+        cluster, client = make_env()
+        client.create_job(jax_job("slow", duration="500"))
+        with pytest.raises(TimeoutException):
+            client.wait_for_job_conditions("slow", timeout=5)
+
+    def test_pod_names_and_logs(self):
+        _, client = make_env()
+        client.create_job(jax_job("p1", replicas=2))
+        client.wait_for_job_conditions(
+            "p1", expected_conditions=[JobConditionType.RUNNING], timeout=60
+        )
+        names = client.get_job_pod_names("p1")
+        assert names == ["p1-worker-0", "p1-worker-1"]
+        masters = client.get_job_pod_names("p1", is_master=True)
+        assert masters == ["p1-worker-0"]  # worker-0 = coordinator
+        logs = client.get_job_logs("p1")
+        assert "SuccessfulCreatePod" in logs["p1-worker-0"]
+
+    def test_list_update_delete(self):
+        cluster, client = make_env()
+        client.create_job(jax_job("a"))
+        client.create_job(jax_job("b"))
+        assert {j.name for j in client.list_jobs()} == {"a", "b"}
+        job = client.get_job("a")
+        job.run_policy.suspend = True
+        client.update_job(job)
+        cluster.run_for(1)
+        assert client.is_job_suspended("a")
+        client.delete_job("b")
+        assert {j.name for j in client.list_jobs()} == {"a"}
+
+    def test_validation_propagates(self):
+        _, client = make_env()
+        with pytest.raises(ValidationError):
+            client.create_job(JAXJob(metadata=ObjectMeta(name="Bad_Name")))
+
+    def test_train_high_level(self):
+        cluster, client = make_env()
+        t = PodTemplateSpec(
+            containers=[Container(name="trainer", image="base", resources={"cpu": 0.5})]
+        )
+        t.annotations[ANNOTATION_SIM_DURATION] = "2"
+        cluster.api.create(ClusterTrainingRuntime(
+            metadata=ObjectMeta(name="tpu-jax-default", namespace=""),
+            spec=TrainingRuntimeSpec(
+                ml_policy=MLPolicy(num_nodes=2),
+                template=[ReplicatedJobTemplate(name=TRAINER_NODE, template=t)],
+            ),
+        ))
+        tj = client.train(
+            name="finetune",
+            model_uri="hf://org/model",
+            dataset_uri="hf://org/data",
+            args=["--lr", "1e-4"],
+            num_nodes=2,
+        )
+        assert tj.runtime_ref.name == "tpu-jax-default"
+        assert cluster.run_until(
+            lambda: cluster.api.get("TrainJob", "default", "finetune").is_finished(),
+            timeout=60,
+        )
+        jj = cluster.api.get("JAXJob", "default", "finetune")
+        inits = [c.name for c in jj.replica_specs["Worker"].template.init_containers]
+        assert inits == ["dataset-initializer", "model-initializer"]
+        assert jj.replica_specs["Worker"].template.containers[0].args == ["--lr", "1e-4"]
+
+
+class TestInitializers:
+    def test_file_provider_roundtrip(self, tmp_path):
+        src = tmp_path / "data"
+        src.mkdir()
+        (src / "train.jsonl").write_text('{"x": 1}\n')
+        out = tmp_path / "workspace"
+        dest = download(f"file://{src}", str(out))
+        assert (out / "data" / "train.jsonl").exists()
+        assert dest.endswith("data")
+
+    def test_scheme_dispatch(self):
+        assert get_provider("file:///x").scheme == "file"
+        assert get_provider("/plain/path").scheme == "file"
+        assert get_provider("hf://org/repo").scheme == "hf"
+        assert get_provider("s3://bucket/k").scheme == "s3"
+        with pytest.raises(ValueError):
+            get_provider("gs://nope")
+
+    def test_config_from_env(self):
+        cfg = InitializerConfig.from_env(
+            {"STORAGE_URI": "hf://d", "TARGET_DIR": "/tmp/t", "ACCESS_TOKEN": "tok"}
+        )
+        assert cfg.storage_uri == "hf://d"
+        assert cfg.target_dir == "/tmp/t"
+        assert cfg.access_token == "tok"

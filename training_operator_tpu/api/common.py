@@ -382,6 +382,7 @@ class PodTemplateSpec:
     annotations: Dict[str, str] = field(default_factory=dict)
     node_selector: Dict[str, str] = field(default_factory=dict)
     scheduler_name: str = ""
+    service_account: str = ""
     restart_policy: Optional[RestartPolicy] = None
 
     def main_container(self, name: str) -> Optional[Container]:

@@ -1,0 +1,23 @@
+"""Dataset/model initializers.
+
+Parity target: reference pkg/initializer_v2 ({dataset,model} packages:
+env-config STORAGE_URI with scheme dispatch -> provider download; abstract
+provider ABCs in utils/utils.py:10-27) and the v1 storage_initializer
+(sdk/python/kubeflow/storage_initializer: HuggingFace + S3 providers).
+"""
+
+from training_operator_tpu.initializers.core import (
+    InitializerConfig,
+    Provider,
+    download,
+    get_provider,
+    register_provider,
+)
+
+__all__ = [
+    "InitializerConfig",
+    "Provider",
+    "download",
+    "get_provider",
+    "register_provider",
+]

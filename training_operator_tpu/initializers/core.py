@@ -1,0 +1,169 @@
+"""Initializer core: scheme-dispatched storage providers.
+
+Reference mapping:
+- env config (`STORAGE_URI`, access token): pkg/initializer_v2/utils +
+  dataset/config.py, model/config.py
+- HuggingFace provider (`hf://`): dataset/huggingface.py:26-42
+  (`huggingface_hub.snapshot_download`)
+- S3 provider (`s3://`): sdk/python/kubeflow/storage_initializer/s3.py
+- abstract Provider ABC: utils/utils.py:10-27
+
+Zero-egress environments: hf/s3 back ends are import-gated; `file://` (and
+plain paths) copy from local storage so the initializer pipeline is fully
+testable offline (SURVEY.md §4: everything testable with no cluster, no
+network).
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import shutil
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+DEFAULT_TARGET = "/workspace"
+
+
+@dataclass
+class InitializerConfig:
+    """Env-derived config (reference config.py dataclasses)."""
+
+    storage_uri: str = ""
+    target_dir: str = DEFAULT_TARGET
+    access_token: Optional[str] = None
+    env: Dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def from_env(cls, environ: Optional[Dict[str, str]] = None) -> "InitializerConfig":
+        e = dict(os.environ if environ is None else environ)
+        return cls(
+            storage_uri=e.get("STORAGE_URI", ""),
+            target_dir=e.get("TARGET_DIR", DEFAULT_TARGET),
+            access_token=e.get("ACCESS_TOKEN") or None,
+            env=e,
+        )
+
+
+class Provider(abc.ABC):
+    """reference utils/utils.py:10-27 (abstract config+download)."""
+
+    scheme: str = ""
+
+    @abc.abstractmethod
+    def download(self, uri: str, target_dir: str, config: InitializerConfig) -> str:
+        """Fetch `uri` into `target_dir`; returns the local path."""
+
+
+_PROVIDERS: Dict[str, Callable[[], Provider]] = {}
+
+
+def register_provider(scheme: str, factory: Callable[[], Provider]) -> None:
+    _PROVIDERS[scheme] = factory
+
+
+def get_provider(uri: str) -> Provider:
+    scheme, sep, _ = uri.partition("://")
+    if not sep:
+        scheme = "file"
+    factory = _PROVIDERS.get(scheme)
+    if factory is None:
+        raise ValueError(
+            f"no provider for scheme {scheme!r} (known: {sorted(_PROVIDERS)})"
+        )
+    return factory()
+
+
+def download(uri: str, target_dir: str, config: Optional[InitializerConfig] = None) -> str:
+    config = config or InitializerConfig(storage_uri=uri, target_dir=target_dir)
+    return get_provider(uri).download(uri, target_dir, config)
+
+
+# ---------------------------------------------------------------------------
+# Providers
+# ---------------------------------------------------------------------------
+
+
+class FileProvider(Provider):
+    """`file://` / bare paths — local copy; the offline test path."""
+
+    scheme = "file"
+
+    def download(self, uri: str, target_dir: str, config: InitializerConfig) -> str:
+        src = uri.partition("://")[2] or uri
+        os.makedirs(target_dir, exist_ok=True)
+        dest = os.path.join(target_dir, os.path.basename(src.rstrip("/")))
+        if os.path.isdir(src):
+            shutil.copytree(src, dest, dirs_exist_ok=True)
+        else:
+            shutil.copy2(src, dest)
+        return dest
+
+
+class HuggingFaceProvider(Provider):
+    """`hf://repo[/path]` via huggingface_hub (reference
+    dataset/huggingface.py:26-42). Import-gated: raises a clear error when
+    the hub or network is unavailable."""
+
+    scheme = "hf"
+
+    def download(self, uri: str, target_dir: str, config: InitializerConfig) -> str:
+        try:
+            from huggingface_hub import snapshot_download
+        except ImportError as e:  # pragma: no cover - env without hub
+            raise RuntimeError(
+                "huggingface_hub is not installed; hf:// URIs unavailable"
+            ) from e
+        repo = uri.partition("://")[2]
+        os.makedirs(target_dir, exist_ok=True)
+        return snapshot_download(
+            repo_id=repo, local_dir=target_dir, token=config.access_token
+        )
+
+
+class S3Provider(Provider):
+    """`s3://bucket/prefix` via boto3 (reference storage_initializer/s3.py).
+    Import-gated."""
+
+    scheme = "s3"
+
+    def download(self, uri: str, target_dir: str, config: InitializerConfig) -> str:
+        try:
+            import boto3  # type: ignore
+        except ImportError as e:  # pragma: no cover - env without boto3
+            raise RuntimeError("boto3 is not installed; s3:// URIs unavailable") from e
+        rest = uri.partition("://")[2]
+        bucket, _, prefix = rest.partition("/")
+        os.makedirs(target_dir, exist_ok=True)
+        s3 = boto3.client(
+            "s3",
+            aws_access_key_id=config.env.get("AWS_ACCESS_KEY_ID"),
+            aws_secret_access_key=config.env.get("AWS_SECRET_ACCESS_KEY"),
+            endpoint_url=config.env.get("S3_ENDPOINT_URL"),
+        )
+        paginator = s3.get_paginator("list_objects_v2")
+        for page in paginator.paginate(Bucket=bucket, Prefix=prefix):
+            for obj in page.get("Contents", []):
+                key = obj["Key"]
+                dest = os.path.join(target_dir, os.path.relpath(key, prefix or ""))
+                os.makedirs(os.path.dirname(dest) or target_dir, exist_ok=True)
+                s3.download_file(bucket, key, dest)
+        return target_dir
+
+
+register_provider("file", FileProvider)
+register_provider("hf", HuggingFaceProvider)
+register_provider("s3", S3Provider)
+
+
+def main(argv: Optional[list] = None) -> str:
+    """Container entry (reference dataset/__main__.py shape): read env,
+    download, done."""
+    config = InitializerConfig.from_env()
+    if not config.storage_uri:
+        raise SystemExit("STORAGE_URI is required")
+    return download(config.storage_uri, config.target_dir, config)
+
+
+if __name__ == "__main__":
+    print(main())

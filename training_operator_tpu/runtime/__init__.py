@@ -1,0 +1,52 @@
+"""The v2 generation: TrainJob + TrainingRuntime with a plugin framework.
+
+Parity target: reference pkg/apis/kubeflow.org/v2alpha1 (TrainJob,
+TrainingRuntime, ClusterTrainingRuntime), pkg/runtime.v2 (plugin framework:
+EnforceMLPolicy / EnforcePodGroupPolicy / ComponentBuilder extension points,
+registry at framework/plugins/registry.go:34-42) and pkg/controller.v2
+(TrainJob controller).
+
+TPU-native redesign: where the reference's JobSet plugin emits a JobSet CR for
+an external operator to expand (process boundary at trainjob_controller.go
+:110-141), the workload-builder plugin here emits one of OUR v1 job kinds
+(JAXJob first) into the same API server, so the battle-tested v1 engine is
+the expansion layer — same layering, one less moving operator. MLPolicy gains
+a first-class TPU policy (slice topology + mesh axes) alongside Torch/MPI.
+"""
+
+from training_operator_tpu.runtime.api import (
+    ClusterTrainingRuntime,
+    DatasetConfig,
+    MLPolicy,
+    ModelConfig,
+    PodGroupPolicy,
+    RuntimeRef,
+    TorchPolicy,
+    TPUMLPolicy,
+    Trainer,
+    TrainingRuntime,
+    TrainJob,
+    TrainJobConditionType,
+)
+from training_operator_tpu.runtime.controller import TrainJobController, RuntimeRegistry
+from training_operator_tpu.runtime.framework import Info, PluginRegistry, default_registry
+
+__all__ = [
+    "ClusterTrainingRuntime",
+    "DatasetConfig",
+    "Info",
+    "MLPolicy",
+    "ModelConfig",
+    "PluginRegistry",
+    "PodGroupPolicy",
+    "RuntimeRef",
+    "RuntimeRegistry",
+    "TPUMLPolicy",
+    "TorchPolicy",
+    "Trainer",
+    "TrainingRuntime",
+    "TrainJob",
+    "TrainJobConditionType",
+    "TrainJobController",
+    "default_registry",
+]

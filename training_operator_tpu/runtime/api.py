@@ -1,0 +1,276 @@
+"""v2 API types: TrainJob, TrainingRuntime, ClusterTrainingRuntime.
+
+Parity target: reference pkg/apis/kubeflow.org/v2alpha1/trainjob_types.go
+:104-368 (RuntimeRef, Trainer, DatasetConfig, ModelConfig, PodSpecOverride,
+Suspend, ManagedBy; conditions Created/Suspended/Complete/Failed) and
+trainingruntime_types.go:102-230 (MLPolicy{NumNodes, Torch, MPI},
+PodGroupPolicy{Coscheduling}).
+
+TPU-first extension: MLPolicy carries a TPUMLPolicy (slice accelerator,
+topology, num_slices, mesh axes) — the surface the reference lacks entirely
+(SURVEY.md §2.3: TP/SP/mesh config is ABSENT upstream; here it is the
+primary policy).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from training_operator_tpu.api.common import Container, PodTemplateSpec
+from training_operator_tpu.api.jobs import ObjectMeta, TPUPolicy
+
+
+class TrainJobConditionType(str, enum.Enum):
+    CREATED = "Created"
+    SUSPENDED = "Suspended"
+    COMPLETE = "Complete"
+    FAILED = "Failed"
+
+
+@dataclass
+class TrainJobCondition:
+    type: TrainJobConditionType
+    status: bool = True
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+
+
+@dataclass
+class RuntimeRef:
+    """Which runtime expands this TrainJob (reference trainjob_types.go:152).
+    kind: TrainingRuntime (namespaced) | ClusterTrainingRuntime."""
+
+    name: str = ""
+    kind: str = "ClusterTrainingRuntime"
+
+
+@dataclass
+class Trainer:
+    """Per-job trainer overrides (reference trainjob_types.go:185-246)."""
+
+    image: Optional[str] = None
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+    num_nodes: Optional[int] = None
+    resources_per_node: Dict[str, float] = field(default_factory=dict)
+    num_proc_per_node: Optional[int] = None
+
+
+@dataclass
+class DatasetConfig:
+    """Dataset initializer config (reference trainjob_types.go:262-281)."""
+
+    storage_uri: Optional[str] = None  # e.g. "hf://dataset/path", "s3://..."
+    env: Dict[str, str] = field(default_factory=dict)
+    secret_ref: Optional[str] = None
+
+
+@dataclass
+class ModelConfig:
+    """Model initializer/exporter config (reference trainjob_types.go:283-308)."""
+
+    input_storage_uri: Optional[str] = None
+    output_storage_uri: Optional[str] = None
+    env: Dict[str, str] = field(default_factory=dict)
+    secret_ref: Optional[str] = None
+
+
+@dataclass
+class PodSpecOverride:
+    """Targeted pod-spec patches (reference trainjob_types.go:310-357)."""
+
+    target_replica_types: List[str] = field(default_factory=list)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[Dict[str, Any]] = field(default_factory=list)
+    service_account: Optional[str] = None
+    init_containers: List[Container] = field(default_factory=list)
+
+
+@dataclass
+class TrainJobStatus:
+    conditions: List[TrainJobCondition] = field(default_factory=list)
+    jobs_status: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class TrainJob:
+    KIND = "TrainJob"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    runtime_ref: RuntimeRef = field(default_factory=RuntimeRef)
+    trainer: Optional[Trainer] = None
+    dataset_config: Optional[DatasetConfig] = None
+    model_config: Optional[ModelConfig] = None
+    pod_spec_overrides: List[PodSpecOverride] = field(default_factory=list)
+    suspend: bool = False
+    managed_by: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    status: TrainJobStatus = field(default_factory=TrainJobStatus)
+
+    @property
+    def kind(self) -> str:
+        return self.KIND
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.uid
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def condition(self, t: TrainJobConditionType) -> Optional[TrainJobCondition]:
+        for c in self.status.conditions:
+            if c.type == t:
+                return c
+        return None
+
+    def set_condition(
+        self, t: TrainJobConditionType, status: bool, reason: str, message: str, now: float
+    ) -> None:
+        c = self.condition(t)
+        if c is not None:
+            if c.status == status and c.reason == reason:
+                return
+            c.status = status
+            c.reason = reason
+            c.message = message
+            c.last_transition_time = now
+            return
+        self.status.conditions.append(
+            TrainJobCondition(type=t, status=status, reason=reason, message=message,
+                              last_transition_time=now)
+        )
+
+    def is_finished(self) -> bool:
+        for t in (TrainJobConditionType.COMPLETE, TrainJobConditionType.FAILED):
+            c = self.condition(t)
+            if c is not None and c.status:
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Runtime types
+# ---------------------------------------------------------------------------
+
+
+class MPIImplementationV2(str, enum.Enum):
+    OPENMPI = "OpenMPI"
+    INTEL = "Intel"
+    MPICH = "MPICH"
+
+
+@dataclass
+class TorchPolicy:
+    """reference trainingruntime_types.go:168-189 (MLPolicySource.Torch)."""
+
+    num_proc_per_node: Optional[int] = None
+    elastic_min_nodes: Optional[int] = None
+    elastic_max_nodes: Optional[int] = None
+    max_restarts: Optional[int] = None
+
+
+@dataclass
+class MPIPolicy:
+    """reference trainingruntime_types.go:191-218 (MLPolicySource.MPI)."""
+
+    num_proc_per_node: Optional[int] = None
+    mpi_implementation: MPIImplementationV2 = MPIImplementationV2.OPENMPI
+    ssh_auth_mount_path: str = "/root/.ssh"
+    run_launcher_as_node: bool = False
+
+
+# The TPU policy IS api.jobs.TPUPolicy; aliased for the v2 surface.
+TPUMLPolicy = TPUPolicy
+
+
+@dataclass
+class MLPolicy:
+    """reference trainingruntime_types.go:140-166, with `tpu` added as the
+    first-class policy of this framework."""
+
+    num_nodes: int = 1
+    torch: Optional[TorchPolicy] = None
+    mpi: Optional[MPIPolicy] = None
+    tpu: Optional[TPUMLPolicy] = None
+
+
+@dataclass
+class CoschedulingPolicy:
+    schedule_timeout_seconds: Optional[int] = None
+
+
+@dataclass
+class PodGroupPolicy:
+    """reference trainingruntime_types.go:121-138."""
+
+    coscheduling: Optional[CoschedulingPolicy] = None
+
+
+@dataclass
+class ReplicatedJobTemplate:
+    """One replicated job of the runtime's workload template — the analogue
+    of a JobSet replicated job (the reference wraps a jobset
+    ReplicatedJob; trainingruntime_types.go:102-119). `name` follows the
+    reference's well-known names: trainer-node, dataset-initializer,
+    model-initializer."""
+
+    name: str = "trainer-node"
+    replicas: int = 1
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class TrainingRuntimeSpec:
+    ml_policy: MLPolicy = field(default_factory=MLPolicy)
+    pod_group_policy: Optional[PodGroupPolicy] = None
+    template: List[ReplicatedJobTemplate] = field(default_factory=list)
+
+    def replicated_job(self, name: str) -> Optional[ReplicatedJobTemplate]:
+        for rj in self.template:
+            if rj.name == name:
+                return rj
+        return None
+
+
+@dataclass
+class TrainingRuntime:
+    """Namespaced runtime blueprint (reference trainingruntime_types.go:30)."""
+
+    KIND = "TrainingRuntime"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TrainingRuntimeSpec = field(default_factory=TrainingRuntimeSpec)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class ClusterTrainingRuntime(TrainingRuntime):
+    """Cluster-scoped variant (reference clustertrainingruntime_types.go)."""
+
+    KIND = "ClusterTrainingRuntime"
+
+
+TRAINER_NODE = "trainer-node"
+DATASET_INITIALIZER = "dataset-initializer"
+MODEL_INITIALIZER = "model-initializer"

@@ -1,0 +1,206 @@
+"""TrainJob controller + runtime resolution + the v2 manager loop.
+
+Parity target: reference pkg/controller.v2/trainjob_controller.go:71-143
+(fetch -> resolve runtime by RuntimeRef GroupKind -> runtime.NewObjects ->
+create-or-update each -> conditions) and pkg/runtime.v2/core/
+{trainingruntime.go:74-129, clustertrainingruntime.go:48-82, registry.go}.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+from typing import Any, Dict, List, Optional
+
+from training_operator_tpu.cluster.apiserver import APIServer, NotFoundError
+from training_operator_tpu.cluster.runtime import Cluster
+from training_operator_tpu.engine.workqueue import RateLimitingQueue
+from training_operator_tpu.runtime.api import (
+    ClusterTrainingRuntime,
+    TrainingRuntime,
+    TrainJob,
+    TrainJobConditionType,
+)
+from training_operator_tpu.runtime.framework import Info, PluginRegistry, default_registry
+
+log = logging.getLogger(__name__)
+
+WORKLOAD_KINDS = ("JAXJob", "PyTorchJob", "MPIJob")
+
+
+class RuntimeRegistry:
+    """Resolves RuntimeRef -> runtime CR (reference core/registry.go:29-34)."""
+
+    def __init__(self, api: APIServer):
+        self.api = api
+
+    def resolve(self, job: TrainJob):
+        ref = job.runtime_ref
+        if ref.kind == TrainingRuntime.KIND:
+            return self.api.try_get(TrainingRuntime.KIND, job.namespace, ref.name)
+        return self.api.try_get(ClusterTrainingRuntime.KIND, "", ref.name)
+
+
+class TrainJobController:
+    """Reconciles one TrainJob through the plugin chain."""
+
+    def __init__(
+        self,
+        api: APIServer,
+        now_fn,
+        registry: Optional[PluginRegistry] = None,
+    ):
+        self.api = api
+        self.now = now_fn
+        self.registry = registry or default_registry()
+        self.runtimes = RuntimeRegistry(api)
+
+    def reconcile(self, namespace: str, name: str) -> None:
+        job = self.api.try_get(TrainJob.KIND, namespace, name)
+        if job is None:
+            return
+        if job.managed_by not in ("", "tpu-training-operator"):
+            return  # MultiKueue analogue (reference :129-138 in v1, same in v2)
+        if job.is_finished():
+            return
+        now = self.now()
+        prev_status = copy.deepcopy(job.status)
+
+        runtime = self.runtimes.resolve(job)
+        if runtime is None:
+            job.set_condition(
+                TrainJobConditionType.CREATED, False, "RuntimeNotFound",
+                f"runtime {job.runtime_ref.kind}/{job.runtime_ref.name} not found", now,
+            )
+            self._write(job, prev_status)
+            return
+
+        # Assemble Info (label/annotation merge: TrainJob wins —
+        # reference core/trainingruntime.go:86-101).
+        info = Info(runtime_spec=runtime.spec)
+        info.labels.update(job.labels)
+        info.annotations.update(job.annotations)
+
+        objects = self.registry.run(info, job)
+        for obj in objects:
+            self._create_or_update(obj, job)
+
+        job.set_condition(
+            TrainJobConditionType.CREATED, True, "JobsCreated",
+            f"created {len(objects)} object(s)", now,
+        )
+        if job.suspend:
+            job.set_condition(
+                TrainJobConditionType.SUSPENDED, True, "Suspended",
+                "TrainJob is suspended", now,
+            )
+        else:
+            if job.condition(TrainJobConditionType.SUSPENDED) is not None:
+                job.set_condition(
+                    TrainJobConditionType.SUSPENDED, False, "Resumed",
+                    "TrainJob is resumed", now,
+                )
+        terminal = self.registry.terminal_condition(self.api, job)
+        if terminal is not None:
+            cond_type, reason, message = terminal
+            job.set_condition(cond_type, True, reason, message, now)
+        self._write(job, prev_status)
+
+    # ------------------------------------------------------------------
+
+    def _create_or_update(self, obj: Any, job: TrainJob) -> None:
+        """Reference reconcileObjects (:110-141): server-side-apply analogue.
+        Spec fields are refreshed; the live object's status is preserved."""
+        from training_operator_tpu.api.defaults import default_job
+
+        # Normalize through the same defaulting the v1 engine applies to the
+        # live object, or the comparison below would never converge.
+        default_job(obj, now=self.now())
+        existing = self.api.try_get(obj.KIND, obj.metadata.namespace, obj.metadata.name)
+        if existing is None:
+            self.api.create(obj)
+            return
+        if existing.metadata.owner_uid not in (None, job.uid):
+            log.warning("name collision on %s %s: owned by someone else",
+                        obj.KIND, obj.metadata.name)
+            return
+        # Only mutable intent is propagated (suspend, replica sizing, specs),
+        # and only when it actually differs — an unconditional write would
+        # echo back through the workload watch and re-trigger this reconcile
+        # forever.
+        desired = (obj.run_policy, obj.replica_specs, getattr(obj, "tpu_policy", None))
+        live = (existing.run_policy, existing.replica_specs,
+                getattr(existing, "tpu_policy", None))
+        if desired == live:
+            return
+        existing.run_policy = obj.run_policy
+        existing.replica_specs = obj.replica_specs
+        if hasattr(obj, "tpu_policy"):
+            existing.tpu_policy = obj.tpu_policy
+        self.api.update(existing, check_version=False)
+
+    def _write(self, job: TrainJob, prev_status=None) -> None:
+        if prev_status is not None and prev_status == job.status:
+            return
+        try:
+            self.api.update(job, check_version=False, status_only=True)
+        except NotFoundError:
+            pass
+
+
+class TrainJobManager:
+    """The v2 manager loop: watches TrainJobs + owned workloads, drives the
+    controller (reference cmd/training-operator.v2alpha1/main.go:142-148 +
+    SetupWithManager watch registrations, trainjob_controller.go:222-233)."""
+
+    def __init__(self, cluster: Cluster, registry: Optional[PluginRegistry] = None):
+        self.cluster = cluster
+        self.api = cluster.api
+        self.controller = TrainJobController(
+            self.api, now_fn=cluster.clock.now, registry=registry
+        )
+        self.queue = RateLimitingQueue()
+        self._watch = self.api.watch()
+        cluster.add_ticker(self.tick)
+        from training_operator_tpu.runtime.webhooks import validate_trainjob, validate_training_runtime
+
+        self.api.register_admission(TrainJob.KIND, validate_trainjob)
+        self.api.register_admission(TrainingRuntime.KIND, validate_training_runtime)
+        self.api.register_admission(ClusterTrainingRuntime.KIND, validate_training_runtime)
+
+    def submit(self, obj: Any) -> Any:
+        if isinstance(obj, TrainJob) and obj.metadata.creation_time is None:
+            obj.metadata.creation_time = self.cluster.clock.now()
+        return self.api.create(obj)
+
+    def tick(self) -> None:
+        for ev in self._watch.drain():
+            self._handle_event(ev)
+        for key in self.queue.drain(limit=256):
+            ns, name = key.split("/", 1)
+            try:
+                self.controller.reconcile(ns, name)
+            except Exception:
+                log.exception("trainjob reconcile failed for %s", key)
+                delay = self.queue.failure_delay(key)
+                self.cluster.schedule_after(delay, lambda: self.queue.add(key))
+            else:
+                self.queue.forget(key)
+
+    def _handle_event(self, ev) -> None:
+        obj = ev.obj
+        if ev.kind == TrainJob.KIND:
+            if ev.type == "Deleted":
+                self._cascade_delete(obj)
+            elif not ev.status_only:
+                self.queue.add(obj.key())
+        elif ev.kind in WORKLOAD_KINDS:
+            owner = obj.metadata.labels.get("training.tpu.dev/trainjob-name")
+            if owner:
+                self.queue.add(f"{obj.namespace}/{owner}")
+
+    def _cascade_delete(self, job: TrainJob) -> None:
+        for kind in WORKLOAD_KINDS:
+            owned = self.api.try_get(kind, job.namespace, job.name)
+            if owned is not None and owned.metadata.owner_uid == job.uid:
+                self.api.try_delete(kind, job.namespace, job.name)

@@ -1,0 +1,141 @@
+"""v2 plugin framework: extension points, Info carrier, registry, run order.
+
+Parity target: reference pkg/runtime.v2/framework/interface.go:31-63 (plugin
+interfaces resolved by interface assertion), framework/core/framework.go
+(RunEnforceMLPolicyPlugins -> RunEnforcePodGroupPolicyPlugins ->
+RunComponentBuilderPlugins, :82-126) and runtime.go:28-62 (`runtime.Info`
+carried between plugins).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Protocol, runtime_checkable
+
+from training_operator_tpu.runtime.api import (
+    MLPolicy,
+    PodGroupPolicy,
+    TrainingRuntimeSpec,
+    TrainJob,
+)
+
+
+@dataclass
+class SchedulerInfo:
+    """Gang-sizing info plugins accumulate (reference runtime.go Scheduler)."""
+
+    pod_labels: Dict[str, str] = field(default_factory=dict)
+    total_members: int = 0
+    total_requests: Dict[str, float] = field(default_factory=dict)
+    schedule_timeout_seconds: Optional[int] = None
+
+
+@dataclass
+class TrainerInfo:
+    """Trainer shape after policy enforcement (reference runtime.go Trainer)."""
+
+    num_nodes: int = 1
+    num_proc_per_node: int = 1
+    env: Dict[str, str] = field(default_factory=dict)
+    container_port: Optional[int] = None
+
+
+@dataclass
+class Info:
+    """The state threaded through the plugin chain for one TrainJob."""
+
+    runtime_spec: TrainingRuntimeSpec
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    trainer: TrainerInfo = field(default_factory=TrainerInfo)
+    scheduler: SchedulerInfo = field(default_factory=SchedulerInfo)
+
+    @property
+    def ml_policy(self) -> MLPolicy:
+        return self.runtime_spec.ml_policy
+
+    @property
+    def pod_group_policy(self) -> Optional[PodGroupPolicy]:
+        return self.runtime_spec.pod_group_policy
+
+
+@runtime_checkable
+class EnforceMLPolicyPlugin(Protocol):
+    def enforce_ml_policy(self, info: Info, job: TrainJob) -> None: ...
+
+
+@runtime_checkable
+class EnforcePodGroupPolicyPlugin(Protocol):
+    def enforce_pod_group_policy(self, info: Info, job: TrainJob) -> None: ...
+
+
+@runtime_checkable
+class ComponentBuilderPlugin(Protocol):
+    def build(self, info: Info, job: TrainJob) -> List[Any]:
+        """Produce the API objects realizing this TrainJob."""
+
+
+@runtime_checkable
+class TerminalConditionPlugin(Protocol):
+    def terminal_condition(self, api, job: TrainJob):
+        """Map underlying workload status to a terminal TrainJob condition;
+        returns (cond_type, reason, message) or None."""
+
+
+class PluginRegistry:
+    """Orders plugins into the reference's run sequence. Plugins register
+    once; extension-point membership is duck-typed (the reference does the
+    same with Go interface assertions, framework/core/framework.go:47-80)."""
+
+    def __init__(self, plugins: Optional[List[Any]] = None):
+        self.plugins: List[Any] = list(plugins or [])
+
+    def register(self, plugin: Any) -> "PluginRegistry":
+        self.plugins.append(plugin)
+        return self
+
+    def run(self, info: Info, job: TrainJob) -> List[Any]:
+        """EnforceMLPolicy -> EnforcePodGroupPolicy -> ComponentBuilders
+        (reference core/trainingruntime.go:116-128)."""
+        for p in self.plugins:
+            if isinstance(p, EnforceMLPolicyPlugin):
+                p.enforce_ml_policy(info, job)
+        for p in self.plugins:
+            if isinstance(p, EnforcePodGroupPolicyPlugin):
+                p.enforce_pod_group_policy(info, job)
+        objects: List[Any] = []
+        for p in self.plugins:
+            if isinstance(p, ComponentBuilderPlugin):
+                objects.extend(p.build(info, job))
+        return objects
+
+    def terminal_condition(self, api, job: TrainJob):
+        for p in self.plugins:
+            if isinstance(p, TerminalConditionPlugin):
+                out = p.terminal_condition(api, job)
+                if out is not None:
+                    return out
+        return None
+
+
+def default_registry() -> PluginRegistry:
+    """The stock plugin set (reference plugins/registry.go:34-42 lists
+    {CoScheduling, MPI, PlainML, Torch, JobSet}; here: {TPUJax, Torch, MPI,
+    PlainML, CoScheduling, WorkloadBuilder})."""
+    from training_operator_tpu.runtime.plugins import (
+        CoSchedulingPlugin,
+        MPIPlugin,
+        PlainMLPlugin,
+        TorchPlugin,
+        TPUJaxPlugin,
+        WorkloadBuilderPlugin,
+    )
+
+    return PluginRegistry([
+        TPUJaxPlugin(),
+        TorchPlugin(),
+        MPIPlugin(),
+        PlainMLPlugin(),
+        CoSchedulingPlugin(),
+        WorkloadBuilderPlugin(),
+    ])

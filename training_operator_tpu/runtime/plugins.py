@@ -1,0 +1,286 @@
+"""Stock v2 plugins.
+
+Parity targets:
+- TorchPlugin: reference framework/plugins/torch/torch.go:52-135 (numNodes /
+  numProcPerNode precedence TrainJob > runtime, PET_* env, trainer port,
+  TotalRequests update).
+- PlainMLPlugin: plainml/plainml.go:46-76 (fallback numNodes + env).
+- MPIPlugin: mpi/mpi.go:50-56 (stub upstream too; here it at least carries
+  numProcPerNode/implementation through).
+- CoSchedulingPlugin: coscheduling/coscheduling.go:81-136 (pod labels, gang
+  minMember/minResources, schedule timeout).
+- WorkloadBuilderPlugin: the JobSet plugin's role (jobset/builder.go:84-191,
+  jobset/jobset.go:72-144) re-targeted at OUR v1 job kinds: it assembles a
+  JAXJob/PyTorchJob/MPIJob from the runtime template + TrainJob overrides and
+  maps the underlying job's terminal conditions back to the TrainJob.
+- TPUJaxPlugin: no upstream analogue — the TPU-first MLPolicy: slice/mesh
+  geometry flows into the job's TPUPolicy so the gang scheduler can place a
+  contiguous ICI mesh and the trainer runtime can build its jax Mesh.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, List, Optional
+
+from training_operator_tpu.api.common import (
+    Container,
+    ReplicaSpec,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+)
+from training_operator_tpu.api.jobs import (
+    JAXJob,
+    Job,
+    MPIImplementation,
+    MPIJob,
+    ObjectMeta,
+    PyTorchJob,
+    REPLICA_LAUNCHER,
+    REPLICA_WORKER,
+    TPUPolicy,
+)
+from training_operator_tpu.runtime.api import (
+    DATASET_INITIALIZER,
+    MODEL_INITIALIZER,
+    TRAINER_NODE,
+    TrainJob,
+    TrainJobConditionType,
+)
+from training_operator_tpu.runtime.framework import Info
+
+POD_GROUP_LABEL = "scheduling.tpu.dev/pod-group"
+TRAINJOB_LABEL = "training.tpu.dev/trainjob-name"
+
+
+class TPUJaxPlugin:
+    """EnforceMLPolicy for the TPU policy (the primary path)."""
+
+    def enforce_ml_policy(self, info: Info, job: TrainJob) -> None:
+        tpu = info.ml_policy.tpu
+        if tpu is None:
+            return
+        num_nodes = info.ml_policy.num_nodes
+        if job.trainer and job.trainer.num_nodes is not None:
+            num_nodes = job.trainer.num_nodes  # TrainJob wins (torch.go:61-66)
+        info.trainer.num_nodes = num_nodes
+        env = {
+            "TPU_ACCELERATOR": tpu.accelerator,
+            "TPU_NUM_SLICES": str(tpu.num_slices),
+        }
+        if tpu.topology:
+            env["TPU_SLICE_TOPOLOGY"] = tpu.topology
+        if tpu.mesh_axes:
+            env["TPU_MESH_AXES"] = ",".join(f"{k}={v}" for k, v in tpu.mesh_axes.items())
+        info.trainer.env.update(env)
+        info.scheduler.total_members = num_nodes
+
+
+class TorchPlugin:
+    """EnforceMLPolicy for torch (PET_* contract)."""
+
+    MASTER_PORT = 29500  # reference constants.go:50
+
+    def enforce_ml_policy(self, info: Info, job: TrainJob) -> None:
+        torch = info.ml_policy.torch
+        if torch is None:
+            return
+        num_nodes = info.ml_policy.num_nodes
+        if job.trainer and job.trainer.num_nodes is not None:
+            num_nodes = job.trainer.num_nodes
+        nproc = torch.num_proc_per_node or 1
+        if job.trainer and job.trainer.num_proc_per_node is not None:
+            nproc = job.trainer.num_proc_per_node
+        info.trainer.num_nodes = num_nodes
+        info.trainer.num_proc_per_node = nproc
+        info.trainer.container_port = self.MASTER_PORT
+        info.trainer.env.update({
+            "PET_NNODES": str(num_nodes),
+            "PET_NPROC_PER_NODE": str(nproc),
+        })
+        info.scheduler.total_members = num_nodes
+
+
+class MPIPlugin:
+    def enforce_ml_policy(self, info: Info, job: TrainJob) -> None:
+        mpi = info.ml_policy.mpi
+        if mpi is None:
+            return
+        num_nodes = info.ml_policy.num_nodes
+        if job.trainer and job.trainer.num_nodes is not None:
+            num_nodes = job.trainer.num_nodes
+        info.trainer.num_nodes = num_nodes
+        if mpi.num_proc_per_node is not None:
+            info.trainer.num_proc_per_node = mpi.num_proc_per_node
+        info.scheduler.total_members = num_nodes + 1  # launcher
+
+
+class PlainMLPlugin:
+    """Fallback when no framework-specific policy is set."""
+
+    def enforce_ml_policy(self, info: Info, job: TrainJob) -> None:
+        if info.ml_policy.torch or info.ml_policy.mpi or info.ml_policy.tpu:
+            return
+        num_nodes = info.ml_policy.num_nodes
+        if job.trainer and job.trainer.num_nodes is not None:
+            num_nodes = job.trainer.num_nodes
+        info.trainer.num_nodes = num_nodes
+        info.scheduler.total_members = num_nodes
+
+
+class CoSchedulingPlugin:
+    """EnforcePodGroupPolicy: gang labels + sizing."""
+
+    def enforce_pod_group_policy(self, info: Info, job: TrainJob) -> None:
+        pgp = info.pod_group_policy
+        if pgp is None or pgp.coscheduling is None:
+            return
+        info.scheduler.pod_labels[POD_GROUP_LABEL] = job.name
+        info.scheduler.schedule_timeout_seconds = pgp.coscheduling.schedule_timeout_seconds
+        # Gang min_resources is derived by the v1 engine from the FINAL
+        # replica specs (_sync_podgroup sums per-pod requests x replicas),
+        # which already include TrainJob resources_per_node overrides —
+        # recomputing it here from the pre-override template would be both
+        # redundant and wrong.
+
+
+class WorkloadBuilderPlugin:
+    """ComponentBuilder + TerminalCondition: TrainJob -> a v1 job kind."""
+
+    def build(self, info: Info, job: TrainJob) -> List[Any]:
+        rj = info.runtime_spec.replicated_job(TRAINER_NODE)
+        template = copy.deepcopy(rj.template) if rj else None
+        if template is None or not template.containers:
+            template = _default_template()
+        self._apply_trainer_overrides(template, info, job)
+        self._apply_initializers(template, job)
+        self._apply_pod_overrides(template, job)
+        template.labels.update(info.scheduler.pod_labels)
+        template.labels[TRAINJOB_LABEL] = job.name
+
+        workload = self._workload_for_policy(info, job, template)
+        # v1 admission requires the framework's canonical container name
+        # (webhook parity: pytorchjob_webhook.go:44-100); the runtime
+        # template's generic "trainer" container is renamed to match.
+        from training_operator_tpu.api.defaults import DEFAULT_CONTAINER_NAME
+
+        canonical = DEFAULT_CONTAINER_NAME.get(workload.KIND)
+        if canonical:
+            for spec in workload.replica_specs.values():
+                if spec.template.containers:
+                    spec.template.containers[0].name = canonical
+        workload.metadata = ObjectMeta(
+            name=job.name,
+            namespace=job.namespace,
+            labels={TRAINJOB_LABEL: job.name, **job.labels},
+            annotations=dict(job.annotations),
+            owner_uid=job.uid,
+        )
+        workload.run_policy = RunPolicy(
+            suspend=job.suspend,
+            scheduling_policy=SchedulingPolicy(
+                min_available=info.scheduler.total_members or None,
+                schedule_timeout_seconds=info.scheduler.schedule_timeout_seconds,
+            ),
+        )
+        return [workload]
+
+    # -- helpers -----------------------------------------------------------
+
+    def _apply_trainer_overrides(self, template, info: Info, job: TrainJob) -> None:
+        """Reference jobset/builder.go:140-191 Trainer()."""
+        c = template.containers[0]
+        t = job.trainer
+        if t is not None:
+            if t.image:
+                c.image = t.image
+            if t.command:
+                c.command = list(t.command)
+            if t.args:
+                c.args = list(t.args)
+            if t.resources_per_node:
+                c.resources = dict(t.resources_per_node)
+            c.env.update(t.env)
+        c.env.update(info.trainer.env)
+        if info.trainer.container_port is not None and not c.ports:
+            c.ports = {"trainer": info.trainer.container_port}
+
+    def _apply_initializers(self, template, job: TrainJob) -> None:
+        """Dataset/model initializers become init containers of the trainer
+        pods (the reference runs them as separate JobSet replicated jobs
+        ordered by JobSet semantics, jobset/builder.go:84-137; collapsing to
+        init containers keeps the ordering contract without a JobSet
+        expansion layer)."""
+        for name, cfg in ((DATASET_INITIALIZER, job.dataset_config),
+                          (MODEL_INITIALIZER, job.model_config)):
+            if cfg is None:
+                continue
+            env = dict(cfg.env)
+            uri = getattr(cfg, "storage_uri", None) or getattr(cfg, "input_storage_uri", None)
+            if uri:
+                env["STORAGE_URI"] = uri
+            if cfg.secret_ref:
+                env["SECRET_REF"] = cfg.secret_ref
+            template.init_containers.append(
+                Container(name=name, image=f"tpu-training/{name}", env=env)
+            )
+
+    def _apply_pod_overrides(self, template, job: TrainJob) -> None:
+        for ov in job.pod_spec_overrides:
+            if ov.target_replica_types and REPLICA_WORKER not in ov.target_replica_types:
+                continue
+            template.node_selector.update(ov.node_selector)
+            if ov.service_account:
+                template.service_account = ov.service_account
+            template.init_containers.extend(copy.deepcopy(ov.init_containers))
+
+    def _workload_for_policy(self, info: Info, job: TrainJob, template) -> Job:
+        n = info.trainer.num_nodes
+        spec = ReplicaSpec(replicas=n, template=template,
+                           restart_policy=RestartPolicy.ON_FAILURE)
+        if info.ml_policy.torch is not None:
+            return PyTorchJob(
+                replica_specs={REPLICA_WORKER: spec},
+                nproc_per_node=info.trainer.num_proc_per_node,
+            )
+        if info.ml_policy.mpi is not None:
+            launcher = ReplicaSpec(replicas=1, template=copy.deepcopy(template),
+                                   restart_policy=RestartPolicy.NEVER)
+            return MPIJob(
+                replica_specs={REPLICA_LAUNCHER: launcher, REPLICA_WORKER: spec},
+                mpi_implementation=MPIImplementation(info.ml_policy.mpi.mpi_implementation.value),
+                run_launcher_as_node=info.ml_policy.mpi.run_launcher_as_node,
+            )
+        tpu = info.ml_policy.tpu
+        return JAXJob(
+            replica_specs={REPLICA_WORKER: spec},
+            tpu_policy=copy.deepcopy(tpu) if tpu else None,
+        )
+
+    # -- terminal condition ------------------------------------------------
+
+    def terminal_condition(self, api, job: TrainJob):
+        """Reference jobset/jobset.go:130-144: JobSetCompleted -> Complete,
+        JobSetFailed -> Failed — here read off the owned v1 job."""
+        import training_operator_tpu.api.common as capi
+
+        for kind in ("JAXJob", "PyTorchJob", "MPIJob"):
+            owned = api.try_get(kind, job.namespace, job.name)
+            if owned is None or owned.metadata.owner_uid != job.uid:
+                continue
+            if capi.is_succeeded(owned.status):
+                return (TrainJobConditionType.COMPLETE, "JobSucceeded",
+                        f"{kind} {owned.name} succeeded")
+            if capi.is_failed(owned.status):
+                return (TrainJobConditionType.FAILED, "JobFailed",
+                        f"{kind} {owned.name} failed")
+        return None
+
+
+def _default_template():
+    from training_operator_tpu.api.common import PodTemplateSpec
+
+    return PodTemplateSpec(
+        containers=[Container(name="trainer", image="tpu-training/trainer")]
+    )

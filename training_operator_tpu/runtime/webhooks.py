@@ -1,0 +1,63 @@
+"""v2 admission validation.
+
+Parity target: reference pkg/webhook.v2/trainjob_webhook.go:44-56 and
+trainingruntime_webhook.go:56-68 (exactly one trainer container in the
+trainer-node replicated job).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from training_operator_tpu.api.validation import ValidationError
+from training_operator_tpu.runtime.api import (
+    ClusterTrainingRuntime,
+    TRAINER_NODE,
+    TrainingRuntime,
+    TrainJob,
+)
+
+_DNS1035 = re.compile(r"^[a-z]([-a-z0-9]*[a-z0-9])?$")
+
+
+def validate_trainjob(job: TrainJob) -> None:
+    errs: List[str] = []
+    if not job.metadata.name:
+        errs.append("metadata.name: required")
+    elif not _DNS1035.match(job.metadata.name) or len(job.metadata.name) > 63:
+        errs.append(f"metadata.name: {job.metadata.name!r} is not a valid DNS-1035 label")
+    if not job.runtime_ref.name:
+        errs.append("runtimeRef.name: required")
+    if job.runtime_ref.kind not in (TrainingRuntime.KIND, ClusterTrainingRuntime.KIND):
+        errs.append(f"runtimeRef.kind: unknown kind {job.runtime_ref.kind!r}")
+    t = job.trainer
+    if t is not None:
+        if t.num_nodes is not None and t.num_nodes < 1:
+            errs.append("trainer.numNodes: must be >= 1")
+        if t.num_proc_per_node is not None and t.num_proc_per_node < 1:
+            errs.append("trainer.numProcPerNode: must be >= 1")
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_training_runtime(rt: TrainingRuntime) -> None:
+    errs: List[str] = []
+    if not rt.metadata.name:
+        errs.append("metadata.name: required")
+    policies = [p for p in (rt.spec.ml_policy.torch, rt.spec.ml_policy.mpi,
+                            rt.spec.ml_policy.tpu) if p is not None]
+    if len(policies) > 1:
+        errs.append("mlPolicy: at most one of torch/mpi/tpu may be set")
+    if rt.spec.ml_policy.num_nodes < 1:
+        errs.append("mlPolicy.numNodes: must be >= 1")
+    trainer_rj = rt.spec.replicated_job(TRAINER_NODE)
+    if trainer_rj is not None and len(trainer_rj.template.containers) != 1:
+        # Reference trainingruntime_webhook.go:56-68: exactly one trainer
+        # container in the trainer-node replicated job.
+        errs.append(
+            f"template[{TRAINER_NODE}]: must have exactly one container "
+            f"(got {len(trainer_rj.template.containers)})"
+        )
+    if errs:
+        raise ValidationError(errs)

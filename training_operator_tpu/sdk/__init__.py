@@ -1,0 +1,12 @@
+"""Python client SDK.
+
+Parity target: reference sdk/python/kubeflow/training (TrainingClient at
+api/training_client.py:41 — create/get/list/patch/delete any job kind,
+wait_for_job_conditions, get_job_logs, and the high-level train() fine-tune
+entry at :95-314). The TPU-native train() targets the v2 TrainJob +
+TrainingRuntime surface instead of hand-assembling a PyTorchJob.
+"""
+
+from training_operator_tpu.sdk.client import TrainingClient
+
+__all__ = ["TrainingClient"]

@@ -1,0 +1,222 @@
+"""TrainingClient: the user-facing API.
+
+Parity map (reference sdk/python/kubeflow/training/api/training_client.py):
+  create_job (:428)            -> create_job
+  get_job (:640) / list_jobs (:744) / delete_job (:1440) / update_job (:584)
+  wait_for_job_conditions (:888) -> wait_for_job_conditions
+  get_job_conditions (:800)    -> get_job_conditions
+  is_job_running/succeeded/... (:846-886) -> same names
+  get_job_pod_names (:1060)    -> get_job_pod_names
+  get_job_logs (:1130)         -> get_job_logs (virtual substrate: the event
+                                  stream stands in for container stdout)
+  train (:95)                  -> train — TPU-native: submits a v2 TrainJob
+                                  wired to a TrainingRuntime with dataset /
+                                  model initializers, instead of assembling
+                                  a PyTorchJob + PVC by hand.
+
+The client talks to an in-process cluster (tests, simulation, benches) the
+way the reference's client talks to a kube-apiserver.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import training_operator_tpu.api.common as capi
+from training_operator_tpu.api.common import JobConditionType
+from training_operator_tpu.api.jobs import JOB_KINDS, Job
+from training_operator_tpu.cluster.apiserver import NotFoundError
+from training_operator_tpu.cluster.runtime import Cluster
+from training_operator_tpu.runtime.api import (
+    DatasetConfig,
+    ModelConfig,
+    RuntimeRef,
+    Trainer,
+    TrainJob,
+)
+from training_operator_tpu.api.jobs import ObjectMeta
+
+JOB_KIND_NAMES = tuple(JOB_KINDS) + ("TrainJob",)
+
+
+class TimeoutException(Exception):
+    pass
+
+
+class TrainingClient:
+    def __init__(
+        self,
+        cluster: Cluster,
+        namespace: str = "default",
+        job_kind: str = "JAXJob",
+    ):
+        self.cluster = cluster
+        self.api = cluster.api
+        self.namespace = namespace
+        self.job_kind = job_kind
+
+    # -- CRUD --------------------------------------------------------------
+
+    def create_job(
+        self,
+        job: Union[Job, TrainJob],
+        namespace: Optional[str] = None,
+    ) -> Union[Job, TrainJob]:
+        """Admission (defaulting + validation) happens server-side, exactly
+        as the reference's create_namespaced_custom_object path does."""
+        if namespace:
+            job.metadata.namespace = namespace
+        elif not job.metadata.namespace:
+            job.metadata.namespace = self.namespace
+        if isinstance(job, TrainJob):
+            if job.metadata.creation_time is None:
+                job.metadata.creation_time = self.cluster.clock.now()
+            return self.api.create(job)
+        from training_operator_tpu.api.defaults import default_job
+
+        default_job(job, now=self.cluster.clock.now())
+        return self.api.create(job)
+
+    def get_job(self, name: str, namespace: Optional[str] = None,
+                job_kind: Optional[str] = None):
+        return self.api.get(job_kind or self.job_kind, namespace or self.namespace, name)
+
+    def list_jobs(self, namespace: Optional[str] = None,
+                  job_kind: Optional[str] = None) -> List[Any]:
+        return self.api.list(job_kind or self.job_kind, namespace or self.namespace)
+
+    def update_job(self, job) -> Any:
+        return self.api.update(job, check_version=False)
+
+    def delete_job(self, name: str, namespace: Optional[str] = None,
+                   job_kind: Optional[str] = None) -> None:
+        self.api.delete(job_kind or self.job_kind, namespace or self.namespace, name)
+
+    # -- conditions --------------------------------------------------------
+
+    def get_job_conditions(self, name: str, namespace: Optional[str] = None,
+                           job_kind: Optional[str] = None) -> List[Any]:
+        job = self.get_job(name, namespace, job_kind)
+        return list(job.status.conditions)
+
+    def is_job_created(self, name: str, **kw) -> bool:
+        return self._has(name, JobConditionType.CREATED, **kw)
+
+    def is_job_running(self, name: str, **kw) -> bool:
+        return self._has(name, JobConditionType.RUNNING, **kw)
+
+    def is_job_restarting(self, name: str, **kw) -> bool:
+        return self._has(name, JobConditionType.RESTARTING, **kw)
+
+    def is_job_suspended(self, name: str, **kw) -> bool:
+        return self._has(name, JobConditionType.SUSPENDED, **kw)
+
+    def is_job_succeeded(self, name: str, **kw) -> bool:
+        return self._has(name, JobConditionType.SUCCEEDED, **kw)
+
+    def is_job_failed(self, name: str, **kw) -> bool:
+        return self._has(name, JobConditionType.FAILED, **kw)
+
+    def _has(self, name: str, cond: JobConditionType,
+             namespace: Optional[str] = None, job_kind: Optional[str] = None) -> bool:
+        job = self.get_job(name, namespace, job_kind)
+        c = capi.get_condition(job.status, cond)
+        return c is not None and c.status
+
+    def wait_for_job_conditions(
+        self,
+        name: str,
+        namespace: Optional[str] = None,
+        job_kind: Optional[str] = None,
+        expected_conditions: Sequence[JobConditionType] = (JobConditionType.SUCCEEDED,),
+        timeout: float = 600,
+        raise_on_failed: bool = True,
+    ):
+        """Drive the cluster until the job reaches one of the expected
+        conditions (reference training_client.py:888 — polling + watch).
+        Raises on Failed unless Failed is expected (same contract)."""
+        expected = set(expected_conditions)
+
+        def reached() -> bool:
+            try:
+                job = self.get_job(name, namespace, job_kind)
+            except NotFoundError:
+                return False
+            if raise_on_failed and JobConditionType.FAILED not in expected:
+                c = capi.get_condition(job.status, JobConditionType.FAILED)
+                if c is not None and c.status:
+                    raise RuntimeError(f"job {name} failed: {c.reason}: {c.message}")
+            return any(self._cond_true(job, e) for e in expected)
+
+        if self.cluster.run_until(reached, timeout=timeout):
+            return self.get_job(name, namespace, job_kind)
+        raise TimeoutException(
+            f"timeout waiting for {expected} on {job_kind or self.job_kind} {name}"
+        )
+
+    @staticmethod
+    def _cond_true(job, cond: JobConditionType) -> bool:
+        c = capi.get_condition(job.status, cond)
+        return c is not None and c.status
+
+    # -- pods / logs -------------------------------------------------------
+
+    def get_job_pod_names(self, name: str, namespace: Optional[str] = None,
+                          is_master: bool = False) -> List[str]:
+        ns = namespace or self.namespace
+        sel = {capi.JOB_NAME_LABEL: name}
+        if is_master:
+            sel[capi.JOB_ROLE_LABEL] = "master"
+        return sorted(p.name for p in self.api.list("Pod", ns, sel))
+
+    def get_job_logs(self, name: str, namespace: Optional[str] = None) -> Dict[str, str]:
+        """Pod name -> log text. The virtual substrate has no container
+        stdout; the per-object event stream is the observable log."""
+        ns = namespace or self.namespace
+        logs: Dict[str, str] = {}
+        for pod in self.api.list("Pod", ns, {capi.JOB_NAME_LABEL: name}):
+            events = self.api.events(object_name=name)
+            lines = [f"{e.timestamp:.3f} {e.event_type} {e.reason}: {e.message}"
+                     for e in events]
+            lines.append(f"phase={pod.status.phase.value} node={pod.node_name}")
+            logs[pod.name] = "\n".join(lines)
+        return logs
+
+    # -- high-level fine-tune ---------------------------------------------
+
+    def train(
+        self,
+        name: str,
+        runtime_ref: str = "tpu-jax-default",
+        runtime_kind: str = "ClusterTrainingRuntime",
+        namespace: Optional[str] = None,
+        model_uri: Optional[str] = None,
+        dataset_uri: Optional[str] = None,
+        output_uri: Optional[str] = None,
+        image: Optional[str] = None,
+        args: Optional[List[str]] = None,
+        env: Optional[Dict[str, str]] = None,
+        num_nodes: Optional[int] = None,
+        resources_per_node: Optional[Dict[str, float]] = None,
+    ) -> TrainJob:
+        """High-level LLM fine-tune entry (reference train(), :95-314):
+        one call wires model + dataset initializers and the trainer into a
+        declarative TrainJob; the runtime decides topology and bootstrap."""
+        job = TrainJob(
+            metadata=ObjectMeta(name=name, namespace=namespace or self.namespace),
+            runtime_ref=RuntimeRef(name=runtime_ref, kind=runtime_kind),
+            trainer=Trainer(
+                image=image,
+                args=list(args or []),
+                env=dict(env or {}),
+                num_nodes=num_nodes,
+                resources_per_node=dict(resources_per_node or {}),
+            ),
+            dataset_config=DatasetConfig(storage_uri=dataset_uri) if dataset_uri else None,
+            model_config=(
+                ModelConfig(input_storage_uri=model_uri, output_storage_uri=output_uri)
+                if (model_uri or output_uri) else None
+            ),
+        )
+        return self.create_job(job)
