@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Headline benchmark: 1k-job cold-start burst on a heterogeneous TPU+GPU pool.
+
+BASELINE.md configs 2 & 5: 1000 jobs (JAX TPU gangs of several shapes, GPU DDP
+gangs, CPU jobs) submitted at t=0 against 48 v5e-4x4 slices + 32x8-GPU nodes +
+CPU pool. Two full simulation runs, identical workload:
+
+  baseline — volcano-style gang scheduling (BaselinePlacer whole-slice mode:
+             topology-unaware schedulers force slice-granularity dedication,
+             so sub-slice jobs strand the rest of their slice)
+  packer   — the JAX batched placement engine (TPUPacker: contiguous ICI
+             sub-mesh packing, best-fit anti-fragmentation)
+  (--all-baselines adds the stronger contiguity-aware first-fit straw-man)
+
+The cluster runs on a virtual clock; each scheduler's real solve wall-time is
+charged into simulated time (GangScheduler charge_solve_time), so the p50
+schedule-to-running latency reflects both queueing quality (fragmentation)
+and actual solver speed on this machine's accelerator.
+
+Prints ONE JSON line:
+  metric      p50 schedule-to-running latency of the packer run (seconds)
+  vs_baseline baseline_p50 / packer_p50  (>1 = packer faster)
+  extras      p90/p99, makespan, TPU-chip utilization %, solver wall time
+
+Usage: python bench.py [--jobs N] [--seed S] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+
+import training_operator_tpu.api.common as capi
+from training_operator_tpu.api.common import Container, JobConditionType, PodTemplateSpec, ReplicaSpec
+from training_operator_tpu.api.jobs import JAXJob, ObjectMeta, PyTorchJob, TFJob, TPUPolicy
+from training_operator_tpu.cluster.inventory import (
+    GPU_RESOURCE,
+    TPU_RESOURCE,
+    make_cpu_pool,
+    make_gpu_pool,
+    make_tpu_pool,
+)
+from training_operator_tpu.cluster.objects import PodPhase
+from training_operator_tpu.cluster.runtime import (
+    ANNOTATION_SIM_DURATION,
+    Cluster,
+    DefaultScheduler,
+    SimKubelet,
+    VirtualClock,
+)
+from training_operator_tpu.controllers import OperatorManager, register_all
+from training_operator_tpu.scheduler import BaselinePlacer, GangScheduler, TPUPacker
+
+
+def build_workload(n_jobs: int, seed: int):
+    """Deterministic job mix. Returns a list of constructor thunks so each
+    run gets fresh objects."""
+    rng = random.Random(seed)
+    specs = []
+    for i in range(n_jobs):
+        r = rng.random()
+        dur = str(rng.randint(30, 120))
+        if r < 0.35:
+            specs.append(("jax", f"jax-sub-{i}", "2x4", 2, 1, dur))
+        elif r < 0.55:
+            specs.append(("jax", f"jax-host-{i}", "1x4", 1, 1, dur))
+        elif r < 0.70:
+            specs.append(("jax", f"jax-full-{i}", "4x4", 4, 1, dur))
+        elif r < 0.75:
+            specs.append(("jax", f"jax-multi-{i}", "4x4", 8, 2, dur))
+        elif r < 0.90:
+            gpus = rng.choice([4.0, 8.0])
+            workers = rng.choice([2, 4])
+            specs.append(("gpu", f"ddp-{i}", gpus, workers, 1, dur))
+        else:
+            specs.append(("cpu", f"tf-{i}", 2.0, rng.choice([1, 2]), 1, dur))
+    return specs
+
+
+def make_job(spec):
+    kind, name, shape, workers, num_slices, dur = spec
+    if kind == "jax":
+        chips = 1
+        for d in shape.split("x"):
+            chips *= int(d)
+        t = PodTemplateSpec(
+            containers=[Container(name="jax", image="trainer",
+                                  resources={"cpu": 1.0, TPU_RESOURCE: 4.0})]
+        )
+        t.annotations[ANNOTATION_SIM_DURATION] = dur
+        return JAXJob(
+            metadata=ObjectMeta(name=name),
+            replica_specs={"Worker": ReplicaSpec(replicas=workers, template=t)},
+            tpu_policy=TPUPolicy(accelerator=f"v5e-{chips}", topology=shape,
+                                 num_slices=num_slices),
+        )
+    if kind == "gpu":
+        t = PodTemplateSpec(
+            containers=[Container(name="pytorch", image="trainer",
+                                  resources={"cpu": 2.0, GPU_RESOURCE: shape})]
+        )
+        t.annotations[ANNOTATION_SIM_DURATION] = dur
+        return PyTorchJob(
+            metadata=ObjectMeta(name=name),
+            replica_specs={"Worker": ReplicaSpec(replicas=workers, template=t)},
+        )
+    t = PodTemplateSpec(
+        containers=[Container(name="tensorflow", image="trainer",
+                              resources={"cpu": shape})]
+    )
+    t.annotations[ANNOTATION_SIM_DURATION] = dur
+    return TFJob(
+        metadata=ObjectMeta(name=name),
+        replica_specs={"Worker": ReplicaSpec(replicas=workers, template=t)},
+    )
+
+
+def run_burst(specs, placer, tpu_slices=48, gpu_nodes=32, cpu_nodes=16):
+    cluster = Cluster(VirtualClock())
+    cluster.add_nodes(make_tpu_pool(tpu_slices, slice_topology="4x4"))
+    cluster.add_nodes(make_gpu_pool(gpu_nodes, gpus_per_node=8, nodes_per_nvlink_domain=4))
+    cluster.add_nodes(make_cpu_pool(cpu_nodes, cpu_per_node=64.0))
+    DefaultScheduler(cluster)
+    SimKubelet(cluster)
+    sched = GangScheduler(cluster, placer, charge_solve_time=True, prewarm=True)
+    mgr = OperatorManager(cluster, gang_enabled=True, reconciles_per_tick=4096)
+    register_all(mgr)
+
+    jobs = [make_job(s) for s in specs]
+    t_wall = time.perf_counter()
+    for j in jobs:
+        mgr.submit(j)
+
+    total_chips = tpu_slices * 16.0
+    # Schedule-to-running is captured from job status-update watch events
+    # (the Running condition is cleared by terminal conditions, so it must be
+    # read while live). O(events), not O(cluster x steps).
+    running_at = {}
+    job_kinds = {j.kind for j in jobs}
+    watch = cluster.api.watch(kinds=job_kinds)
+
+    def track():
+        for ev in watch.drain():
+            if ev.type != "Modified":
+                continue
+            j = ev.obj
+            if j.name in running_at:
+                continue
+            cond = capi.get_condition(j.status, JobConditionType.RUNNING)
+            if cond is not None and cond.status:
+                running_at[j.name] = cond.last_transition_time
+
+    cluster.add_ticker(track)
+
+    def all_done():
+        return all(capi.is_finished(j.status) for j in jobs)
+
+    ok = cluster.run_until(all_done, timeout=50_000, max_steps=5_000_000)
+    wall = time.perf_counter() - t_wall
+    if not ok:
+        unfinished = sum(1 for j in jobs if not capi.is_finished(j.status))
+        raise RuntimeError(f"burst did not finish: {unfinished} jobs pending")
+
+    latencies = []
+    for j in jobs:
+        created = j.metadata.creation_time or 0.0
+        if j.name in running_at:
+            latencies.append(running_at[j.name] - created)
+    latencies.sort()
+
+    def pct(p):
+        return latencies[min(len(latencies) - 1, int(p * len(latencies)))] if latencies else 0.0
+
+    # Utilization post-hoc from pod lifetimes: chip-seconds / capacity.
+    makespan = cluster.clock.now()
+    busy_area = 0.0
+    for p in cluster.api.list("Pod"):
+        chips = p.resources().get(TPU_RESOURCE, 0.0)
+        if chips and p.status.start_time is not None:
+            end = p.status.finish_time if p.status.finish_time is not None else makespan
+            busy_area += chips * (end - p.status.start_time)
+    utilization = busy_area / (total_chips * makespan) if makespan else 0.0
+    return {
+        "p50_s": round(pct(0.50), 3),
+        "p90_s": round(pct(0.90), 3),
+        "p99_s": round(pct(0.99), 3),
+        "makespan_s": round(makespan, 1),
+        "tpu_utilization": round(utilization, 4),
+        "solver_wall_s": round(sched.solve_walltime_total, 3),
+        "solver_cycles": sched.cycles,
+        "bench_wall_s": round(wall, 1),
+        "jobs_measured": len(latencies),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--jobs", type=int, default=1000)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--quick", action="store_true", help="100-job smoke run")
+    ap.add_argument("--all-baselines", action="store_true",
+                    help="also run the contiguity-aware first-fit straw-man")
+    args = ap.parse_args()
+    n = 100 if args.quick else args.jobs
+
+    specs = build_workload(n, args.seed)
+    base = run_burst(specs, BaselinePlacer(whole_slice=True))
+    pack = run_burst(specs, TPUPacker())
+    out = {
+        "metric": f"burst{n}_p50_schedule_to_running",
+        "value": pack["p50_s"],
+        "unit": "s",
+        "vs_baseline": round(base["p50_s"] / pack["p50_s"], 3) if pack["p50_s"] > 0 else float("inf"),
+        "utilization_gain_pp": round(100 * (pack["tpu_utilization"] - base["tpu_utilization"]), 1),
+        "packer": pack,
+        "baseline": base,
+    }
+    if args.all_baselines:
+        out["baseline_firstfit"] = run_burst(specs, BaselinePlacer(whole_slice=False))
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""Elasticity tests: HPA loop + incremental gang re-pack (BASELINE.md
+config 4: incremental re-pack on scale events, not full re-schedule)."""
+
+import training_operator_tpu.api.common as capi
+from training_operator_tpu.api.common import Container, PodTemplateSpec, ReplicaSpec
+from training_operator_tpu.api.jobs import ElasticPolicy, ObjectMeta, PyTorchJob
+from training_operator_tpu.cluster.inventory import GPU_RESOURCE, make_gpu_pool
+from training_operator_tpu.cluster.objects import PodGroupPhase, PodPhase
+from training_operator_tpu.cluster.runtime import (
+    Cluster,
+    DefaultScheduler,
+    SimKubelet,
+    VirtualClock,
+)
+from training_operator_tpu.controllers import OperatorManager, register_all
+from training_operator_tpu.scheduler import GangScheduler, TPUPacker
+from training_operator_tpu.scheduler.elastic import (
+    HorizontalAutoscaler,
+    StaticMetricsSource,
+)
+
+
+def elastic_job(name="el", min_r=2, max_r=6, metric_target=70.0):
+    t = PodTemplateSpec(
+        containers=[
+            Container(name="pytorch", image="img",
+                      resources={"cpu": 1.0, GPU_RESOURCE: 8.0})
+        ]
+    )
+    return PyTorchJob(
+        metadata=ObjectMeta(name=name),
+        replica_specs={"Worker": ReplicaSpec(replicas=min_r, template=t)},
+        elastic_policy=ElasticPolicy(
+            min_replicas=min_r, max_replicas=max_r,
+            metrics=[{"name": "gpu_util", "target": metric_target}],
+        ),
+    )
+
+
+def make_env(gang=True, nodes=8):
+    cluster = Cluster(VirtualClock())
+    cluster.add_nodes(make_gpu_pool(nodes, gpus_per_node=8, nodes_per_nvlink_domain=4))
+    DefaultScheduler(cluster)
+    SimKubelet(cluster)
+    metrics = StaticMetricsSource()
+    HorizontalAutoscaler(cluster, metrics, sync_period=5.0, stabilization_seconds=10.0)
+    if gang:
+        GangScheduler(cluster, TPUPacker())
+    mgr = OperatorManager(cluster, gang_enabled=gang)
+    register_all(mgr)
+    return cluster, mgr, metrics
+
+
+def worker_pods(cluster, name):
+    return [
+        p for p in cluster.api.list("Pod", "default", {capi.JOB_NAME_LABEL: name})
+        if p.status.phase == PodPhase.RUNNING
+    ]
+
+
+class TestAutoscaler:
+    def test_scale_out_on_high_utilization(self):
+        cluster, mgr, metrics = make_env()
+        mgr.submit(elastic_job())
+        assert cluster.run_until(lambda: len(worker_pods(cluster, "el")) == 2, timeout=60)
+        # 140% of target => desired = ceil(2 * 140/70) = 4.
+        metrics.set("default", "el", "gpu_util", 140.0)
+        assert cluster.run_until(lambda: len(worker_pods(cluster, "el")) == 4, timeout=120)
+        job = cluster.api.get("PyTorchJob", "default", "el")
+        assert job.replica_specs["Worker"].replicas == 4
+        hpa = cluster.api.get("HorizontalPodAutoscaler", "default", "el")
+        assert hpa.desired_replicas == 4
+
+    def test_scale_out_clamped_to_max(self):
+        cluster, mgr, metrics = make_env()
+        mgr.submit(elastic_job(max_r=3))
+        assert cluster.run_until(lambda: len(worker_pods(cluster, "el")) == 2, timeout=60)
+        metrics.set("default", "el", "gpu_util", 700.0)
+        assert cluster.run_until(lambda: len(worker_pods(cluster, "el")) == 3, timeout=120)
+
+    def test_scale_in_after_stabilization(self):
+        cluster, mgr, metrics = make_env()
+        mgr.submit(elastic_job(min_r=2, max_r=6))
+        assert cluster.run_until(lambda: len(worker_pods(cluster, "el")) == 2, timeout=60)
+        metrics.set("default", "el", "gpu_util", 140.0)
+        assert cluster.run_until(lambda: len(worker_pods(cluster, "el")) == 4, timeout=120)
+        metrics.set("default", "el", "gpu_util", 20.0)
+        # desired = ceil(4 * 20/70) = 2, after the stabilization window.
+        assert cluster.run_until(lambda: len(worker_pods(cluster, "el")) == 2, timeout=200)
+
+    def test_incremental_repack_keeps_existing_members(self):
+        """Scale-out must not move running pods (config 4: incremental
+        re-pack, not full re-schedule) and should prefer the gang's NVLink
+        domain for new members."""
+        cluster, mgr, metrics = make_env()
+        mgr.submit(elastic_job(min_r=2, max_r=4))
+        assert cluster.run_until(lambda: len(worker_pods(cluster, "el")) == 2, timeout=60)
+        before = {p.name: p.node_name for p in worker_pods(cluster, "el")}
+        metrics.set("default", "el", "gpu_util", 140.0)
+        assert cluster.run_until(lambda: len(worker_pods(cluster, "el")) == 4, timeout=120)
+        after = {p.name: p.node_name for p in worker_pods(cluster, "el")}
+        for name, node in before.items():
+            assert after[name] == node  # members did not move
+        pg = cluster.api.get("PodGroup", "default", "el")
+        assert pg.min_member == 4
+        domains = {
+            cluster.api.get("Node", "", n).accelerator.nvlink_domain
+            for n in after.values()
+        }
+        assert len(domains) == 1  # locality preserved on growth
+
+    def test_scale_in_releases_placement_entries(self):
+        cluster, mgr, metrics = make_env()
+        mgr.submit(elastic_job(min_r=2, max_r=6))
+        assert cluster.run_until(lambda: len(worker_pods(cluster, "el")) == 2, timeout=60)
+        metrics.set("default", "el", "gpu_util", 140.0)
+        assert cluster.run_until(lambda: len(worker_pods(cluster, "el")) == 4, timeout=120)
+        metrics.set("default", "el", "gpu_util", 20.0)
+        assert cluster.run_until(lambda: len(worker_pods(cluster, "el")) == 2, timeout=200)
+        pg = cluster.api.get("PodGroup", "default", "el")
+        assert len(pg.placement) == 2
+        assert set(pg.placement) == {"el-worker-0", "el-worker-1"}

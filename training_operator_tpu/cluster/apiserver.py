@@ -72,6 +72,9 @@ class APIServer:
 
     def __init__(self) -> None:
         self._objects: Dict[Tuple[str, str, str], Any] = {}
+        # Per-kind index so list(kind) doesn't scan the whole store — at
+        # 1k-job-burst scale the reconcilers list pods thousands of times.
+        self._by_kind: Dict[str, Dict[Tuple[str, str], Any]] = {}
         self._rv_value = 0
         self._watchers: List[WatchQueue] = []
         self._events: List[Event] = []
@@ -123,6 +126,7 @@ class APIServer:
             obj.metadata.ensure_uid(obj.KIND)
             obj.metadata.resource_version = self._next_rv()
             self._objects[key] = obj
+            self._by_kind.setdefault(key[0], {})[key[1:]] = obj
             self._notify("Added", obj)
             return obj
 
@@ -152,6 +156,7 @@ class APIServer:
                 )
             obj.metadata.resource_version = self._next_rv()
             self._objects[key] = obj
+            self._by_kind.setdefault(key[0], {})[key[1:]] = obj
             self._notify("Modified", obj, status_only=status_only)
             return obj
 
@@ -161,6 +166,7 @@ class APIServer:
             obj = self._objects.pop(key, None)
             if obj is None:
                 raise NotFoundError(f"{key} not found")
+            self._by_kind.get(kind, {}).pop(key[1:], None)
             self._notify("Deleted", obj)
             return obj
 
@@ -178,9 +184,7 @@ class APIServer:
     ) -> List[Any]:
         with self._lock:
             out = []
-            for (k, ns, _), obj in self._objects.items():
-                if k != kind:
-                    continue
+            for (ns, _), obj in self._by_kind.get(kind, {}).items():
                 if namespace is not None and ns != namespace:
                     continue
                 if label_selector:

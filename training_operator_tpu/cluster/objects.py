@@ -173,6 +173,10 @@ class PodGroup:
     num_slices: int = 1
     phase: PodGroupPhase = PodGroupPhase.PENDING
     placement: Dict[str, str] = field(default_factory=dict)  # pod name -> node name
+    # Nodes dedicated to this gang beyond its pod assignments (whole-slice
+    # allocation mode): their accelerator capacity is held until the gang's
+    # PodGroup is deleted.
+    reserved_nodes: List[str] = field(default_factory=list)
     placement_score: float = 0.0
     creation_attempts: int = 0
 

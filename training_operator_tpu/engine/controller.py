@@ -194,7 +194,12 @@ class JobController:
             pg = self._sync_podgroup(job)
             if self.podgroup_control.delay_pod_creation(pg):
                 delay_pods = True
-                self.requeue_after(key, 0.05)
+                # Admission is event-driven — the manager re-enqueues this job
+                # on the PodGroup's Modified event. The requeue is only a
+                # safety net, so keep it long: a tight poll here multiplies
+                # into reconcile storms under queue pressure (1k pending jobs
+                # x 20 polls/s was the bench bottleneck).
+                self.requeue_after(key, 30.0)
 
         # -- expectations gate ------------------------------------------
         if not self._satisfied_expectations(job):

@@ -1,12 +1,18 @@
-"""BaselinePlacer: volcano-style FIFO first-fit gang admission.
+"""BaselinePlacer: volcano-style FIFO gang admission, two fidelity modes.
 
-This is the comparison target from BASELINE.md (configs 2 & 5): what you get
-today by pointing the reference at Volcano with slice-type node selectors.
-Per pending group, in creation order, it takes the FIRST feasible placement —
-contiguity-feasible for TPU gangs (so placements are always valid meshes) but
-with no scoring: no best-fit, no fragmentation awareness, no batching. Partial
-gangs land on whichever slice is first in iteration order, which is exactly
-the behavior that strands full slices and inflates p50 for later big jobs.
+The comparison targets from BASELINE.md (configs 2 & 5):
+
+- `whole_slice=True` (default — "Volcano"): topology-unaware gang scheduling
+  as actually deployed for multi-host TPU slices. Volcano knows nothing
+  about ICI geometry, so correctness forces slice-granularity dedication
+  (per-slice node pools / one-job-per-slice selectors): every TPU gang takes
+  WHOLE fully-free slices, and a sub-slice job strands the remainder. This
+  is the fragmentation/utilization cost the tpu-packer exists to eliminate.
+
+- `whole_slice=False` ("first-fit"): a stronger straw-man that is already
+  contiguity-aware (equivalent to hand-maintained per-sub-slice selectors)
+  but takes the FIRST feasible placement per group in FIFO order — no
+  best-fit scoring, no batching.
 """
 
 from __future__ import annotations
@@ -24,10 +30,10 @@ from training_operator_tpu.scheduler.snapshot import (
 
 
 class BaselinePlacer:
-    name = "baseline-firstfit"
-
-    def __init__(self) -> None:
+    def __init__(self, whole_slice: bool = True) -> None:
         self.candidates = CandidateCache()
+        self.whole_slice = whole_slice
+        self.name = "baseline-volcano" if whole_slice else "baseline-firstfit"
 
     def place(
         self, requests: List[GangRequest], snapshot: ClusterSnapshot
@@ -48,6 +54,8 @@ class BaselinePlacer:
     def _place_tpu(
         self, req: GangRequest, snapshot: ClusterSnapshot
     ) -> Optional[Placement]:
+        if self.whole_slice:
+            return self._place_tpu_whole_slice(req, snapshot)
         assignments: Dict[str, str] = {}
         slices_used: List[str] = []
         committed: List[tuple] = []
@@ -86,6 +94,60 @@ class BaselinePlacer:
                 self._rollback(snapshot, committed)
                 return None
         return Placement(assignments=assignments, slices_used=slices_used)
+
+    def _place_tpu_whole_slice(
+        self, req: GangRequest, snapshot: ClusterSnapshot
+    ) -> Optional[Placement]:
+        """Slice-granularity dedication: each of the gang's num_slices shares
+        takes the first FULLY-free compatible slice; hosts beyond the pods'
+        need are reserved (stranded) for the job's lifetime."""
+        assignments: Dict[str, str] = {}
+        reserved: List[str] = []
+        slices_used: List[str] = []
+        committed: List[tuple] = []
+        pods = sorted(req.pods, key=lambda p: (p.replica_type, p.index))
+        if req.num_slices <= 0 or len(pods) % req.num_slices:
+            return None
+        pods_per_slice = len(pods) // req.num_slices
+        cursor = 0
+        taken = set()
+        for _ in range(req.num_slices):
+            found = False
+            for sl in snapshot.slices.values():
+                if sl.slice_id in taken:
+                    continue
+                if req.tpu_type and sl.tpu_type != req.tpu_type:
+                    continue
+                if pods_per_slice > sl.num_hosts:
+                    continue
+                if not all(
+                    snapshot.host_free(n, sl.chips_per_host) for n in sl.host_nodes
+                ):
+                    continue  # whole slice must be free
+                for pod, node in zip(
+                    pods[cursor : cursor + pods_per_slice], sl.host_nodes
+                ):
+                    assignments[pod.name] = node
+                    snapshot.commit(pod.resources, node)
+                    committed.append((pod.resources, node))
+                # Strand the rest of the slice: only hosts BEYOND the pods'
+                # need go into reserved_nodes (the documented contract).
+                for node in sl.host_nodes[pods_per_slice:]:
+                    reserved.append(node)
+                    strand = {TPU_RESOURCE: float(sl.chips_per_host)}
+                    snapshot.commit(strand, node)
+                    committed.append((strand, node))
+                slices_used.append(sl.slice_id)
+                taken.add(sl.slice_id)
+                cursor += pods_per_slice
+                found = True
+                break
+            if not found:
+                self._rollback(snapshot, committed)
+                return None
+        return Placement(
+            assignments=assignments, slices_used=slices_used, reserved_nodes=reserved
+        )
 
     # -- generic gangs (GPU/CPU) -------------------------------------------
 

@@ -1,0 +1,167 @@
+"""Elasticity: the HPA controller and incremental gang re-pack.
+
+Parity target: the reference delegates scaling to the Kubernetes HPA
+controller (it only creates/deletes the HPA object, pytorch/hpa.go:33-80) and
+torchrun handles membership changes in-process. Here both halves are
+first-class:
+
+- `HorizontalAutoscaler` — the HPA control loop: reads a metric source,
+  applies the k8s HPA formula (desired = ceil(current * actual/target),
+  clamped to [min,max], stabilized by a cooldown), and resizes the target
+  job's Worker replica count. The engine then creates/deletes pods
+  (scale-in removes the highest indices, matching torchrun's contract).
+
+- Incremental re-pack (BASELINE.md config 4): when an admitted gang grows,
+  `repack_grown_gangs` places ONLY the missing pods against the current
+  snapshot — existing members keep their nodes (no full re-schedule, no
+  job restart); placement entries of removed members are pruned so their
+  reservations release.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, List, Optional, Protocol
+
+from training_operator_tpu.api.jobs import REPLICA_WORKER
+from training_operator_tpu.cluster.objects import PodGroupPhase
+from training_operator_tpu.cluster.runtime import Cluster
+from training_operator_tpu.scheduler.snapshot import (
+    ClusterSnapshot,
+    GangRequest,
+    build_gang_request,
+    resolve_owner_job,
+)
+
+
+class MetricsSource(Protocol):
+    def get(self, namespace: str, target: str, metric: str) -> Optional[float]: ...
+
+
+class StaticMetricsSource:
+    """Settable metric values (tests/sim drive utilization signals)."""
+
+    def __init__(self) -> None:
+        self._values: Dict[tuple, float] = {}
+
+    def set(self, namespace: str, target: str, metric: str, value: float) -> None:
+        self._values[(namespace, target, metric)] = value
+
+    def get(self, namespace: str, target: str, metric: str) -> Optional[float]:
+        return self._values.get((namespace, target, metric))
+
+
+class HorizontalAutoscaler:
+    """The HPA control loop (what kube-controller-manager provides upstream)."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        metrics: Optional[MetricsSource] = None,
+        sync_period: float = 15.0,
+        stabilization_seconds: float = 60.0,
+    ):
+        self.cluster = cluster
+        self.api = cluster.api
+        self.metrics = metrics or StaticMetricsSource()
+        self.sync_period = sync_period
+        self.stabilization_seconds = stabilization_seconds
+        self._last_scale: Dict[str, float] = {}
+        self._next_sync = 0.0
+        cluster.add_ticker(self.tick)
+
+    def tick(self) -> None:
+        now = self.cluster.clock.now()
+        if now < self._next_sync:
+            return
+        self._next_sync = now + self.sync_period
+        for hpa in self.api.list("HorizontalPodAutoscaler"):
+            self._sync_one(hpa, now)
+
+    def _sync_one(self, hpa, now: float) -> None:
+        job = self.api.try_get(hpa.target_kind, hpa.namespace, hpa.target_name)
+        if job is None:
+            return
+        spec = job.replica_specs.get(REPLICA_WORKER)
+        if spec is None:
+            return
+        current = spec.replicas or 0
+        desired = current
+        for m in hpa.metrics:
+            name = m.get("name", "")
+            target = float(m.get("target", 0) or 0)
+            if target <= 0:
+                continue
+            actual = self.metrics.get(hpa.namespace, hpa.target_name, name)
+            if actual is None:
+                continue
+            # k8s HPA core formula; max over metrics.
+            desired = max(desired if desired != current else 0,
+                          math.ceil(current * actual / target))
+        if desired == 0:
+            desired = current
+        desired = max(hpa.min_replicas, min(hpa.max_replicas, desired))
+        hpa.current_replicas = current
+        hpa.desired_replicas = desired
+        if desired == current:
+            return
+        key = f"{hpa.namespace}/{hpa.name}"
+        if desired < current and now - self._last_scale.get(key, -1e9) < self.stabilization_seconds:
+            return  # downscale stabilization window
+        spec.replicas = desired
+        self._last_scale[key] = now
+        self.api.update(job, check_version=False)
+        self.api.update(hpa, check_version=False)
+
+
+def repack_grown_gangs(api, placer, snapshot_factory: Callable[[], ClusterSnapshot]) -> int:
+    """Incrementally place missing members of admitted gangs.
+
+    A gang that scaled out has pods in its (current) spec that carry no
+    placement entry; a gang that scaled in has stale entries whose pods are
+    gone. Stale entries are pruned (releasing their capacity reservation) and
+    the delta pods are solved as a mini-gang against a live snapshot;
+    existing members are untouched. Returns the number of groups updated.
+
+    The snapshot is built lazily — a cheap size check (spec replica count vs
+    placement entries) filters the common no-elastic case before any
+    O(cluster) work happens.
+    """
+    updated = 0
+    snapshot: Optional[ClusterSnapshot] = None
+    for pg in api.list("PodGroup"):
+        if pg.phase not in (PodGroupPhase.INQUEUE, PodGroupPhase.RUNNING):
+            continue
+        if not pg.placement:
+            continue
+        job = resolve_owner_job(api, pg)
+        if job is None or job.total_replicas() == len(pg.placement):
+            continue  # size matches: nothing grew or shrank
+        req = build_gang_request(api, pg)
+        if req is None:
+            continue
+        want = {p.name for p in req.pods}
+        have = set(pg.placement)
+        stale = have - want
+        missing = [p for p in req.pods if p.name not in have]
+        if not stale and not missing:
+            continue
+        if snapshot is None:
+            snapshot = snapshot_factory()
+        for name in stale:
+            pg.placement.pop(name, None)
+        if missing:
+            # Elastic membership is a generic (CPU/GPU) concern — the
+            # reference's ElasticPolicy is PyTorchJob-only; TPU gangs keep
+            # fixed meshes. topology=None routes the delta through the
+            # generic best-fit path (NVLink-locality bonus pulls new members
+            # toward the gang's existing domain).
+            delta = GangRequest(group=pg, pods=missing, topology=None, num_slices=1)
+            placements = placer.place([delta], snapshot)
+            placement = placements.get(delta.key)
+            if placement is not None:
+                pg.placement.update(placement.assignments)
+        pg.min_member = len(pg.placement)
+        api.update(pg, check_version=False)
+        updated += 1
+    return updated

@@ -45,10 +45,14 @@ class GangScheduler:
         cluster: Cluster,
         placer,
         charge_solve_time: bool = False,
+        prewarm: bool = False,
     ):
         self.cluster = cluster
         self.api = cluster.api
         self.placer = placer
+        # Compile the placer for this pool before the first cycle (one-time
+        # XLA compile; belongs to operator startup, not to job latency).
+        self._needs_prewarm = prewarm and hasattr(placer, "prewarm")
         # When benching on a VirtualClock, advance sim time by the real wall
         # time each solve took, so "p50 schedule-to-running" includes the
         # scheduler's own latency, not just queueing (BASELINE.md configs 2/5).
@@ -60,14 +64,28 @@ class GangScheduler:
         # (capacity freed, node added, new group). Informer-driven, like the
         # reference's event-triggered reconciles vs. Volcano's fixed period.
         self._solved_at_version: Optional[int] = None
+        self._bound_at_version: Optional[int] = None
         cluster.add_ticker(self.tick)
 
     # ------------------------------------------------------------------
 
     def tick(self) -> None:
+        if self._needs_prewarm:
+            self._needs_prewarm = False
+            self.placer.prewarm(ClusterSnapshot(self.api))
         self._admit_pending()
-        self._bind_pods()
-        self._advance_running()
+        # Binding / phase advancement / elastic re-pack scan the pod set —
+        # only worth re-running when something was written since the last
+        # pass (informer-style).
+        if self.api.version() != self._bound_at_version:
+            from training_operator_tpu.scheduler.elastic import repack_grown_gangs
+
+            repack_grown_gangs(
+                self.api, self.placer, lambda: ClusterSnapshot(self.api)
+            )
+            self._bind_pods()
+            self._advance_running()
+            self._bound_at_version = self.api.version()
 
     # ------------------------------------------------------------------
 
@@ -94,7 +112,6 @@ class GangScheduler:
             self._solved_at_version = version
             return
         placements = self.placer.place(requests, snapshot)
-        self._solved_at_version = self.api.version()
         wall = time.perf_counter() - t0
         self.solve_walltime_total += wall
         self.cycles += 1
@@ -108,6 +125,7 @@ class GangScheduler:
             placement = placements.get(req.key)
             if placement is not None:
                 pg.placement = dict(placement.assignments)
+                pg.reserved_nodes = list(placement.reserved_nodes)
                 pg.placement_score = placement.score
                 pg.phase = PodGroupPhase.INQUEUE
                 self.api.update(pg, check_version=False)
@@ -121,6 +139,9 @@ class GangScheduler:
                 # time advancement. Phase transitions are persisted by
                 # _check_timeouts.
                 pg.creation_attempts += 1
+        # Recorded AFTER our own admission writes so they don't immediately
+        # invalidate the gate and force a redundant re-solve next tick.
+        self._solved_at_version = self.api.version()
 
     def _check_timeouts(self, groups: List[PodGroup]) -> None:
         now = self.cluster.clock.now()
@@ -173,16 +194,21 @@ class GangScheduler:
             metrics.pods_bound.inc()
 
     def _advance_running(self) -> None:
-        for pg in self.api.list("PodGroup"):
-            if pg.phase != PodGroupPhase.INQUEUE or not pg.placement:
-                continue
-            pods = {
-                p.name: p
-                for p in self.api.list("Pod", pg.namespace)
-                if p.spec.annotations.get(PodGroupControl.POD_GROUP_ANNOTATION) == pg.name
-            }
+        inqueue = [
+            pg for pg in self.api.list("PodGroup")
+            if pg.phase == PodGroupPhase.INQUEUE and pg.placement
+        ]
+        if not inqueue:
+            return
+        by_group: Dict[str, List[Pod]] = {}
+        for p in self.api.list("Pod"):
+            g = p.spec.annotations.get(PodGroupControl.POD_GROUP_ANNOTATION)
+            if g:
+                by_group.setdefault(f"{p.namespace}/{g}", []).append(p)
+        for pg in inqueue:
+            pods = by_group.get(f"{pg.namespace}/{pg.name}", [])
             if len(pods) >= pg.min_member and all(
-                p.status.phase == PodPhase.RUNNING for p in pods.values()
+                p.status.phase == PodPhase.RUNNING for p in pods
             ):
                 pg.phase = PodGroupPhase.RUNNING
                 self.api.update(pg, check_version=False)

@@ -72,11 +72,13 @@ class GangRequest:
 @dataclass
 class Placement:
     """Solver output for one gang: pod name -> node name, plus the score the
-    solver assigned (higher = more contiguous / less fragmenting)."""
+    solver assigned (higher = more contiguous / less fragmenting).
+    `reserved_nodes` dedicates extra nodes to the gang (whole-slice mode)."""
 
     assignments: Dict[str, str]
     score: float = 0.0
     slices_used: List[str] = field(default_factory=list)
+    reserved_nodes: List[str] = field(default_factory=list)
 
 
 class ClusterSnapshot:
@@ -134,6 +136,19 @@ class ClusterSnapshot:
                     continue
                 for k, v in per_pod.get(pod_name, {}).items():
                     avail[k] = avail.get(k, 0.0) - v
+            # Whole-slice dedication: reserved nodes without a placed pod
+            # hold their full accelerator capacity for this gang.
+            placed_nodes = set(pg.placement.values())
+            for node_name in pg.reserved_nodes:
+                if node_name in placed_nodes:
+                    continue
+                node = self.nodes.get(node_name)
+                avail = self.free.get(node_name)
+                if node is None or avail is None:
+                    continue
+                chips = node.capacity.get(TPU_RESOURCE, 0.0)
+                if chips:
+                    avail[TPU_RESOURCE] = avail.get(TPU_RESOURCE, 0.0) - chips
 
     def _build_slices(self) -> Dict[str, SliceInfo]:
         by_slice: Dict[str, List[Node]] = {}
