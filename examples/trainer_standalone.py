@@ -1,0 +1,96 @@
+"""The trainer-image entry: what runs INSIDE a scheduled JAXJob pod.
+
+The TPU-native analogue of the reference's hf_llm_training.py (torchrun +
+transformers.Trainer): consumes the bootstrap env the operator injected
+(COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID / TPU_MESH_AXES), builds
+the mesh, shards the data by process, runs the jitted train step, and
+checkpoints — resumable after preemption or elastic re-mesh.
+
+Run (single host, virtual devices):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  TPU_MESH_AXES="fsdp=4,tensor=2" python examples/trainer_standalone.py
+"""
+
+import os as _os, sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import argparse
+import os
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--checkpoint-dir", default="/tmp/tpu-trainer-ckpt")
+    ap.add_argument("--resume", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    # Multi-process bootstrap straight from the operator's env contract.
+    num_processes = int(os.environ.get("NUM_PROCESSES", "1"))
+    if num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=(
+                f"{os.environ['COORDINATOR_ADDRESS']}:{os.environ['COORDINATOR_PORT']}"
+            ),
+            num_processes=num_processes,
+            process_id=int(os.environ["PROCESS_ID"]),
+        )
+
+    from training_operator_tpu.trainer.checkpoint import Checkpointer, restore_into_mesh
+    from training_operator_tpu.trainer.data import DataLoader, TokenDataset, process_shard
+    from training_operator_tpu.trainer.mesh import mesh_from_env
+    from training_operator_tpu.trainer.model import TransformerConfig
+    from training_operator_tpu.trainer.train import (
+        init_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    mesh = mesh_from_env()
+    print("mesh:", dict(mesh.shape))
+
+    config = TransformerConfig(
+        vocab_size=4096, d_model=256, n_layers=4, n_heads=8, d_ff=688,
+        max_seq_len=args.seq_len,
+    )
+    optimizer = make_optimizer(total_steps=args.steps)
+    if args.resume:
+        state = restore_into_mesh(args.checkpoint_dir, config, optimizer, mesh)
+        print("resumed at step", int(state.step))
+    else:
+        state = init_train_state(config, optimizer, jax.random.PRNGKey(0), mesh)
+
+    pid, nproc = process_shard()
+    dataset = TokenDataset.synthetic(
+        config.vocab_size, args.seq_len, num_rows=args.batch_size * 8,
+        process_id=pid, num_processes=nproc,
+    )
+    loader = DataLoader(dataset, args.batch_size, mesh)
+    step_fn = make_train_step(config, optimizer, mesh)
+    ckpt = Checkpointer(args.checkpoint_dir, save_interval_steps=10)
+
+    done = int(state.step)
+    epoch = 0
+    while done < args.steps:
+        for batch in loader.epoch(epoch):
+            state, metrics = step_fn(state, batch)
+            done = int(metrics["step"])
+            if done % 5 == 0 or done == args.steps:
+                print(f"step {done} loss {float(metrics['loss']):.4f}")
+            if done % 10 == 0:
+                ckpt.save(state)
+            if done >= args.steps:
+                break
+        epoch += 1
+    ckpt.save(state, force=True)  # final save regardless of interval
+    ckpt.close()
+    print("done at step", done)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""v2 path: a reusable TrainingRuntime + a one-line TrainJob via the SDK.
+
+Mirrors the reference's TrainJob/TrainingRuntime examples: the platform team
+publishes a ClusterTrainingRuntime once (topology, mesh, gang policy, base
+image); users submit TrainJobs that reference it, overriding only what they
+own (dataset, model, args, node count).
+
+Run: python examples/trainjob_v2.py
+"""
+
+import os as _os, sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+from training_operator_tpu.api.common import Container, PodTemplateSpec
+from training_operator_tpu.api.jobs import ObjectMeta, TPUPolicy
+from training_operator_tpu.cluster.inventory import TPU_RESOURCE, make_tpu_pool
+from training_operator_tpu.cluster.runtime import (
+    ANNOTATION_SIM_DURATION,
+    Cluster,
+    DefaultScheduler,
+    SimKubelet,
+    VirtualClock,
+)
+from training_operator_tpu.controllers import OperatorManager, register_all
+from training_operator_tpu.runtime import ClusterTrainingRuntime, MLPolicy
+from training_operator_tpu.runtime.api import (
+    CoschedulingPolicy,
+    PodGroupPolicy,
+    ReplicatedJobTemplate,
+    TrainingRuntimeSpec,
+    TRAINER_NODE,
+)
+from training_operator_tpu.runtime.controller import TrainJobManager
+from training_operator_tpu.scheduler import GangScheduler, TPUPacker
+from training_operator_tpu.sdk import TrainingClient
+
+
+def platform_runtime() -> ClusterTrainingRuntime:
+    template = PodTemplateSpec(
+        containers=[
+            Container(
+                name="trainer",
+                image="my-registry/jax-trainer:stable",
+                resources={"cpu": 4.0, TPU_RESOURCE: 4.0},
+            )
+        ]
+    )
+    template.annotations[ANNOTATION_SIM_DURATION] = "20"  # sim only
+    return ClusterTrainingRuntime(
+        metadata=ObjectMeta(name="v5e-16-pretrain", namespace=""),
+        spec=TrainingRuntimeSpec(
+            ml_policy=MLPolicy(
+                num_nodes=4,
+                tpu=TPUPolicy(accelerator="v5e-16", topology="4x4",
+                              mesh_axes={"fsdp": 8, "tensor": 2}),
+            ),
+            pod_group_policy=PodGroupPolicy(coscheduling=CoschedulingPolicy(300)),
+            template=[ReplicatedJobTemplate(name=TRAINER_NODE, replicas=4,
+                                            template=template)],
+        ),
+    )
+
+
+def main():
+    cluster = Cluster(VirtualClock())
+    cluster.add_nodes(make_tpu_pool(4, slice_topology="4x4"))
+    DefaultScheduler(cluster)
+    SimKubelet(cluster)
+    GangScheduler(cluster, TPUPacker())
+    v1 = OperatorManager(cluster, gang_enabled=True)
+    register_all(v1)
+    TrainJobManager(cluster)
+    client = TrainingClient(cluster)
+
+    cluster.api.create(platform_runtime())
+
+    client.train(
+        name="squad-finetune",
+        runtime_ref="v5e-16-pretrain",
+        dataset_uri="hf://rajpurkar/squad",
+        model_uri="hf://meta-llama/Llama-3.2-1B",
+        output_uri="file:///checkpoints/squad-finetune",
+        args=["--epochs", "3", "--lr", "2e-5"],
+    )
+    ok = cluster.run_until(
+        lambda: cluster.api.get("TrainJob", "default", "squad-finetune").is_finished(),
+        timeout=300,
+    )
+    tj = cluster.api.get("TrainJob", "default", "squad-finetune")
+    print("finished:", ok, "| conditions:",
+          [c.type.value for c in tj.status.conditions if c.status])
+
+
+if __name__ == "__main__":
+    main()

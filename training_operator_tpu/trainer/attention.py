@@ -115,10 +115,34 @@ def attention(
     v: jax.Array,
     mesh: Optional[Mesh] = None,
     causal: bool = True,
+    impl: str = "auto",
 ) -> jax.Array:
-    """Dispatch: ring attention when the mesh shards the sequence axis,
-    otherwise the fused single-shard path (tensor/data sharding of the plain
-    path is handled by XLA's sharding propagation)."""
+    """Dispatch: ring attention when the mesh shards the sequence axis;
+    otherwise the pallas flash kernel on TPU (when shapes tile cleanly) or
+    the XLA fused path. `impl`: "auto" | "flash" | "xla"."""
     if mesh is not None and axis_size(mesh, "sequence") > 1:
         return ring_attention(q, k, v, mesh, causal=causal)
+    if impl != "xla":
+        from training_operator_tpu.trainer.flash import flash_attention, flash_available
+
+        s, d = q.shape[1], q.shape[-1]
+        tiles = s % 128 == 0 and d in (64, 128, 256) and k.shape[2] == q.shape[2]
+        if impl == "flash" or (impl == "auto" and flash_available() and tiles):
+            interpret = not flash_available()
+            if mesh is None or all(n == 1 for n in mesh.shape.values()):
+                return flash_attention(q, k, v, causal, 128, 128, interpret)
+            # Sharded path: a pallas_call has no SPMD partitioning rule, so
+            # it must run per-device under shard_map (batch over data/fsdp,
+            # heads over tensor; sequence is unsharded on this branch).
+            h_local = q.shape[2] // axis_size(mesh, "tensor")
+            b_local = q.shape[0] // (
+                axis_size(mesh, "data") * axis_size(mesh, "fsdp")
+            )
+            if h_local >= 1 and b_local >= 1:
+                spec = P(BATCH_AXES, None, "tensor", None)
+                fn = lambda a, b_, c: flash_attention(a, b_, c, causal, 128, 128, interpret)
+                return jax.shard_map(
+                    fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                    check_vma=False,
+                )(q, k, v)
     return plain_attention(q, k, v, causal=causal)

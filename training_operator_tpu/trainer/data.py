@@ -1,0 +1,111 @@
+"""Data pipeline: process-sharded token batches.
+
+Parity target: the reference trainer's `split_dataset_by_node(RANK,
+WORLD_SIZE)` (sdk/python/kubeflow/trainer/hf_llm_training.py:31-120) — each
+process reads only its shard. Here the shard identity comes from the env the
+operator injects (PROCESS_ID / NUM_PROCESSES, controllers/jax.py) and global
+device arrays are assembled per batch with the mesh's batch sharding, so the
+loader feeds a jit-compiled step without host-side gather.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from training_operator_tpu.trainer.mesh import batch_sharding
+
+
+def process_shard(environ: Optional[Dict[str, str]] = None) -> Tuple[int, int]:
+    """(process_id, num_processes) from the operator-injected bootstrap env."""
+    e = os.environ if environ is None else environ
+    return int(e.get("PROCESS_ID", "0")), int(e.get("NUM_PROCESSES", "1"))
+
+
+def pack_tokens(tokens: np.ndarray, seq_len: int) -> np.ndarray:
+    """Pack a flat token stream into [N, seq_len+1] rows (input+target via
+    shift); trailing remainder is dropped."""
+    row = seq_len + 1
+    n = len(tokens) // row
+    return np.asarray(tokens[: n * row], dtype=np.int32).reshape(n, row)
+
+
+class TokenDataset:
+    """Fixed-length LM rows with deterministic per-process sharding."""
+
+    def __init__(self, rows: np.ndarray, process_id: int = 0, num_processes: int = 1):
+        # Equal-size contiguous shards, remainder dropped: every process must
+        # see the SAME number of batches or SPMD collectives deadlock when
+        # one process enters an extra step (split_dataset_by_node semantics).
+        per = len(rows) // num_processes
+        self.rows = rows[process_id * per : (process_id + 1) * per]
+
+    @classmethod
+    def synthetic(cls, vocab_size: int, seq_len: int, num_rows: int, seed: int = 0,
+                  process_id: int = 0, num_processes: int = 1) -> "TokenDataset":
+        rng = np.random.RandomState(seed)
+        rows = rng.randint(0, vocab_size, size=(num_rows, seq_len + 1)).astype(np.int32)
+        return cls(rows, process_id, num_processes)
+
+    @classmethod
+    def from_env(cls, rows: np.ndarray) -> "TokenDataset":
+        pid, n = process_shard()
+        return cls(rows, pid, n)
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+
+class DataLoader:
+    """Yields device-ready batches: {tokens, targets, mask} placed with the
+    mesh's (data x fsdp, sequence) sharding."""
+
+    def __init__(
+        self,
+        dataset: TokenDataset,
+        batch_size: int,
+        mesh: Optional[Mesh] = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+    ):
+        if batch_size > len(dataset):
+            raise ValueError(
+                f"batch_size {batch_size} exceeds dataset shard of {len(dataset)} rows"
+            )
+        if mesh is not None and not drop_last:
+            # A partial tail batch cannot be laid out on the (data x fsdp)
+            # axis; fail at construction, not mid-epoch.
+            raise ValueError("drop_last=False is incompatible with a sharded mesh")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.mesh = mesh
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+
+    def __iter__(self) -> Iterator[Dict[str, jax.Array]]:
+        return self.epoch(0)
+
+    def epoch(self, epoch: int) -> Iterator[Dict[str, jax.Array]]:
+        rows = self.dataset.rows
+        order = np.arange(len(rows))
+        if self.shuffle:
+            np.random.RandomState(self.seed + epoch).shuffle(order)
+        end = (len(rows) // self.batch_size) * self.batch_size if self.drop_last else len(rows)
+        for start in range(0, end, self.batch_size):
+            chunk = rows[order[start : start + self.batch_size]]
+            batch = {
+                "tokens": chunk[:, :-1],
+                "targets": chunk[:, 1:],
+                "mask": np.ones_like(chunk[:, 1:], dtype=np.float32),
+            }
+            if self.mesh is not None:
+                sharding = batch_sharding(self.mesh)
+                yield {k: jax.device_put(v, sharding) for k, v in batch.items()}
+            else:
+                yield {k: jax.numpy.asarray(v) for k, v in batch.items()}

@@ -1,0 +1,159 @@
+"""Pallas flash attention: the hot-op kernel of the trainer runtime.
+
+Classic blocked online-softmax attention tiled for the MXU: grid
+(batch*heads, q_blocks, k_blocks) with the k axis innermost — TPU grids run
+sequentially, so the running max / denominator / accumulator live in VMEM
+scratch across k steps and the output block is written exactly once on the
+last step. Causal q/k block pairs that are fully masked are skipped with
+`pl.when` (predicated execution), halving the work for causal LMs.
+
+Training: wrapped in `jax.custom_vjp` — the forward runs the kernel, the
+backward recomputes attention with the XLA reference implementation and
+differentiates that (flash backward = recompute by construction; this keeps
+the memory win where it matters, in the forward residuals).
+
+Layout: [B, S, H, D] at the API (matching attention.py); internally folded to
+[B*H, S, D]. Block sizes default to MXU-friendly 128.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from jax.experimental.pallas import tpu as pltpu
+
+_MASK = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                  *, scale: float, causal: bool, block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _MASK)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: skip blocks strictly above the diagonal (kpos_min > qpos_max).
+    run = True
+    if causal:
+        run = ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)  # (BQ, D)
+        k = k_ref[0].astype(jnp.float32)  # (BK, D)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (BQ, BK)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _MASK)
+        m_prev = m_ref[:]  # (BQ, 1)
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * corr + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _flash_fwd_impl(
+    q: jax.Array, k: jax.Array, v: jax.Array, *,
+    causal: bool, block_q: int, block_k: int, interpret: bool,
+) -> jax.Array:
+    b, s, h, d = q.shape
+    if s % block_q or s % block_k:
+        # Out-of-range padded K rows would silently inflate the softmax
+        # denominator — refuse rather than return wrong numbers.
+        raise ValueError(
+            f"flash_attention requires seq len divisible by block sizes "
+            f"(s={s}, block_q={block_q}, block_k={block_k}); use the XLA path"
+        )
+    scale = d ** -0.5
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    grid = (b * h, pl.cdiv(s, bq), pl.cdiv(s, bk))
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+    )
+    scratch = [
+        pltpu.VMEM((bq, 1), jnp.float32),
+        pltpu.VMEM((bq, 1), jnp.float32),
+        pltpu.VMEM((bq, d), jnp.float32),
+    ]
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _reference(q, k, v, causal):
+    from training_operator_tpu.trainer.attention import plain_attention
+
+    return plain_attention(q, k, v, causal=causal)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    causal: bool = True, block_q: int = 128, block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention on [B, S, H, D]; `interpret=True` runs the kernel in
+    the Pallas interpreter (CPU tests)."""
+    return _flash_fwd_impl(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k, interpret=interpret
+    )
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_fwd_impl(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k, interpret=interpret
+    )
+    return out, (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, interpret, res, g):
+    # Recompute-based backward: differentiate the XLA reference (flash
+    # backward IS recompute; XLA fuses this well and it is exact).
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: _reference(a, b, c, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+def flash_available() -> bool:
+    return jax.default_backend() == "tpu"

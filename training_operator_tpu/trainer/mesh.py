@@ -93,6 +93,26 @@ def mesh_from_env(devices: Optional[Sequence] = None) -> Mesh:
     else:
         n = len(devices) if devices is not None else len(jax.devices())
         spec = MeshSpec.for_devices(n)
+    if devices is None and spec.size() > len(jax.devices()):
+        # Too few devices on the default backend. Falling back to virtual
+        # CPU devices is only acceptable for dry runs — a production TPU pod
+        # with a short device count is a misconfiguration that must fail
+        # loudly, not silently train on CPU.
+        allow = (
+            jax.default_backend() == "cpu"
+            or os.environ.get("TRAINER_ALLOW_CPU_MESH") == "1"
+        )
+        if allow:
+            try:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "mesh_from_env: falling back to virtual CPU devices for a "
+                    "%d-device mesh (dry-run mode)", spec.size(),
+                )
+                devices = jax.devices("cpu")
+            except RuntimeError:
+                pass
     return build_mesh(spec, devices)
 
 

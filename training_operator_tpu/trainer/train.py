@@ -71,7 +71,59 @@ def init_train_state(
         lambda k: init_params(config, k), out_shardings=shardings
     )(key)
     opt_state = jax.jit(optimizer.init)(params)
-    return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
+    # Param-shaped moments inherit the params' shardings through init; scalar
+    # leaves (e.g. AdamW's count) land on one device and must be replicated
+    # across the mesh or jit rejects the mixed-device state.
+    # Compare device objects, not ids — ids are only unique per backend
+    # (cpu:0 and tpu:0 share id 0).
+    mesh_devices = set(mesh.devices.flat)
+
+    def span_mesh(leaf):
+        if (
+            isinstance(leaf, jax.Array)
+            and set(leaf.sharding.device_set) != mesh_devices
+        ):
+            return jax.device_put(
+                leaf, NamedSharding(mesh, P(*([None] * leaf.ndim)))
+            )
+        return leaf
+
+    opt_state = jax.tree.map(span_mesh, opt_state)
+    step = jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(mesh, P()))
+    return TrainState(step=step, params=params, opt_state=opt_state)
+
+
+def template_train_state(
+    config: TransformerConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+) -> TrainState:
+    """A zero-filled TrainState with production sharding layout — the
+    checkpoint-restore target. Skips the RNG init compute (restore overwrites
+    every value; only shapes/dtypes/shardings matter)."""
+    p_struct = jax.eval_shape(
+        lambda k: init_params(config, k), jax.random.PRNGKey(0)
+    )
+    zeros = lambda: jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), p_struct)
+    if mesh is None:
+        params = zeros()
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=optimizer.init(params))
+    params = jax.jit(zeros, out_shardings=param_shardings(config, mesh))()
+    opt_state = jax.jit(optimizer.init)(params)
+    mesh_devices = set(mesh.devices.flat)
+
+    def span_mesh(leaf):
+        if (
+            isinstance(leaf, jax.Array)
+            and set(leaf.sharding.device_set) != mesh_devices
+        ):
+            return jax.device_put(leaf, NamedSharding(mesh, P(*([None] * leaf.ndim))))
+        return leaf
+
+    opt_state = jax.tree.map(span_mesh, opt_state)
+    step = jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(mesh, P()))
+    return TrainState(step=step, params=params, opt_state=opt_state)
 
 
 def make_train_step(
