@@ -120,3 +120,31 @@ class TestAutoscaler:
         pg = cluster.api.get("PodGroup", "default", "el")
         assert len(pg.placement) == 2
         assert set(pg.placement) == {"el-worker-0", "el-worker-1"}
+
+
+    def test_metric_demanding_current_capacity_blocks_downscale(self):
+        """A metric proposing exactly `current` replicas must win over a
+        later metric proposing fewer (max-over-metrics, no 0-sentinel)."""
+        t = PodTemplateSpec(
+            containers=[
+                Container(name="pytorch", image="img",
+                          resources={"cpu": 1.0, GPU_RESOURCE: 8.0})
+            ]
+        )
+        job = PyTorchJob(
+            metadata=ObjectMeta(name="el"),
+            replica_specs={"Worker": ReplicaSpec(replicas=2, template=t)},
+            elastic_policy=ElasticPolicy(
+                min_replicas=1, max_replicas=6,
+                metrics=[{"name": "gpu_util", "target": 70.0},
+                         {"name": "queue_depth", "target": 100.0}],
+            ),
+        )
+        cluster, mgr, metrics = make_env()
+        mgr.submit(job)
+        assert cluster.run_until(lambda: len(worker_pods(cluster, "el")) == 2, timeout=60)
+        metrics.set("default", "el", "gpu_util", 70.0)     # proposes exactly 2
+        metrics.set("default", "el", "queue_depth", 10.0)  # proposes 1
+        cluster.run_for(60)  # well past the downscale stabilization window
+        job = cluster.api.get("PyTorchJob", "default", "el")
+        assert job.replica_specs["Worker"].replicas == 2

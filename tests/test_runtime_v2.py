@@ -238,3 +238,26 @@ class TestV2Validation:
         rt2.spec.template[0].template.containers.append(Container(name="extra", image="x"))
         with pytest.raises(ValidationError):
             v2.submit(rt2)
+
+
+class TestReconcileRetry:
+    def test_failed_reconcile_retries_the_failed_key(self):
+        """A reconcile failure must re-enqueue the key that failed, not the
+        last key drained in the same tick (late-binding closure regression)."""
+        cluster, v2 = make_env(gang=False)
+        calls = []
+
+        def fake_reconcile(ns, name):
+            calls.append(name)
+            if name == "bad":
+                raise RuntimeError("boom")
+
+        v2.controller.reconcile = fake_reconcile
+        v2.queue.add("default/bad")
+        v2.queue.add("default/ok")
+        v2.tick()
+        assert calls == ["bad", "ok"]
+        calls.clear()
+        cluster.run_for(30)  # past the failure backoff delay
+        assert "bad" in calls
+        assert "ok" not in calls

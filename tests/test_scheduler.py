@@ -270,3 +270,47 @@ class TestSnapshotAccounting:
             cluster.step()
         pg_b = cluster.api.get("PodGroup", "default", "jb")
         assert pg_b.phase == PodGroupPhase.PENDING
+
+
+class TestDistinctSlices:
+    def test_sub_slice_multi_slice_gang_lands_on_distinct_slices(self):
+        """A multi-slice gang with sub-slice topology must occupy one distinct
+        physical slice per sub-request (inter-slice traffic rides DCN; two
+        sub-meshes on one slice would break the assumed topology)."""
+        cluster, mgr = make_gang_env(TPUPacker(), slices=2)
+        # 2x4 = 2 hosts per slice on a 4-host 4x4 slice; both subs fit on
+        # slice-0 capacity-wise, so only the distinct-slice constraint forces
+        # them apart.
+        job = make_jax_job("ring", workers=4, topology="2x4", num_slices=2, duration=5)
+        mgr.submit(job)
+        assert cluster.run_until(
+            lambda: capi.is_succeeded(cluster.api.get("JAXJob", "default", "ring").status),
+            timeout=120,
+        )
+        pods = cluster.api.list("Pod", "default", {capi.JOB_NAME_LABEL: "ring"})
+        assert len(pods) == 4
+        slices = {p.node_name.rsplit("-host-", 1)[0] for p in pods}
+        assert len(slices) == 2
+
+    def test_generic_gang_never_lands_on_tpu_hosts(self):
+        """A CPU/GPU gang in a TPU-only pool stays pending instead of
+        silently consuming TPU-host capacity."""
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_tpu_pool(1, slice_topology="4x4"))
+        DefaultScheduler(cluster)
+        SimKubelet(cluster)
+        GangScheduler(cluster, TPUPacker())
+        mgr = OperatorManager(cluster, gang_enabled=True)
+        register_all(mgr)
+        t = PodTemplateSpec(
+            containers=[Container(name="pytorch", image="img", resources={"cpu": 1.0})]
+        )
+        job = PyTorchJob(
+            metadata=ObjectMeta(name="cpu-gang"),
+            replica_specs={"Worker": ReplicaSpec(replicas=2, template=t)},
+        )
+        mgr.submit(job)
+        cluster.run_for(10)
+        assert cluster.api.list("Pod", "default", {capi.JOB_NAME_LABEL: "cpu-gang"}) == []
+        pg = cluster.api.get("PodGroup", "default", "cpu-gang")
+        assert pg.phase == PodGroupPhase.PENDING

@@ -183,7 +183,7 @@ class TrainJobManager:
             except Exception:
                 log.exception("trainjob reconcile failed for %s", key)
                 delay = self.queue.failure_delay(key)
-                self.cluster.schedule_after(delay, lambda: self.queue.add(key))
+                self.cluster.schedule_after(delay, lambda k=key: self.queue.add(k))
             else:
                 self.queue.forget(key)
 

@@ -86,7 +86,7 @@ class HorizontalAutoscaler:
         if spec is None:
             return
         current = spec.replicas or 0
-        desired = current
+        proposals = []
         for m in hpa.metrics:
             name = m.get("name", "")
             target = float(m.get("target", 0) or 0)
@@ -96,10 +96,8 @@ class HorizontalAutoscaler:
             if actual is None:
                 continue
             # k8s HPA core formula; max over metrics.
-            desired = max(desired if desired != current else 0,
-                          math.ceil(current * actual / target))
-        if desired == 0:
-            desired = current
+            proposals.append(math.ceil(current * actual / target))
+        desired = max(proposals) if proposals else current
         desired = max(hpa.min_replicas, min(hpa.max_replicas, desired))
         hpa.current_replicas = current
         hpa.desired_replicas = desired

@@ -40,6 +40,7 @@ from training_operator_tpu.scheduler.snapshot import (
     ClusterSnapshot,
     GangRequest,
     Placement,
+    SliceInfo,
     request_hosts_per_slice,
 )
 
@@ -307,6 +308,17 @@ class TPUPacker:
                 failed.add(req.key)
                 continue
             partial.setdefault(req.key, []).append((sub, int(choice[g])))
+
+        # Every host the kernel granted this cycle to a gang that will be
+        # stitched: a distinct-slice repair below must never take one. Grants
+        # to partially-admitted gangs (in `failed`) are excluded — those are
+        # never stitched, so their hosts are genuinely available for repair.
+        kernel_taken = np.zeros((len(slices), h_max), dtype=bool)
+        for g, (req, sub, k) in enumerate(items):
+            if ok[g] and req.key not in failed:
+                sidx, m, _rank = class_cands[k][int(choice[g])]
+                kernel_taken[sidx] |= m
+
         for req in ordered:
             if req.key in failed or req.key not in partial:
                 continue
@@ -314,10 +326,41 @@ class TPUPacker:
             pods = sorted(req.pods, key=lambda p: (p.replica_type, p.index))
             pods_per_slice = len(pods) // req.num_slices
             k = class_ids[(req.tpu_type, req.topology, pods_per_slice)]
+
+            # Distinct-slice constraint: each sub-request owns its own
+            # physical slice (inter-slice traffic rides DCN; two sub-meshes
+            # co-located on one slice break the job's assumed topology). The
+            # kernel desynchronizes identical items by candidate rank, which
+            # usually — but not provably — lands them on different slices;
+            # duplicates are repaired here against untouched free hosts, or
+            # the whole gang forfeits this cycle.
+            picked: List[Tuple[int, Tuple[int, np.ndarray, int]]] = []
+            used_slices: set = set()
+            dups: List[int] = []
+            for sub, c in subs:
+                cand = class_cands[k][c]
+                if cand[0] in used_slices:
+                    dups.append(sub)
+                else:
+                    used_slices.add(cand[0])
+                    picked.append((sub, cand))
+            repaired = True
+            for sub in dups:
+                alt = self._repair_duplicate_slice(
+                    class_cands[k], used_slices, kernel_taken, snapshot, slices
+                )
+                if alt is None:
+                    repaired = False
+                    break
+                used_slices.add(alt[0])
+                kernel_taken[alt[0]] |= alt[1]
+                picked.append((sub, alt))
+            if not repaired:
+                continue  # gang stays pending; fresh solve next cycle
+
             assignments: Dict[str, str] = {}
             slices_used: List[str] = []
-            for sub, c in subs:
-                sidx, m, _rank = class_cands[k][c]
+            for sub, (sidx, m, _rank) in sorted(picked):
                 sl = slices[sidx]
                 hosts = [sl.host_nodes[h] for h in range(sl.num_hosts) if m[h]]
                 for pod, node in zip(
@@ -328,6 +371,31 @@ class TPUPacker:
                 slices_used.append(sl.slice_id)
             out[req.key] = Placement(assignments=assignments, slices_used=slices_used)
         return out
+
+    @staticmethod
+    def _repair_duplicate_slice(
+        cands: List[Tuple[int, np.ndarray, int]],
+        used_slices: set,
+        kernel_taken: np.ndarray,
+        snapshot: ClusterSnapshot,
+        slices: List[SliceInfo],
+    ) -> Optional[Tuple[int, np.ndarray, int]]:
+        """Find an alternative candidate on a slice the gang does not already
+        use, whose hosts are free in the live snapshot and were not granted to
+        any gang by this cycle's kernel solve."""
+        for sidx, m, rank in cands:
+            if sidx in used_slices:
+                continue
+            if np.any(m & kernel_taken[sidx]):
+                continue
+            sl = slices[sidx]
+            if all(
+                snapshot.host_free(sl.host_nodes[h], sl.chips_per_host)
+                for h in range(sl.num_hosts)
+                if m[h]
+            ):
+                return (sidx, m, rank)
+        return None
 
     # ------------------------------------------------------------------
     # Generic (GPU/CPU) batch solve — vectorized best-fit + NVLink locality
@@ -342,7 +410,10 @@ class TPUPacker:
             if snapshot.nodes[n].accelerator.kind != "tpu"
         ]
         if not node_names:
-            node_names = list(snapshot.free)
+            # No non-TPU node exists: generic gangs stay pending rather than
+            # invisibly consuming TPU-host capacity out from under the TPU
+            # gang solve.
+            return {r.key: None for r in requests}
         res_keys = sorted({k for n in node_names for k in snapshot.free[n]})
         ridx = {k: i for i, k in enumerate(res_keys)}
         free = np.zeros((len(node_names), len(res_keys)))
