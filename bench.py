@@ -203,8 +203,29 @@ def main():
     ap.add_argument("--quick", action="store_true", help="100-job smoke run")
     ap.add_argument("--all-baselines", action="store_true",
                     help="also run the contiguity-aware first-fit straw-man")
+    trainer_group = ap.add_mutually_exclusive_group()
+    trainer_group.add_argument("--no-trainer", action="store_true",
+                               help="skip the single-chip trainer compute benchmark")
+    trainer_group.add_argument("--trainer-only", action="store_true",
+                               help="run only the trainer compute benchmark")
     args = ap.parse_args()
     n = 100 if args.quick else args.jobs
+
+    trainer = None
+    if not args.no_trainer:
+        from training_operator_tpu.trainer.bench import run_trainer_bench
+
+        trainer = run_trainer_bench(steps=5 if args.quick else 10)
+        if args.trainer_only:
+            ts = trainer.get("train_step", {})
+            print(json.dumps({
+                "metric": "trainer_tokens_per_s",
+                "value": ts.get("tokens_per_s"),
+                "unit": "tokens/s",
+                "vs_baseline": None,
+                "trainer": trainer,
+            }))
+            return
 
     specs = build_workload(n, args.seed)
     base = run_burst(specs, BaselinePlacer(whole_slice=True))
@@ -218,6 +239,8 @@ def main():
         "packer": pack,
         "baseline": base,
     }
+    if trainer is not None:
+        out["trainer"] = trainer
     if args.all_baselines:
         out["baseline_firstfit"] = run_burst(specs, BaselinePlacer(whole_slice=False))
     print(json.dumps(out))

@@ -130,7 +130,7 @@ def attention(
         if impl == "flash" or (impl == "auto" and flash_available() and tiles):
             interpret = not flash_available()
             if mesh is None or all(n == 1 for n in mesh.shape.values()):
-                return flash_attention(q, k, v, causal, 128, 128, interpret)
+                return flash_attention(q, k, v, causal, 512, 1024, interpret)
             # Sharded path: a pallas_call has no SPMD partitioning rule, so
             # it must run per-device under shard_map (batch over data/fsdp,
             # heads over tensor; sequence is unsharded on this branch).
@@ -140,7 +140,7 @@ def attention(
             )
             if h_local >= 1 and b_local >= 1:
                 spec = P(BATCH_AXES, None, "tensor", None)
-                fn = lambda a, b_, c: flash_attention(a, b_, c, causal, 128, 128, interpret)
+                fn = lambda a, b_, c: flash_attention(a, b_, c, causal, 512, 1024, interpret)
                 return jax.shard_map(
                     fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
                     check_vma=False,
