@@ -73,7 +73,14 @@ class Pod:
         return self.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED)
 
     def resources(self) -> Dict[str, float]:
-        return self.spec.resources()
+        # Memoized: pod resource requests are immutable after creation (k8s
+        # semantics), and the placement snapshot sums them for every bound
+        # pod on every scheduling cycle.
+        memo = self.__dict__.get("_resources_memo")
+        if memo is None:
+            memo = self.spec.resources()
+            self.__dict__["_resources_memo"] = memo
+        return memo
 
     def effective_restart_policy(self) -> RestartPolicy:
         return self.spec.restart_policy or RestartPolicy.ON_FAILURE

@@ -36,8 +36,13 @@ class BaselinePlacer:
         self.name = "baseline-volcano" if whole_slice else "baseline-firstfit"
 
     def place(
-        self, requests: List[GangRequest], snapshot: ClusterSnapshot
+        self,
+        requests: List[GangRequest],
+        snapshot: ClusterSnapshot,
+        now: Optional[float] = None,
     ) -> Dict[str, Optional[Placement]]:
+        # `now` is accepted for placer-interface parity and ignored: the
+        # baseline is strict-FIFO by definition (that is what it models).
         out: Dict[str, Optional[Placement]] = {}
         ordered = sorted(
             requests, key=lambda r: r.group.metadata.creation_time or 0.0
@@ -59,7 +64,7 @@ class BaselinePlacer:
         assignments: Dict[str, str] = {}
         slices_used: List[str] = []
         committed: List[tuple] = []
-        pods = sorted(req.pods, key=lambda p: (p.replica_type, p.index))
+        pods = req.sorted_pods()
         pods_per_slice = len(pods) // req.num_slices if req.num_slices else 0
         if pods_per_slice * req.num_slices != len(pods):
             return None
@@ -105,7 +110,7 @@ class BaselinePlacer:
         reserved: List[str] = []
         slices_used: List[str] = []
         committed: List[tuple] = []
-        pods = sorted(req.pods, key=lambda p: (p.replica_type, p.index))
+        pods = req.sorted_pods()
         if req.num_slices <= 0 or len(pods) % req.num_slices:
             return None
         pods_per_slice = len(pods) // req.num_slices
@@ -160,7 +165,7 @@ class BaselinePlacer:
             n for n in snapshot.free
             if snapshot.nodes[n].accelerator.kind != "tpu"
         ] or list(snapshot.free)
-        for pod in sorted(req.pods, key=lambda p: (p.replica_type, p.index)):
+        for pod in req.sorted_pods():
             placed = False
             for name in node_names:  # first fit
                 if snapshot.fits(name, pod.resources):

@@ -21,7 +21,7 @@ first-class:
 from __future__ import annotations
 
 import math
-from typing import Callable, Dict, List, Optional, Protocol
+from typing import Callable, Dict, List, Optional, Protocol, Tuple
 
 from training_operator_tpu.api.jobs import REPLICA_WORKER
 from training_operator_tpu.cluster.objects import PodGroupPhase
@@ -112,20 +112,26 @@ class HorizontalAutoscaler:
         self.api.update(hpa, check_version=False)
 
 
-def repack_grown_gangs(api, placer, snapshot_factory: Callable[[], ClusterSnapshot]) -> int:
+def repack_grown_gangs(
+    api, placer, snapshot_factory: Callable[[], ClusterSnapshot]
+) -> Tuple[int, int]:
     """Incrementally place missing members of admitted gangs.
 
     A gang that scaled out has pods in its (current) spec that carry no
     placement entry; a gang that scaled in has stale entries whose pods are
     gone. Stale entries are pruned (releasing their capacity reservation) and
     the delta pods are solved as a mini-gang against a live snapshot;
-    existing members are untouched. Returns the number of groups updated.
+    existing members are untouched. Returns (groups updated, groups whose
+    delta could NOT be fully placed) — callers must retry the latter when
+    capacity frees (the job spec still exceeds the placement size, so the
+    size check below re-detects them).
 
     The snapshot is built lazily — a cheap size check (spec replica count vs
     placement entries) filters the common no-elastic case before any
     O(cluster) work happens.
     """
     updated = 0
+    unsatisfied = 0
     snapshot: Optional[ClusterSnapshot] = None
     for pg in api.list("PodGroup"):
         if pg.phase not in (PodGroupPhase.INQUEUE, PodGroupPhase.RUNNING):
@@ -159,7 +165,9 @@ def repack_grown_gangs(api, placer, snapshot_factory: Callable[[], ClusterSnapsh
             placement = placements.get(delta.key)
             if placement is not None:
                 pg.placement.update(placement.assignments)
+            else:
+                unsatisfied += 1
         pg.min_member = len(pg.placement)
         api.update(pg, check_version=False)
         updated += 1
-    return updated
+    return updated, unsatisfied

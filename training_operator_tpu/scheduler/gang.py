@@ -33,6 +33,7 @@ from training_operator_tpu.engine.control import PodGroupControl
 from training_operator_tpu.scheduler.snapshot import (
     ClusterSnapshot,
     build_gang_request,
+    resolve_owner_job,
 )
 from training_operator_tpu.utils import metrics
 
@@ -46,6 +47,8 @@ class GangScheduler:
         placer,
         charge_solve_time: bool = False,
         prewarm: bool = False,
+        resolve_period: float = 15.0,
+        min_solve_interval: float = 0.0,
     ):
         self.cluster = cluster
         self.api = cluster.api
@@ -59,35 +62,167 @@ class GangScheduler:
         self.charge_solve_time = charge_solve_time
         self.solve_walltime_total = 0.0
         self.cycles = 0
-        # Solves are skipped while the API state is unchanged — a gang that
-        # didn't fit at version V cannot fit until something is written
-        # (capacity freed, node added, new group). Informer-driven, like the
-        # reference's event-triggered reconciles vs. Volcano's fixed period.
-        self._solved_at_version: Optional[int] = None
-        self._bound_at_version: Optional[int] = None
+        # Event-driven solving: a gang that didn't fit cannot fit until an
+        # event that frees capacity (pod terminal/deleted, node change) or
+        # changes demand (PodGroup created/reset, job spec resized) — status
+        # churn alone never does. A periodic re-solve bounds the staleness of
+        # anything the event rules miss. Informer-style, like the reference's
+        # event-triggered reconciles vs. Volcano's fixed period.
+        self.resolve_period = resolve_period
+        # Coalescing: a dirty event within min_solve_interval of the last
+        # solve defers (a wakeup timer guarantees the deferred solve runs),
+        # so a burst of pod completions is admitted against one snapshot by
+        # one solve instead of one per completion instant. Trades a bounded
+        # admission delay for fewer, larger solves.
+        self.min_solve_interval = min_solve_interval
+        self._wakeup_armed = False
+        self._watch = cluster.api.watch()
+        self._solve_dirty = True
+        self._bind_dirty = True
+        self._advance_dirty = True
+        self._repack_dirty = False
+        self._repack_unsatisfied = False
+        self._capacity_freed = False
+        self._last_solve_at = -float("inf")
+        # Informer caches maintained from watch events (initial LIST below):
+        # unbound gang pods awaiting binding, and pods grouped by PodGroup.
+        self._unbound: Dict[tuple, Pod] = {}
+        self._group_pods: Dict[str, Dict[str, Pod]] = {}
+        self._bound_active: Dict[tuple, Pod] = {}
+        for pod in self.api.list("Pod"):
+            self._observe_pod("Added", pod)
+        # Cross-cycle memos: expanded GangRequests keyed by PodGroup uid and
+        # the snapshot's per-gang pod-request cache (both invalidated by the
+        # owning job's resourceVersion).
+        self._req_cache: Dict[str, tuple] = {}
+        self._pod_req_cache: Dict[str, tuple] = {}
         cluster.add_ticker(self.tick)
 
     # ------------------------------------------------------------------
 
+    def _snapshot(self) -> ClusterSnapshot:
+        return ClusterSnapshot(
+            self.api,
+            self._pod_req_cache,
+            bound_pods=self._bound_active.values(),
+        )
+
+    def _observe_pod(self, ev_type: str, pod: Pod) -> None:
+        key = (pod.namespace, pod.name)
+        if ev_type != "Deleted" and pod.node_name and not pod.is_terminal():
+            self._bound_active[key] = pod
+        else:
+            self._bound_active.pop(key, None)
+        gname = pod.spec.annotations.get(PodGroupControl.POD_GROUP_ANNOTATION)
+        if gname:
+            gkey = f"{pod.namespace}/{gname}"
+            if ev_type == "Deleted":
+                self._group_pods.get(gkey, {}).pop(pod.name, None)
+            else:
+                self._group_pods.setdefault(gkey, {})[pod.name] = pod
+            self._advance_dirty = True
+        if (
+            ev_type != "Deleted"
+            and not pod.node_name
+            and pod.status.phase == PodPhase.PENDING
+            and pod.spec.scheduler_name == PodGroupControl.SCHEDULER_NAME
+        ):
+            self._unbound[key] = pod
+            self._bind_dirty = True
+        else:
+            self._unbound.pop(key, None)
+
+    def _drain_events(self) -> None:
+        for ev in self._watch.drain():
+            kind, obj = ev.kind, ev.obj
+            if kind == "Pod":
+                self._observe_pod(ev.type, obj)
+                # Capacity is freed when a pod terminates or disappears.
+                if ev.type == "Deleted" or obj.is_terminal():
+                    self._solve_dirty = True
+                    self._capacity_freed = True
+            elif kind == "PodGroup":
+                if ev.type in ("Added", "Deleted") or obj.phase == PodGroupPhase.PENDING:
+                    self._solve_dirty = True
+                self._bind_dirty = True
+                self._advance_dirty = True
+                if ev.type == "Deleted":
+                    self._group_pods.pop(f"{obj.namespace}/{obj.name}", None)
+                    self._req_cache.pop(obj.metadata.uid, None)
+                    self._pod_req_cache.pop(obj.metadata.uid, None)
+                    self._solve_dirty = True  # reservations released
+                    self._capacity_freed = True
+            elif kind == "Node":
+                self._solve_dirty = True
+                self._bind_dirty = True
+                self._capacity_freed = True
+            elif (
+                ev.type == "Modified"
+                and not ev.status_only
+                and hasattr(obj, "replica_specs")
+            ):
+                # A job spec change (elastic resize) can grow an admitted
+                # gang (re-pack) or resize a still-pending one (re-solve).
+                self._repack_dirty = True
+                self._solve_dirty = True
+
     def tick(self) -> None:
         if self._needs_prewarm:
             self._needs_prewarm = False
-            self.placer.prewarm(ClusterSnapshot(self.api))
+            self.placer.prewarm(self._snapshot())
+        self._drain_events()
         self._admit_pending()
-        # Binding / phase advancement / elastic re-pack scan the pod set —
-        # only worth re-running when something was written since the last
-        # pass (informer-style).
-        if self.api.version() != self._bound_at_version:
+        # Repack runs on job-spec resizes AND retries unsatisfied deltas
+        # whenever capacity frees — a grown gang whose delta didn't fit must
+        # not stall until the next spec write (the HPA writes nothing once
+        # desired == spec).
+        if self._repack_dirty or (self._repack_unsatisfied and self._capacity_freed):
             from training_operator_tpu.scheduler.elastic import repack_grown_gangs
 
-            repack_grown_gangs(
-                self.api, self.placer, lambda: ClusterSnapshot(self.api)
+            self._repack_dirty = False
+            updated, unsatisfied = repack_grown_gangs(
+                self.api, self.placer, self._snapshot
             )
+            self._repack_unsatisfied = unsatisfied > 0
+            if updated:
+                self._bind_dirty = True
+        self._capacity_freed = False
+        if self._bind_dirty:
+            self._bind_dirty = False
             self._bind_pods()
+        if self._advance_dirty:
+            self._advance_dirty = False
             self._advance_running()
-            self._bound_at_version = self.api.version()
 
     # ------------------------------------------------------------------
+
+    def _wakeup(self) -> None:
+        # No-op timer body: existing so the virtual clock has a reason to
+        # stop at the deferred-solve instant; the tick that follows solves.
+        self._wakeup_armed = False
+
+    def _gang_request(self, pg: PodGroup):
+        """build_gang_request with a (job rv, group shape)-keyed memo — the
+        replica expansion is pure given those inputs."""
+        job = resolve_owner_job(self.api, pg)
+        if job is None:
+            return None
+        ck = (
+            job.KIND,
+            job.metadata.resource_version,
+            pg.topology_request,
+            pg.num_slices,
+            pg.min_member,
+        )
+        hit = self._req_cache.get(pg.metadata.uid)
+        if hit is not None and hit[0] == ck:
+            req = hit[1]
+            req.group = pg  # rebind to the current object
+            return req
+        req = build_gang_request(self.api, pg)
+        if req is not None:
+            self._req_cache[pg.metadata.uid] = (ck, req)
+        return req
 
     def _admit_pending(self) -> None:
         groups = [
@@ -98,20 +233,29 @@ class GangScheduler:
         if not groups:
             return
         self._check_timeouts(groups)
-        version = self.api.version()
-        if version == self._solved_at_version:
+        now = self.cluster.clock.now()
+        since_last = now - self._last_solve_at
+        if not self._solve_dirty and since_last < self.resolve_period:
+            return
+        if self._solve_dirty and since_last < self.min_solve_interval:
+            if not self._wakeup_armed:
+                self._wakeup_armed = True
+                self.cluster.schedule_after(
+                    self.min_solve_interval - since_last, self._wakeup
+                )
             return
         t0 = time.perf_counter()
-        snapshot = ClusterSnapshot(self.api)
+        snapshot = self._snapshot()
         requests = []
         for pg in groups:
-            req = build_gang_request(self.api, pg)
+            req = self._gang_request(pg)
             if req is not None:
                 requests.append(req)
+        self._solve_dirty = False
+        self._last_solve_at = now
         if not requests:
-            self._solved_at_version = version
             return
-        placements = self.placer.place(requests, snapshot)
+        placements = self.placer.place(requests, snapshot, now=now)
         wall = time.perf_counter() - t0
         self.solve_walltime_total += wall
         self.cycles += 1
@@ -139,9 +283,9 @@ class GangScheduler:
                 # time advancement. Phase transitions are persisted by
                 # _check_timeouts.
                 pg.creation_attempts += 1
-        # Recorded AFTER our own admission writes so they don't immediately
-        # invalidate the gate and force a redundant re-solve next tick.
-        self._solved_at_version = self.api.version()
+        # Our own admission writes (phase -> INQUEUE) echo back through the
+        # watch but do not match any dirty rule, so they don't force a
+        # redundant re-solve next tick.
 
     def _check_timeouts(self, groups: List[PodGroup]) -> None:
         now = self.cluster.clock.now()
@@ -162,19 +306,16 @@ class GangScheduler:
     # ------------------------------------------------------------------
 
     def _bind_pods(self) -> None:
+        if not self._unbound:
+            return
         groups: Dict[str, PodGroup] = {
             f"{pg.namespace}/{pg.name}": pg for pg in self.api.list("PodGroup")
         }
         nodes = {n.name for n in self.api.list("Node") if not n.unschedulable}
-        for pod in self.api.list("Pod"):
-            if (
-                pod.node_name
-                or pod.status.phase != PodPhase.PENDING
-                or pod.spec.scheduler_name != PodGroupControl.SCHEDULER_NAME
-            ):
-                continue
+        for key, pod in list(self._unbound.items()):
             pg_name = pod.spec.annotations.get(PodGroupControl.POD_GROUP_ANNOTATION)
             if not pg_name:
+                self._unbound.pop(key, None)
                 continue
             pg = groups.get(f"{pod.namespace}/{pg_name}")
             if pg is None or pg.phase == PodGroupPhase.PENDING:
@@ -191,6 +332,7 @@ class GangScheduler:
                             f"node {target} is gone; re-solving")
                 continue
             bind_pod(self.api, pod, target, now=self.cluster.clock.now())
+            self._unbound.pop(key, None)
             metrics.pods_bound.inc()
 
     def _advance_running(self) -> None:
@@ -200,13 +342,8 @@ class GangScheduler:
         ]
         if not inqueue:
             return
-        by_group: Dict[str, List[Pod]] = {}
-        for p in self.api.list("Pod"):
-            g = p.spec.annotations.get(PodGroupControl.POD_GROUP_ANNOTATION)
-            if g:
-                by_group.setdefault(f"{p.namespace}/{g}", []).append(p)
         for pg in inqueue:
-            pods = by_group.get(f"{pg.namespace}/{pg.name}", [])
+            pods = list(self._group_pods.get(f"{pg.namespace}/{pg.name}", {}).values())
             if len(pods) >= pg.min_member and all(
                 p.status.phase == PodPhase.RUNNING for p in pods
             ):

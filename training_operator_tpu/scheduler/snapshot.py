@@ -10,7 +10,7 @@ structure, and the pending gangs expanded to per-pod resource requests.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from training_operator_tpu.api.jobs import Job
 from training_operator_tpu.cluster.apiserver import APIServer
@@ -57,13 +57,26 @@ class GangRequest:
     topology: Optional[str] = None
     num_slices: int = 1
     tpu_type: str = ""
+    _sorted_pods: Optional[List[PodRequest]] = None
+    _total_chips: Optional[float] = None
 
     @property
     def key(self) -> str:
         return f"{self.group.namespace}/{self.group.name}"
 
+    def sorted_pods(self) -> List[PodRequest]:
+        """Pods in (replica_type, index) order — the per-slice assignment
+        order. Memoized: requests are re-solved every cycle but immutable."""
+        if self._sorted_pods is None:
+            self._sorted_pods = sorted(self.pods, key=lambda p: (p.replica_type, p.index))
+        return self._sorted_pods
+
     def total_chips(self) -> float:
-        return sum(p.resources.get(TPU_RESOURCE, 0.0) for p in self.pods)
+        if self._total_chips is None:
+            self._total_chips = sum(
+                p.resources.get(TPU_RESOURCE, 0.0) for p in self.pods
+            )
+        return self._total_chips
 
     def is_tpu(self) -> bool:
         return self.topology is not None
@@ -91,43 +104,70 @@ class ClusterSnapshot:
     guards on the pod-creation side).
     """
 
-    def __init__(self, api: APIServer):
+    def __init__(
+        self,
+        api: APIServer,
+        pod_requests_cache: Optional[Dict[str, Tuple[int, Dict[str, Dict[str, float]]]]] = None,
+        bound_pods: Optional[Iterable] = None,
+    ):
         self.api = api
+        # Optional cross-snapshot memo for per-gang pod requests, keyed by
+        # PodGroup uid -> (owning job resourceVersion, per-pod requests).
+        # Snapshots are rebuilt every scheduling cycle but job specs rarely
+        # change; the owner resolve + replica expansion dominates build time
+        # at 1k-gang scale without it.
+        self._requests_cache = pod_requests_cache
         self.nodes: Dict[str, Node] = {n.name: n for n in api.list("Node")}
         self.free: Dict[str, Dict[str, float]] = {
             name: dict(n.capacity)
             for name, n in self.nodes.items()
             if not n.unschedulable
         }
-        self._subtract_bound_pods()
-        self._subtract_admitted_reservations()
+        # `bound_pods`: an informer-maintained view of bound non-terminal
+        # pods (GangScheduler keeps one from watch events). Without it the
+        # full pod list — which accumulates terminal pods until TTL cleanup —
+        # is scanned on every snapshot.
+        bound = self._subtract_bound_pods(bound_pods)
+        self._subtract_admitted_reservations(bound)
         self.slices = self._build_slices()
 
     # -- construction ------------------------------------------------------
 
-    def _subtract_bound_pods(self) -> None:
-        for pod in self.api.list("Pod"):
+    def _subtract_bound_pods(self, bound_pods: Optional[Iterable]) -> set:
+        bound = set()
+        pods = bound_pods if bound_pods is not None else self.api.list("Pod")
+        for pod in pods:
             if not pod.node_name or pod.is_terminal():
                 continue
+            bound.add((pod.namespace, pod.name))
             avail = self.free.get(pod.node_name)
             if avail is None:
                 continue
             for k, v in pod.resources().items():
                 avail[k] = avail.get(k, 0.0) - v
+        return bound
 
-    def _subtract_admitted_reservations(self) -> None:
-        bound = {
-            (p.namespace, p.name)
-            for p in self.api.list("Pod")
-            if p.node_name and not p.is_terminal()
-        }
+    def _pod_requests_for(self, pg: PodGroup) -> Dict[str, Dict[str, float]]:
+        job = resolve_owner_job(self.api, pg)
+        if job is None:
+            return {}
+        if self._requests_cache is None:
+            return job_pod_requests(job)
+        rv = job.metadata.resource_version
+        hit = self._requests_cache.get(pg.metadata.uid)
+        if hit is not None and hit[0] == rv:
+            return hit[1]
+        per_pod = job_pod_requests(job)
+        self._requests_cache[pg.metadata.uid] = (rv, per_pod)
+        return per_pod
+
+    def _subtract_admitted_reservations(self, bound: set) -> None:
         for pg in self.api.list("PodGroup"):
             if pg.phase not in (PodGroupPhase.INQUEUE, PodGroupPhase.RUNNING):
                 continue
             if not pg.placement:
                 continue
-            job = resolve_owner_job(self.api, pg)
-            per_pod = job_pod_requests(job) if job is not None else {}
+            per_pod = self._pod_requests_for(pg)
             for pod_name, node_name in pg.placement.items():
                 if (pg.namespace, pod_name) in bound:
                     continue  # already accounted as a bound pod
