@@ -80,6 +80,9 @@ def _chips(shape: str) -> int:
     return chips
 
 
+CHIPS_PER_SLICE = _chips(SLICE_TOPOLOGY)
+
+
 def _pct(sorted_vals, p):
     return sorted_vals[min(len(sorted_vals) - 1, int(p * len(sorted_vals)))] if sorted_vals else 0.0
 
@@ -149,7 +152,7 @@ def make_job(spec):
 
 def oracle_bound(
     specs,
-    tpu_chips=TPU_SLICES * HOSTS_PER_SLICE * 4.0,
+    tpu_chips=TPU_SLICES * float(CHIPS_PER_SLICE),
     gpus=GPU_NODES * float(GPUS_PER_NODE),
     cpus=CPU_NODES * CPU_PER_NODE,
 ):
@@ -356,11 +359,12 @@ def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nod
     for j in jobs:
         mgr.submit(j)
 
-    total_chips = tpu_slices * 16.0
+    total_chips = tpu_slices * float(CHIPS_PER_SLICE)
     # Schedule-to-running is captured from job status-update watch events
     # (the Running condition is cleared by terminal conditions, so it must be
     # read while live). O(events), not O(cluster x steps).
     running_at = {}
+    finished = set()
     job_kinds = {j.kind for j in jobs}
     watch = cluster.api.watch(kinds=job_kinds)
 
@@ -369,6 +373,8 @@ def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nod
             if ev.type != "Modified":
                 continue
             j = ev.obj
+            if capi.is_finished(j.status):
+                finished.add(j.name)
             if j.name in running_at:
                 continue
             cond = capi.get_condition(j.status, JobConditionType.RUNNING)
@@ -394,10 +400,10 @@ def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nod
             return
         frag_state["next"] = now + 5.0
         used = set()
-        for p in cluster.api.list("Pod"):
+        for p in cluster.informer.list("Pod"):
             if p.node_name and not p.is_terminal() and p.resources().get(TPU_RESOURCE, 0):
                 used.add(p.node_name)
-        for pg in cluster.api.list("PodGroup"):
+        for pg in cluster.informer.list("PodGroup"):
             if pg.phase in (PodGroupPhase.INQUEUE, PodGroupPhase.RUNNING):
                 used.update(pg.reserved_nodes)
                 used.update(pg.placement.values())
@@ -414,13 +420,14 @@ def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nod
     cluster.add_ticker(frag_tick)
 
     def all_done():
-        return all(capi.is_finished(j.status) for j in jobs)
+        # Copy-on-read: submitted objects never mutate in our hands; terminal
+        # states are collected from watch events in track() above.
+        return len(finished) >= len(jobs)
 
     ok = cluster.run_until(all_done, timeout=50_000, max_steps=5_000_000)
     wall = time.perf_counter() - t_wall
     if not ok:
-        unfinished = sum(1 for j in jobs if not capi.is_finished(j.status))
-        raise RuntimeError(f"burst did not finish: {unfinished} jobs pending")
+        raise RuntimeError(f"burst did not finish: {len(jobs) - len(finished)} jobs pending")
 
     latencies = []
     for j in jobs:
@@ -432,7 +439,8 @@ def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nod
     # Utilization post-hoc from pod lifetimes: chip-seconds / capacity.
     makespan = cluster.clock.now()
     busy_area = 0.0
-    for p in cluster.api.list("Pod"):
+    cluster.informer.sync()  # absorb the final completion events
+    for p in cluster.informer.list("Pod"):
         chips = p.resources().get(TPU_RESOURCE, 0.0)
         if chips and p.status.start_time is not None:
             end = p.status.finish_time if p.status.finish_time is not None else makespan

@@ -93,6 +93,80 @@ class TestAPIServer:
             api.create(make_pod("p1"))
 
 
+class TestCopyOnRead:
+    """The aliasing-proof semantics real k8s has: reads are copies, in-place
+    mutation never reaches the store, version checks have no identity escape."""
+
+    def test_get_returns_copy(self):
+        api = APIServer()
+        api.create(make_pod("p1"))
+        read = api.get("Pod", "default", "p1")
+        read.status.phase = PodPhase.FAILED
+        read.metadata.labels["injected"] = "yes"
+        fresh = api.get("Pod", "default", "p1")
+        assert fresh.status.phase == PodPhase.PENDING
+        assert "injected" not in fresh.metadata.labels
+
+    def test_list_returns_copies(self):
+        api = APIServer()
+        api.create(make_pod("p1"))
+        api.list("Pod")[0].node_name = "hacked"
+        assert api.get("Pod", "default", "p1").node_name == ""
+
+    def test_create_detaches_caller_object(self):
+        api = APIServer()
+        pod = make_pod("p1")
+        api.create(pod)
+        pod.status.phase = PodPhase.FAILED  # caller-side mutation
+        assert api.get("Pod", "default", "p1").status.phase == PodPhase.PENDING
+
+    def test_same_identity_stale_write_conflicts(self):
+        """The old `current is not obj` escape let a component that held the
+        live instance skip the version check entirely; with copies + strict
+        comparison, a stale write always conflicts."""
+        api = APIServer()
+        api.create(make_pod("p1"))
+        a = api.get("Pod", "default", "p1")
+        b = api.get("Pod", "default", "p1")
+        a.node_name = "n1"
+        api.update(a)
+        b.node_name = "n2"
+        with pytest.raises(ConflictError):
+            api.update(b)  # lost update surfaced, not silently applied
+        assert api.get("Pod", "default", "p1").node_name == "n1"
+
+    def test_label_index_tracks_updates(self):
+        api = APIServer()
+        api.create(make_pod("a", labels={"job": "x", "role": "w"}))
+        api.create(make_pod("b", labels={"job": "x", "role": "m"}))
+        assert {p.name for p in api.list("Pod", None, {"job": "x"})} == {"a", "b"}
+        assert [p.name for p in api.list("Pod", None, {"job": "x", "role": "m"})] == ["b"]
+        # Relabel a; the index must follow.
+        a = api.get("Pod", "default", "a")
+        a.metadata.labels["job"] = "y"
+        api.update(a)
+        assert [p.name for p in api.list("Pod", None, {"job": "x"})] == ["b"]
+        assert [p.name for p in api.list("Pod", None, {"job": "y"})] == ["a"]
+        api.delete("Pod", "default", "b")
+        assert api.list("Pod", None, {"job": "x"}) == []
+
+    def test_shared_informer_lags_then_converges(self):
+        cluster = Cluster(VirtualClock())
+        cluster.api.create(make_pod("p1"))
+        # Not yet synced: the informer hasn't applied the Added event.
+        assert cluster.informer.get("Pod", "default", "p1") is None
+        cluster.step()
+        cached = cluster.informer.get("Pod", "default", "p1")
+        assert cached is not None and cached.name == "p1"
+        # Store mutations don't leak into the cache between syncs.
+        live = cluster.api.get("Pod", "default", "p1")
+        live.node_name = "n1"
+        cluster.api.update(live)
+        assert cluster.informer.get("Pod", "default", "p1").node_name == ""
+        cluster.step()
+        assert cluster.informer.get("Pod", "default", "p1").node_name == "n1"
+
+
 class TestInventory:
     def test_tpu_slice_topology(self):
         nodes = make_tpu_pool(num_slices=2, slice_topology="4x4", chips_per_host=4)
@@ -126,8 +200,10 @@ class TestSchedulerAndKubelet:
             lambda: cluster.api.get("Pod", "default", "p1").status.phase == PodPhase.RUNNING,
             timeout=10,
         )
-        assert pod.node_name.startswith("cpu-")
-        assert pod.status.start_time is not None
+        # Copy-on-read: the submitted object never mutates — re-read.
+        live = cluster.live(pod)
+        assert live.node_name.startswith("cpu-")
+        assert live.status.start_time is not None
 
     def test_node_selector_respected(self):
         cluster = Cluster(VirtualClock())
@@ -135,8 +211,8 @@ class TestSchedulerAndKubelet:
         DefaultScheduler(cluster)
         pod = make_pod("p1", node_selector={"kubernetes.io/hostname": "cpu-1"})
         cluster.api.create(pod)
-        cluster.run_until(lambda: pod.node_name != "", timeout=5)
-        assert pod.node_name == "cpu-1"
+        cluster.run_until(lambda: cluster.live(pod).node_name != "", timeout=5)
+        assert cluster.live(pod).node_name == "cpu-1"
 
     def test_resource_exhaustion_leaves_pod_pending(self):
         cluster = Cluster(VirtualClock())
@@ -158,8 +234,10 @@ class TestSchedulerAndKubelet:
         pod = make_pod("p1")
         pod.spec.annotations[ANNOTATION_SIM_DURATION] = "1.0"
         cluster.api.create(pod)
-        assert cluster.run_until(lambda: pod.status.phase == PodPhase.SUCCEEDED, timeout=30)
-        assert pod.status.container_statuses[0].exit_code == 0
+        assert cluster.run_until(
+            lambda: cluster.live(pod).status.phase == PodPhase.SUCCEEDED, timeout=30
+        )
+        assert cluster.live(pod).status.container_statuses[0].exit_code == 0
 
     def test_failed_pod_releases_resources(self):
         cluster = Cluster(VirtualClock())
@@ -171,4 +249,6 @@ class TestSchedulerAndKubelet:
         cluster.api.create(p1)
         p2 = make_pod("p2", cpu=2.0)
         cluster.api.create(p2)
-        assert cluster.run_until(lambda: p2.status.phase == PodPhase.RUNNING, timeout=30)
+        assert cluster.run_until(
+            lambda: cluster.live(p2).status.phase == PodPhase.RUNNING, timeout=30
+        )

@@ -1,7 +1,7 @@
 """In-process API server: object store + watch streams + optimistic concurrency.
 
 This is the substrate the reconcile engine writes to, standing in for the
-Kubernetes API server. Two properties matter and are reproduced faithfully:
+Kubernetes API server. Three properties matter and are reproduced faithfully:
 
 1. **Asynchronous watch echo.** Writes return immediately, but watch events are
    *queued* and only observed when the consumer drains its informer queue.
@@ -12,10 +12,22 @@ Kubernetes API server. Two properties matter and are reproduced faithfully:
 2. **Optimistic concurrency.** Every write bumps `resourceVersion`; an update
    carrying a stale version conflicts (like k8s), which the engine's status
    writer must retry (reference UpdateJobStatusInApiServer path).
+
+3. **Copy-on-read.** get/list return deep copies and writes store copies, so
+   in-place mutation of a read object never reaches the store without an
+   update() — the class of stale-read/lost-update bug real k8s surfaces is
+   surfaced here too instead of being structurally invisible. Watch events
+   carry ONE shared copy per write (the informer contract: handlers may keep
+   the object but must treat it as read-only or accept cross-watcher skew;
+   the store itself can't be corrupted either way).
+
+A per-(kind, label) inverted index backs label-selector lists, so the engine's
+per-job pod/service lookups don't scan (and clone) the whole pod population.
 """
 
 from __future__ import annotations
 
+import copy as _copylib
 import threading
 from collections import deque
 from dataclasses import dataclass
@@ -67,6 +79,44 @@ class WatchQueue:
         return len(self._q)
 
 
+class SharedInformer:
+    """Cluster-wide read cache fed by one watch stream (controller-runtime's
+    shared informer). Components read full state from here instead of listing
+    (and cloning) the store on every tick; the cache holds the per-write
+    event copies, so reads are O(1) and allocation-free.
+
+    Contract: cached objects are the shared event copies — treat them as
+    read-only unless you immediately persist the same change with update()
+    (write-through). sync() applies queued events; `Cluster.step` calls it
+    before tickers run, so caches lag the store by at most one tick — the
+    same lag every real informer has.
+    """
+
+    def __init__(self, api: "APIServer"):
+        self._watch = api.watch()
+        self.caches: Dict[str, Dict[Tuple[str, str], Any]] = {}
+        # Seed from the store (initial LIST, then WATCH).
+        for kind in list(api._by_kind):
+            for obj in api.list(kind):
+                ns = getattr(obj.metadata, "namespace", "") or ""
+                self.caches.setdefault(kind, {})[(ns, obj.metadata.name)] = obj
+
+    def sync(self) -> None:
+        for ev in self._watch.drain():
+            ns = getattr(ev.obj.metadata, "namespace", "") or ""
+            key = (ns, ev.obj.metadata.name)
+            if ev.type == "Deleted":
+                self.caches.get(ev.kind, {}).pop(key, None)
+            else:
+                self.caches.setdefault(ev.kind, {})[key] = ev.obj
+
+    def list(self, kind: str) -> List[Any]:
+        return list(self.caches.get(kind, {}).values())
+
+    def get(self, kind: str, namespace: str, name: str) -> Optional[Any]:
+        return self.caches.get(kind, {}).get((namespace or "", name))
+
+
 class APIServer:
     """Typed object store keyed by (kind, namespace, name)."""
 
@@ -75,12 +125,29 @@ class APIServer:
         # Per-kind index so list(kind) doesn't scan the whole store — at
         # 1k-job-burst scale the reconcilers list pods thousands of times.
         self._by_kind: Dict[str, Dict[Tuple[str, str], Any]] = {}
+        # Inverted label index: (kind, label_key, label_value) -> {(ns, name)}
+        # so selector lists touch only matching objects.
+        self._by_label: Dict[Tuple[str, str, str], set] = {}
         self._rv_value = 0
         self._watchers: List[WatchQueue] = []
         self._events: List[Event] = []
         self._lock = threading.RLock()
         # Admission hooks: kind -> [callable(obj) raising on rejection]
         self._admission: Dict[str, List[Callable[[Any], None]]] = {}
+
+    @staticmethod
+    def _clone(obj: Any) -> Any:
+        return _copylib.deepcopy(obj)
+
+    def _index_labels(self, key: Tuple[str, str, str], obj: Any) -> None:
+        for lk, lv in obj.metadata.labels.items():
+            self._by_label.setdefault((key[0], lk, lv), set()).add(key[1:])
+
+    def _unindex_labels(self, key: Tuple[str, str, str], obj: Any) -> None:
+        for lk, lv in obj.metadata.labels.items():
+            bucket = self._by_label.get((key[0], lk, lv))
+            if bucket is not None:
+                bucket.discard(key[1:])
 
     # -- admission ---------------------------------------------------------
 
@@ -125,21 +192,31 @@ class APIServer:
                 raise AlreadyExistsError(f"{key} already exists")
             obj.metadata.ensure_uid(obj.KIND)
             obj.metadata.resource_version = self._next_rv()
-            self._objects[key] = obj
-            self._by_kind.setdefault(key[0], {})[key[1:]] = obj
-            self._notify("Added", obj)
+            stored = self._clone(obj)
+            self._objects[key] = stored
+            self._by_kind.setdefault(key[0], {})[key[1:]] = stored
+            self._index_labels(key, stored)
+            self._notify("Added", self._clone(stored))
             return obj
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
         with self._lock:
             try:
-                return self._objects[(kind, namespace or "", name)]
+                return self._clone(self._objects[(kind, namespace or "", name)])
             except KeyError:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found") from None
 
     def try_get(self, kind: str, namespace: str, name: str) -> Optional[Any]:
         with self._lock:
-            return self._objects.get((kind, namespace or "", name))
+            obj = self._objects.get((kind, namespace or "", name))
+            return self._clone(obj) if obj is not None else None
+
+    def resource_version(self, kind: str, namespace: str, name: str) -> Optional[int]:
+        """Version probe without the read copy — cache-validation fast path
+        (a clone per probe would defeat the caches that key on this)."""
+        with self._lock:
+            obj = self._objects.get((kind, namespace or "", name))
+            return obj.metadata.resource_version if obj is not None else None
 
     def update(self, obj: Any, check_version: bool = True, status_only: bool = False) -> Any:
         with self._lock:
@@ -147,7 +224,7 @@ class APIServer:
             current = self._objects.get(key)
             if current is None:
                 raise NotFoundError(f"{key} not found")
-            if check_version and current is not obj and (
+            if check_version and (
                 obj.metadata.resource_version != current.metadata.resource_version
             ):
                 raise ConflictError(
@@ -155,9 +232,12 @@ class APIServer:
                     f"!= {current.metadata.resource_version}"
                 )
             obj.metadata.resource_version = self._next_rv()
-            self._objects[key] = obj
-            self._by_kind.setdefault(key[0], {})[key[1:]] = obj
-            self._notify("Modified", obj, status_only=status_only)
+            stored = self._clone(obj)
+            self._unindex_labels(key, current)
+            self._objects[key] = stored
+            self._by_kind.setdefault(key[0], {})[key[1:]] = stored
+            self._index_labels(key, stored)
+            self._notify("Modified", self._clone(stored), status_only=status_only)
             return obj
 
     def delete(self, kind: str, namespace: str, name: str) -> Any:
@@ -167,7 +247,8 @@ class APIServer:
             if obj is None:
                 raise NotFoundError(f"{key} not found")
             self._by_kind.get(kind, {}).pop(key[1:], None)
-            self._notify("Deleted", obj)
+            self._unindex_labels(key, obj)
+            self._notify("Deleted", obj)  # orphaned: safe to hand out as-is
             return obj
 
     def try_delete(self, kind: str, namespace: str, name: str) -> Optional[Any]:
@@ -183,16 +264,31 @@ class APIServer:
         label_selector: Optional[Dict[str, str]] = None,
     ) -> List[Any]:
         with self._lock:
-            out = []
-            for (ns, _), obj in self._by_kind.get(kind, {}).items():
-                if namespace is not None and ns != namespace:
-                    continue
-                if label_selector:
-                    labels = obj.metadata.labels
-                    if not all(labels.get(lk) == lv for lk, lv in label_selector.items()):
+            by_kind = self._by_kind.get(kind, {})
+            if label_selector:
+                # Intersect via the inverted index: start from the smallest
+                # label bucket, verify remaining pairs per object.
+                buckets = [
+                    self._by_label.get((kind, lk, lv), set())
+                    for lk, lv in label_selector.items()
+                ]
+                candidates = min(buckets, key=len) if buckets else set()
+                out = []
+                for subkey in candidates:
+                    obj = by_kind.get(subkey)
+                    if obj is None:
                         continue
-                out.append(obj)
-            return out
+                    if namespace is not None and subkey[0] != namespace:
+                        continue
+                    labels = obj.metadata.labels
+                    if all(labels.get(lk) == lv for lk, lv in label_selector.items()):
+                        out.append(self._clone(obj))
+                return out
+            return [
+                self._clone(obj)
+                for (ns, _), obj in by_kind.items()
+                if namespace is None or ns == namespace
+            ]
 
     # -- events ------------------------------------------------------------
 

@@ -16,7 +16,7 @@ import itertools
 import time as _time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from training_operator_tpu.cluster.apiserver import APIServer
+from training_operator_tpu.cluster.apiserver import APIServer, SharedInformer
 from training_operator_tpu.cluster.objects import (
     ContainerStatus,
     Node,
@@ -63,6 +63,10 @@ class Cluster:
     def __init__(self, clock: Optional[Clock] = None):
         self.clock = clock or Clock()
         self.api = APIServer()
+        # Shared read cache (controller-runtime's shared informer): synced at
+        # the top of every step, read by schedulers/kubelet/benchmarks so
+        # full-state scans don't clone the store each tick.
+        self.informer = SharedInformer(self.api)
         self._tickers: List[Callable[[], None]] = []
         self._timers: List[Tuple[float, int, Callable[[], None]]] = []
         self._timer_seq = itertools.count()
@@ -75,6 +79,13 @@ class Cluster:
 
     def nodes(self) -> List[Node]:
         return self.api.list("Node")
+
+    def live(self, obj: Any) -> Any:
+        """Latest stored state of `obj` (or None if deleted). With copy-on-
+        read semantics a submitted object never mutates in the caller's hand
+        — k8s clients re-GET, and so must tests/benchmarks."""
+        ns = getattr(obj.metadata, "namespace", "") or ""
+        return self.api.try_get(obj.KIND, ns, obj.metadata.name)
 
     # -- scheduling of work ------------------------------------------------
 
@@ -91,7 +102,9 @@ class Cluster:
         return self._timers[0][0] if self._timers else None
 
     def step(self) -> None:
-        """One tick: run due timers, then every ticker once."""
+        """One tick: sync the shared informer, run due timers, then every
+        ticker once."""
+        self.informer.sync()
         now = self.clock.now()
         while self._timers and self._timers[0][0] <= now:
             _, _, fn = heapq.heappop(self._timers)
@@ -145,37 +158,8 @@ class Cluster:
             self.clock.set(end)
 
 
-class NodeAllocations:
-    """Tracks committed resources per node from bound, non-terminal pods."""
-
-    def __init__(self, api: APIServer):
-        self.api = api
-
-    def used(self) -> Dict[str, Dict[str, float]]:
-        used: Dict[str, Dict[str, float]] = {}
-        for pod in self.api.list("Pod"):
-            if not pod.node_name or pod.is_terminal():
-                continue
-            bucket = used.setdefault(pod.node_name, {})
-            for k, v in pod.resources().items():
-                bucket[k] = bucket.get(k, 0.0) + v
-        return used
-
-    def free(self) -> Dict[str, Dict[str, float]]:
-        used = self.used()
-        free: Dict[str, Dict[str, float]] = {}
-        for node in self.api.list("Node"):
-            if node.unschedulable:
-                continue
-            u = used.get(node.name, {})
-            free[node.name] = {
-                k: cap - u.get(k, 0.0) for k, cap in node.capacity.items()
-            }
-        return free
-
-    @staticmethod
-    def fits(request: Dict[str, float], avail: Dict[str, float]) -> bool:
-        return all(avail.get(k, 0.0) >= v for k, v in request.items())
+def request_fits(request: Dict[str, float], avail: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) >= v for k, v in request.items())
 
 
 class DefaultScheduler:
@@ -186,61 +170,89 @@ class DefaultScheduler:
 
     def __init__(self, cluster: Cluster, handles_scheduler_names: Tuple[str, ...] = ("", "default-scheduler")):
         self.cluster = cluster
-        self.alloc = NodeAllocations(cluster.api)
         self.handles = set(handles_scheduler_names)
-        # Informer pattern: the unbound-pod set is maintained from watch
-        # events, and binding only retries when the cluster state changed —
-        # a full pod scan per tick is O(cluster x steps) and dominates at
-        # 1k-job scale. Like a real informer: initial LIST, then WATCH.
-        self._watch = cluster.api.watch(kinds=("Pod",))
+        # Informer pattern: unbound pods, active (bound, non-terminal) pods,
+        # and nodes are all maintained from THIS component's watch events, so
+        # the retry gate and the capacity view can never disagree (a shared
+        # cache synced elsewhere lags the events drained here, which would
+        # deadlock an attempt-once gate). Initial LIST, then WATCH.
+        self._watch = cluster.api.watch(kinds=("Pod", "Node"))
         self._pending: dict = {}
+        self._active: dict = {}  # (ns, name) -> bound non-terminal pod
+        self._nodes: dict = {}
         for pod in cluster.api.list("Pod"):
-            if (
-                pod.status.phase == PodPhase.PENDING
-                and not pod.node_name
-                and pod.spec.scheduler_name in self.handles
-            ):
-                self._pending[(pod.namespace, pod.name)] = pod
-        self._tried_at_version: Optional[int] = None
+            self._observe_pod("Added", pod)
+        for node in cluster.api.list("Node"):
+            self._nodes[node.name] = node
+        # Retry only when something changed: a new pending pod, freed
+        # capacity (bound pod terminal/deleted), or a node event.
+        self._dirty = True
         cluster.add_ticker(self.tick)
+
+    def _observe_pod(self, ev_type: str, pod: Pod) -> None:
+        key = (pod.namespace, pod.name)
+        if (
+            ev_type != "Deleted"
+            and pod.status.phase == PodPhase.PENDING
+            and not pod.node_name
+            and pod.spec.scheduler_name in self.handles
+        ):
+            self._pending[key] = pod
+            self._dirty = True
+        else:
+            self._pending.pop(key, None)
+        if ev_type != "Deleted" and pod.node_name and not pod.is_terminal():
+            self._active[key] = pod
+        elif self._active.pop(key, None) is not None:
+            self._dirty = True  # capacity freed
+
+    def _free(self) -> Dict[str, Dict[str, float]]:
+        used: Dict[str, Dict[str, float]] = {}
+        for pod in self._active.values():
+            bucket = used.setdefault(pod.node_name, {})
+            for k, v in pod.resources().items():
+                bucket[k] = bucket.get(k, 0.0) + v
+        free: Dict[str, Dict[str, float]] = {}
+        for node in self._nodes.values():
+            if node.unschedulable:
+                continue
+            u = used.get(node.name, {})
+            free[node.name] = {
+                k: cap - u.get(k, 0.0) for k, cap in node.capacity.items()
+            }
+        return free
 
     def tick(self) -> None:
         for ev in self._watch.drain():
-            pod = ev.obj
-            key = (pod.namespace, pod.name)
-            if (
-                ev.type != "Deleted"
-                and pod.status.phase == PodPhase.PENDING
-                and not pod.node_name
-                and pod.spec.scheduler_name in self.handles
-            ):
-                self._pending[key] = pod
+            if ev.kind == "Node":
+                if ev.type == "Deleted":
+                    self._nodes.pop(ev.obj.metadata.name, None)
+                else:
+                    self._nodes[ev.obj.metadata.name] = ev.obj
+                self._dirty = True
             else:
-                self._pending.pop(key, None)
-        if not self._pending:
+                self._observe_pod(ev.type, ev.obj)
+        if not self._pending or not self._dirty:
             return
-        version = self.cluster.api.version()
-        if version == self._tried_at_version:
-            return  # nothing changed since the last failed attempt
-        free = self.alloc.free()
-        nodes = {n.name: n for n in self.cluster.api.list("Node")}
+        self._dirty = False
+        free = self._free()
         bound = []
         for key, pod in self._pending.items():
             req = pod.resources()
-            for name, node in nodes.items():
+            for name, node in self._nodes.items():
                 if node.unschedulable or name not in free:
                     continue
                 if pod.spec.node_selector and not node.matches_selector(pod.spec.node_selector):
                     continue
-                if NodeAllocations.fits(req, free[name]):
+                if request_fits(req, free[name]):
                     bind_pod(self.cluster.api, pod, name, now=self.cluster.clock.now())
+                    self._active[key] = pod
                     for k, v in req.items():
                         free[name][k] = free[name].get(k, 0.0) - v
                     bound.append(key)
                     break
         for key in bound:
             self._pending.pop(key, None)
-        self._tried_at_version = self.cluster.api.version() if not self._pending else version
 
 
 def bind_pod(api: APIServer, pod: Pod, node_name: str, now: Optional[float] = None) -> None:

@@ -124,26 +124,37 @@ class TrainJobController:
             log.warning("name collision on %s %s: owned by someone else",
                         obj.KIND, obj.metadata.name)
             return
-        # Only mutable intent is propagated (suspend, replica sizing, specs),
-        # and only when it actually differs — an unconditional write would
-        # echo back through the workload watch and re-trigger this reconcile
-        # forever.
-        desired = (obj.run_policy, obj.replica_specs, getattr(obj, "tpu_policy", None))
-        live = (existing.run_policy, existing.replica_specs,
-                getattr(existing, "tpu_policy", None))
-        if desired == live:
+        # ALL spec intent is propagated (every dataclass field except
+        # metadata/status — replica sizing, run policy, nproc_per_node, MPI
+        # settings, elastic policy, ...), and only when something actually
+        # differs — an unconditional write would echo back through the
+        # workload watch and re-trigger this reconcile forever. The write is
+        # version-checked: `existing` was read this reconcile, so a conflict
+        # means a concurrent writer won and the queue's failure backoff
+        # retries against fresh state.
+        import dataclasses
+
+        spec_fields = [
+            f.name
+            for f in dataclasses.fields(obj)
+            if f.name not in ("metadata", "status")
+        ]
+        if all(
+            getattr(obj, f) == getattr(existing, f, None) for f in spec_fields
+        ):
             return
-        existing.run_policy = obj.run_policy
-        existing.replica_specs = obj.replica_specs
-        if hasattr(obj, "tpu_policy"):
-            existing.tpu_policy = obj.tpu_policy
-        self.api.update(existing, check_version=False)
+        for f in spec_fields:
+            setattr(existing, f, getattr(obj, f))
+        self.api.update(existing, check_version=True)
 
     def _write(self, job: TrainJob, prev_status=None) -> None:
         if prev_status is not None and prev_status == job.status:
             return
         try:
-            self.api.update(job, check_version=False, status_only=True)
+            # Version-checked: `job` was read at reconcile start. A conflict
+            # (client spec update raced this reconcile) propagates to the
+            # manager loop, which backs off and re-enqueues.
+            self.api.update(job, check_version=True, status_only=True)
         except NotFoundError:
             pass
 

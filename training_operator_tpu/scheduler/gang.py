@@ -33,7 +33,6 @@ from training_operator_tpu.engine.control import PodGroupControl
 from training_operator_tpu.scheduler.snapshot import (
     ClusterSnapshot,
     build_gang_request,
-    resolve_owner_job,
 )
 from training_operator_tpu.utils import metrics
 
@@ -85,12 +84,26 @@ class GangScheduler:
         self._capacity_freed = False
         self._last_solve_at = -float("inf")
         # Informer caches maintained from watch events (initial LIST below):
-        # unbound gang pods awaiting binding, and pods grouped by PodGroup.
+        # unbound gang pods awaiting binding, pods grouped by PodGroup, bound
+        # non-terminal pods (the snapshot's capacity view), plus PodGroups
+        # and Nodes themselves — with copy-on-read these caches are what
+        # keeps the per-cycle solve path allocation-free.
         self._unbound: Dict[tuple, Pod] = {}
         self._group_pods: Dict[str, Dict[str, Pod]] = {}
         self._bound_active: Dict[tuple, Pod] = {}
+        self._groups: Dict[str, PodGroup] = {}
+        self._nodes: Dict[str, object] = {}
+        # Failed-admission attempt counts, keyed by PodGroup uid. Tracked
+        # scheduler-side (NOT by mutating the read copy, which copy-on-read
+        # would silently discard) and persisted onto the group only on the
+        # Unschedulable transition.
+        self._attempts: Dict[str, int] = {}
         for pod in self.api.list("Pod"):
             self._observe_pod("Added", pod)
+        for pg in self.api.list("PodGroup"):
+            self._groups[f"{pg.namespace}/{pg.name}"] = pg
+        for node in self.api.list("Node"):
+            self._nodes[node.name] = node
         # Cross-cycle memos: expanded GangRequests keyed by PodGroup uid and
         # the snapshot's per-gang pod-request cache (both invalidated by the
         # owning job's resourceVersion).
@@ -105,6 +118,8 @@ class GangScheduler:
             self.api,
             self._pod_req_cache,
             bound_pods=self._bound_active.values(),
+            podgroups=self._groups.values(),
+            nodes=self._nodes.values(),
         )
 
     def _observe_pod(self, ev_type: str, pod: Pod) -> None:
@@ -142,17 +157,26 @@ class GangScheduler:
                     self._solve_dirty = True
                     self._capacity_freed = True
             elif kind == "PodGroup":
+                gkey = f"{obj.namespace}/{obj.name}"
                 if ev.type in ("Added", "Deleted") or obj.phase == PodGroupPhase.PENDING:
                     self._solve_dirty = True
                 self._bind_dirty = True
                 self._advance_dirty = True
                 if ev.type == "Deleted":
-                    self._group_pods.pop(f"{obj.namespace}/{obj.name}", None)
+                    self._groups.pop(gkey, None)
+                    self._group_pods.pop(gkey, None)
                     self._req_cache.pop(obj.metadata.uid, None)
                     self._pod_req_cache.pop(obj.metadata.uid, None)
+                    self._attempts.pop(obj.metadata.uid, None)
                     self._solve_dirty = True  # reservations released
                     self._capacity_freed = True
+                else:
+                    self._groups[gkey] = obj
             elif kind == "Node":
+                if ev.type == "Deleted":
+                    self._nodes.pop(obj.metadata.name, None)
+                else:
+                    self._nodes[obj.metadata.name] = obj
                 self._solve_dirty = True
                 self._bind_dirty = True
                 self._capacity_freed = True
@@ -203,17 +227,16 @@ class GangScheduler:
 
     def _gang_request(self, pg: PodGroup):
         """build_gang_request with a (job rv, group shape)-keyed memo — the
-        replica expansion is pure given those inputs."""
-        job = resolve_owner_job(self.api, pg)
-        if job is None:
+        replica expansion is pure given those inputs. The version probe
+        avoids cloning the owning job on every cycle (copy-on-read makes
+        get() allocate); the job is only fetched on a cache miss."""
+        kind = pg.metadata.labels.get("job-kind")
+        if not kind:
             return None
-        ck = (
-            job.KIND,
-            job.metadata.resource_version,
-            pg.topology_request,
-            pg.num_slices,
-            pg.min_member,
-        )
+        rv = self.api.resource_version(kind, pg.namespace, pg.name)
+        if rv is None:
+            return None  # owner gone; group awaits cascade GC
+        ck = (kind, rv, pg.topology_request, pg.num_slices, pg.min_member)
         hit = self._req_cache.get(pg.metadata.uid)
         if hit is not None and hit[0] == ck:
             req = hit[1]
@@ -227,7 +250,7 @@ class GangScheduler:
     def _admit_pending(self) -> None:
         groups = [
             pg
-            for pg in self.api.list("PodGroup")
+            for pg in self._groups.values()
             if pg.phase in (PodGroupPhase.PENDING, PodGroupPhase.UNSCHEDULABLE)
         ]
         if not groups:
@@ -268,24 +291,42 @@ class GangScheduler:
             pg = req.group
             placement = placements.get(req.key)
             if placement is not None:
-                pg.placement = dict(placement.assignments)
-                pg.reserved_nodes = list(placement.reserved_nodes)
-                pg.placement_score = placement.score
-                pg.phase = PodGroupPhase.INQUEUE
-                self.api.update(pg, check_version=False)
+                live = self._fresh_for_write(pg)
+                if live is None:
+                    continue
+                live.placement = dict(placement.assignments)
+                live.reserved_nodes = list(placement.reserved_nodes)
+                live.placement_score = placement.score
+                live.phase = PodGroupPhase.INQUEUE
+                self._persist(live)
                 metrics.podgroups_admitted.inc()
-                self._event(pg, "Normal", "GangAdmitted",
+                self._event(live, "Normal", "GangAdmitted",
                             f"placed on {len(set(placement.assignments.values()))} nodes")
             else:
-                # Track attempts in-object without an API write per cycle —
-                # persisting every failed attempt would look like cluster
-                # activity and (in tests/benches on a virtual clock) starve
-                # time advancement. Phase transitions are persisted by
-                # _check_timeouts.
-                pg.creation_attempts += 1
+                # Track attempts scheduler-side without an API write per
+                # cycle — persisting every failed attempt would look like
+                # cluster activity and (on a virtual clock) starve time
+                # advancement; mutating the read copy would be silently
+                # discarded under copy-on-read. Counts are persisted onto
+                # the group by _check_timeouts at the phase transition.
+                self._attempts[pg.metadata.uid] = self._attempts.get(pg.metadata.uid, 0) + 1
         # Our own admission writes (phase -> INQUEUE) echo back through the
         # watch but do not match any dirty rule, so they don't force a
         # redundant re-solve next tick.
+
+    def _fresh_for_write(self, pg: PodGroup) -> Optional[PodGroup]:
+        """Re-read a cached PodGroup before mutating it for a write. Watch-
+        event caches lag writes made earlier in the same tick (e.g. a repack
+        extending `placement`); a full-object write from the stale copy would
+        silently revert them. Within the single-threaded tick nothing races
+        the fresh copy, so the follow-up update is version-check safe."""
+        return self.api.try_get("PodGroup", pg.namespace, pg.name)
+
+    def _persist(self, pg: PodGroup) -> None:
+        """Version-checked write + write-through of this component's cache
+        so same-tick readers see the new state before the watch echo."""
+        self.api.update(pg, check_version=True)
+        self._groups[f"{pg.namespace}/{pg.name}"] = pg
 
     def _check_timeouts(self, groups: List[PodGroup]) -> None:
         now = self.cluster.clock.now()
@@ -294,24 +335,26 @@ class GangScheduler:
             created = pg.metadata.creation_time or now
             if (
                 pg.phase == PodGroupPhase.PENDING
-                and pg.creation_attempts > 0
+                and self._attempts.get(pg.metadata.uid, 0) > 0
                 and timeout is not None
                 and now - created > timeout
             ):
-                pg.phase = PodGroupPhase.UNSCHEDULABLE
-                self._event(pg, "Warning", "Unschedulable",
+                live = self._fresh_for_write(pg)
+                if live is None or live.phase != PodGroupPhase.PENDING:
+                    continue
+                live.phase = PodGroupPhase.UNSCHEDULABLE
+                live.creation_attempts = self._attempts.get(pg.metadata.uid, 0)
+                self._event(live, "Warning", "Unschedulable",
                             f"no feasible placement after {timeout}s")
-                self.api.update(pg, check_version=False)
+                self._persist(live)
 
     # ------------------------------------------------------------------
 
     def _bind_pods(self) -> None:
         if not self._unbound:
             return
-        groups: Dict[str, PodGroup] = {
-            f"{pg.namespace}/{pg.name}": pg for pg in self.api.list("PodGroup")
-        }
-        nodes = {n.name for n in self.api.list("Node") if not n.unschedulable}
+        groups = self._groups
+        nodes = {n.name for n in self._nodes.values() if not n.unschedulable}
         for key, pod in list(self._unbound.items()):
             pg_name = pod.spec.annotations.get(PodGroupControl.POD_GROUP_ANNOTATION)
             if not pg_name:
@@ -325,10 +368,13 @@ class GangScheduler:
                 continue
             if target not in nodes:
                 # Placed node vanished before binding: re-solve the gang.
-                pg.phase = PodGroupPhase.PENDING
-                pg.placement = {}
-                self.api.update(pg, check_version=False)
-                self._event(pg, "Warning", "PlacementInvalidated",
+                live = self._fresh_for_write(pg)
+                if live is None:
+                    continue
+                live.phase = PodGroupPhase.PENDING
+                live.placement = {}
+                self._persist(live)
+                self._event(live, "Warning", "PlacementInvalidated",
                             f"node {target} is gone; re-solving")
                 continue
             bind_pod(self.api, pod, target, now=self.cluster.clock.now())
@@ -337,7 +383,7 @@ class GangScheduler:
 
     def _advance_running(self) -> None:
         inqueue = [
-            pg for pg in self.api.list("PodGroup")
+            pg for pg in self._groups.values()
             if pg.phase == PodGroupPhase.INQUEUE and pg.placement
         ]
         if not inqueue:
@@ -347,8 +393,15 @@ class GangScheduler:
             if len(pods) >= pg.min_member and all(
                 p.status.phase == PodPhase.RUNNING for p in pods
             ):
-                pg.phase = PodGroupPhase.RUNNING
-                self.api.update(pg, check_version=False)
+                live = self._fresh_for_write(pg)
+                if (
+                    live is None
+                    or live.phase != PodGroupPhase.INQUEUE
+                    or len(pods) < live.min_member  # grew since our cache
+                ):
+                    continue
+                live.phase = PodGroupPhase.RUNNING
+                self._persist(live)
 
     def _event(self, pg: PodGroup, etype: str, reason: str, message: str) -> None:
         self.api.record_event(

@@ -109,6 +109,8 @@ class ClusterSnapshot:
         api: APIServer,
         pod_requests_cache: Optional[Dict[str, Tuple[int, Dict[str, Dict[str, float]]]]] = None,
         bound_pods: Optional[Iterable] = None,
+        podgroups: Optional[Iterable[PodGroup]] = None,
+        nodes: Optional[Iterable[Node]] = None,
     ):
         self.api = api
         # Optional cross-snapshot memo for per-gang pod requests, keyed by
@@ -117,16 +119,18 @@ class ClusterSnapshot:
         # change; the owner resolve + replica expansion dominates build time
         # at 1k-gang scale without it.
         self._requests_cache = pod_requests_cache
-        self.nodes: Dict[str, Node] = {n.name: n for n in api.list("Node")}
+        # `bound_pods`/`podgroups`/`nodes`: informer-maintained views
+        # (GangScheduler keeps them from watch events). Without them every
+        # snapshot clones the full store — including the terminal-pod
+        # population that accumulates until TTL cleanup.
+        node_iter = nodes if nodes is not None else api.list("Node")
+        self.nodes: Dict[str, Node] = {n.name: n for n in node_iter}
         self.free: Dict[str, Dict[str, float]] = {
             name: dict(n.capacity)
             for name, n in self.nodes.items()
             if not n.unschedulable
         }
-        # `bound_pods`: an informer-maintained view of bound non-terminal
-        # pods (GangScheduler keeps one from watch events). Without it the
-        # full pod list — which accumulates terminal pods until TTL cleanup —
-        # is scanned on every snapshot.
+        self._podgroups = list(podgroups) if podgroups is not None else api.list("PodGroup")
         bound = self._subtract_bound_pods(bound_pods)
         self._subtract_admitted_reservations(bound)
         self.slices = self._build_slices()
@@ -148,21 +152,25 @@ class ClusterSnapshot:
         return bound
 
     def _pod_requests_for(self, pg: PodGroup) -> Dict[str, Dict[str, float]]:
+        if self._requests_cache is not None:
+            # Version-probe fast path: skip the owner GET (a clone under
+            # copy-on-read) when the cached expansion is still current.
+            kind = pg.metadata.labels.get("job-kind")
+            rv = self.api.resource_version(kind, pg.namespace, pg.name) if kind else None
+            hit = self._requests_cache.get(pg.metadata.uid)
+            if hit is not None and rv is not None and hit[0] == rv:
+                return hit[1]
+            job = resolve_owner_job(self.api, pg)
+            if job is None:
+                return {}
+            per_pod = job_pod_requests(job)
+            self._requests_cache[pg.metadata.uid] = (job.metadata.resource_version, per_pod)
+            return per_pod
         job = resolve_owner_job(self.api, pg)
-        if job is None:
-            return {}
-        if self._requests_cache is None:
-            return job_pod_requests(job)
-        rv = job.metadata.resource_version
-        hit = self._requests_cache.get(pg.metadata.uid)
-        if hit is not None and hit[0] == rv:
-            return hit[1]
-        per_pod = job_pod_requests(job)
-        self._requests_cache[pg.metadata.uid] = (rv, per_pod)
-        return per_pod
+        return job_pod_requests(job) if job is not None else {}
 
     def _subtract_admitted_reservations(self, bound: set) -> None:
-        for pg in self.api.list("PodGroup"):
+        for pg in self._podgroups:
             if pg.phase not in (PodGroupPhase.INQUEUE, PodGroupPhase.RUNNING):
                 continue
             if not pg.placement:
