@@ -358,3 +358,101 @@ class TestManualPhaseControl:
         assert cluster.run_until(
             lambda: job_has(cluster, JobConditionType.SUCCEEDED), timeout=30
         )
+
+
+class TestAdoptOrphan:
+    """ControllerRefManager claim semantics (reference
+    control/controller_ref_manager.go:380 via common/pod.go:242-253)."""
+
+    def test_orphan_with_matching_labels_is_adopted_and_counted(self):
+        """Pods stranded without an owner ref (e.g. after an operator restart
+        with a fresh uid counter) must be claimed, not re-created: the job
+        reaches Running on its orphans and no duplicate pods appear."""
+        cluster, mgr = make_env(kubelet=False)
+        mgr.submit(make_job())
+        assert cluster.run_until(
+            lambda: len(cluster.api.list("Pod", "default")) == 2, timeout=30
+        )
+        # Simulate operator-restart orphaning: strip owner refs in the store.
+        for pod in cluster.api.list("Pod", "default"):
+            pod.metadata.owner_uid = None
+            cluster.api.update(pod)
+        # Run the pods; reconcile must adopt them and count them active.
+        for pod in cluster.api.list("Pod", "default"):
+            pod.status.phase = PodPhase.RUNNING
+            cluster.api.update(pod)
+        assert cluster.run_until(
+            lambda: job_has(cluster, JobConditionType.RUNNING), timeout=30
+        )
+        pods = cluster.api.list("Pod", "default")
+        assert len(pods) == 2  # adopted, not duplicated
+        job = get_job(cluster)
+        assert all(p.metadata.owner_uid == job.uid for p in pods)
+
+    def test_relabeled_pod_is_released_and_replaced(self):
+        """A dependent whose labels no longer match the selector is released
+        (owner ref cleared) and the engine creates a replacement for the
+        missing index."""
+        from training_operator_tpu.api.common import JOB_KIND_LABEL
+
+        cluster, mgr = make_env(kubelet=False)
+        mgr.submit(make_job())
+        assert cluster.run_until(
+            lambda: len(cluster.api.list("Pod", "default")) == 2, timeout=30
+        )
+        # Mutate a secondary selector label (job-kind): the pod still appears
+        # in the job-name list but fails the full-selector match — exactly
+        # the case release exists for. (A job-name relabel removes the pod
+        # from the list entirely, in the reference too.)
+        victim = sorted(cluster.api.list("Pod", "default"), key=lambda p: p.name)[0]
+        victim.metadata.labels[JOB_KIND_LABEL] = "Impostor"
+        cluster.api.update(victim)
+        # The engine releases the mismatched pod: owner ref cleared, pod NOT
+        # deleted. (As in the reference, replica names are deterministic, so
+        # the released pod squats on the name until an operator deletes it —
+        # release is an ownership operation, not a replacement.)
+        assert cluster.run_until(
+            lambda: cluster.api.get("Pod", "default", victim.name).metadata.owner_uid
+            is None,
+            timeout=30,
+        )
+        released = cluster.api.get("Pod", "default", victim.name)
+        assert released.metadata.labels[JOB_KIND_LABEL] == "Impostor"
+        # The other worker is still owned and counted.
+        job = get_job(cluster)
+        owned = [
+            p for p in cluster.api.list("Pod", "default")
+            if p.metadata.owner_uid == job.uid
+        ]
+        assert len(owned) == 1
+
+    def test_foreign_owned_pod_is_never_touched(self):
+        """A pod with someone else's owner ref but matching labels must be
+        ignored entirely (no adoption, no release, no deletion)."""
+        from training_operator_tpu.engine import core
+
+        cluster, mgr = make_env(kubelet=False)
+        job = make_job(workers=1)
+        mgr.submit(job)
+        assert cluster.run_until(
+            lambda: len(cluster.api.list("Pod", "default")) == 1, timeout=30
+        )
+        # Plant an impostor carrying matching labels but a foreign owner.
+        from training_operator_tpu.cluster.objects import Pod
+        from training_operator_tpu.api.jobs import ObjectMeta as OM
+
+        live = get_job(cluster)
+        impostor = Pod(
+            metadata=OM(
+                name="impostor",
+                namespace="default",
+                labels=dict(
+                    core.replica_labels(live.kind, live, "Worker", 7, False)
+                ),
+                owner_uid="uid-of-someone-else",
+            )
+        )
+        cluster.api.create(impostor)
+        cluster.run_for(2.0)
+        after = cluster.api.get("Pod", "default", "impostor")
+        assert after.metadata.owner_uid == "uid-of-someone-else"

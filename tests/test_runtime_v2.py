@@ -261,3 +261,84 @@ class TestReconcileRetry:
         cluster.run_for(30)  # past the failure backoff delay
         assert "bad" in calls
         assert "ok" not in calls
+
+
+class TestTolerationsAndTaints:
+    def test_override_tolerations_reach_pods_and_gate_placement(self):
+        """PodSpecOverride tolerations flow TrainJob -> workload template ->
+        pods, and placement honors node taints: with every TPU slice tainted,
+        an untolerated TrainJob stays pending while a tolerated one runs
+        (reference trainjob_types.go:310-357; taint semantics as in k8s)."""
+        from training_operator_tpu.runtime.api import PodSpecOverride
+
+        cluster, v2 = make_env()
+        # Taint every TPU node.
+        for node in cluster.api.list("Node"):
+            if node.accelerator.kind == "tpu":
+                node.taints = [
+                    {"key": "tpu-reserved", "value": "team-a", "effect": "NoSchedule"}
+                ]
+                cluster.api.update(node)
+        v2.submit(tpu_runtime())
+
+        blocked = TrainJob(
+            metadata=ObjectMeta(name="no-toleration"),
+            runtime_ref=RuntimeRef(name="tpu-v5e-16"),
+        )
+        v2.submit(blocked)
+        cluster.run_for(10.0)
+        pods = [
+            p for p in cluster.api.list("Pod", "default")
+            if "no-toleration" in p.name and p.node_name
+        ]
+        assert pods == []  # untolerated: nothing bound onto tainted slices
+
+        tolerated_job = TrainJob(
+            metadata=ObjectMeta(name="with-toleration"),
+            runtime_ref=RuntimeRef(name="tpu-v5e-16"),
+            pod_spec_overrides=[
+                PodSpecOverride(
+                    tolerations=[
+                        {"key": "tpu-reserved", "operator": "Equal",
+                         "value": "team-a", "effect": "NoSchedule"}
+                    ],
+                    volumes=[{"name": "scratch", "emptyDir": {}}],
+                )
+            ],
+        )
+        v2.submit(tolerated_job)
+        assert cluster.run_until(
+            lambda: cluster.api.get("TrainJob", "default", "with-toleration").is_finished(),
+            timeout=120,
+        )
+        workers = [
+            p for p in cluster.api.list("Pod", "default") if "with-toleration" in p.name
+        ]
+        assert len(workers) == 4 and all(p.node_name for p in workers)
+        # Tolerations AND volumes arrived on the pods themselves.
+        assert workers[0].spec.tolerations[0]["key"] == "tpu-reserved"
+        assert workers[0].spec.volumes[0]["name"] == "scratch"
+
+    def test_default_scheduler_respects_taints(self):
+        """Non-gang pods: a tainted node is skipped unless tolerated."""
+        from training_operator_tpu.cluster.objects import Pod
+        from training_operator_tpu.api.common import Container, PodTemplateSpec
+        from training_operator_tpu.api.jobs import ObjectMeta as OM
+
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_cpu_pool(1))
+        node = cluster.api.list("Node")[0]
+        node.taints = [{"key": "dedicated", "value": "x", "effect": "NoSchedule"}]
+        cluster.api.update(node)
+        DefaultScheduler(cluster)
+        plain = Pod(metadata=OM(name="plain", namespace="default"),
+                    spec=PodTemplateSpec(containers=[Container(name="c", image="i", resources={"cpu": 1.0})]))
+        tol = Pod(metadata=OM(name="tol", namespace="default"),
+                  spec=PodTemplateSpec(
+                      containers=[Container(name="c", image="i", resources={"cpu": 1.0})],
+                      tolerations=[{"key": "dedicated", "operator": "Exists"}]))
+        cluster.api.create(plain)
+        cluster.api.create(tol)
+        cluster.run_for(2.0)
+        assert cluster.api.get("Pod", "default", "plain").node_name == ""
+        assert cluster.api.get("Pod", "default", "tol").node_name != ""

@@ -381,6 +381,12 @@ class PodTemplateSpec:
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     node_selector: Dict[str, str] = field(default_factory=dict)
+    # Tolerations of node taints, k8s-shaped dicts:
+    # {"key", "operator" ("Equal"|"Exists"), "value", "effect"}.
+    tolerations: List[Dict[str, Any]] = field(default_factory=list)
+    # Volumes, k8s-shaped dicts ({"name", ...source}); carried through to
+    # pods verbatim (the substrate does not mount anything).
+    volumes: List[Dict[str, Any]] = field(default_factory=list)
     scheduler_name: str = ""
     service_account: str = ""
     restart_policy: Optional[RestartPolicy] = None
@@ -408,6 +414,8 @@ class PodTemplateSpec:
             "labels": dict(self.labels),
             "annotations": dict(self.annotations),
             "nodeSelector": dict(self.node_selector),
+            "tolerations": [dict(t) for t in self.tolerations],
+            "volumes": [dict(v) for v in self.volumes],
             "schedulerName": self.scheduler_name,
             "restartPolicy": self.restart_policy.value if self.restart_policy else None,
         }
@@ -421,6 +429,8 @@ class PodTemplateSpec:
             labels=dict(d.get("labels", {})),
             annotations=dict(d.get("annotations", {})),
             node_selector=dict(d.get("nodeSelector", {})),
+            tolerations=[dict(t) for t in d.get("tolerations", [])],
+            volumes=[dict(v) for v in d.get("volumes", [])],
             scheduler_name=d.get("schedulerName", ""),
             restart_policy=RestartPolicy(rp) if rp else None,
         )

@@ -137,6 +137,11 @@ class Node:
     capacity: Dict[str, float] = field(default_factory=dict)
     accelerator: AcceleratorInfo = field(default_factory=AcceleratorInfo)
     unschedulable: bool = False
+    # Taints, k8s-shaped dicts: {"key", "value", "effect"} with effect
+    # "NoSchedule" | "NoExecute" | "PreferNoSchedule". Placement (default
+    # scheduler, gang placers) refuses NoSchedule/NoExecute taints a pod's
+    # tolerations don't cover.
+    taints: List[Dict[str, Any]] = field(default_factory=list)
 
     KIND = "Node"
 
@@ -149,6 +154,41 @@ class Node:
 
     def matches_selector(self, selector: Dict[str, str]) -> bool:
         return all(self.metadata.labels.get(k) == v for k, v in selector.items())
+
+
+def toleration_key(t: Dict[str, Any]) -> tuple:
+    """Canonical hashable form of one toleration/taint dict — THE form used
+    for dedup, cache signatures, and solver class identity (all three must
+    agree or cache invalidation breaks)."""
+    return tuple(sorted(t.items()))
+
+
+def tolerates(taints: List[Dict[str, Any]], tolerations: List[Dict[str, Any]]) -> bool:
+    """k8s taint/toleration matching: every NoSchedule/NoExecute taint must
+    be covered by some toleration (Exists matches any value; Equal requires
+    the value; empty toleration key + Exists tolerates everything; empty
+    toleration effect matches all effects)."""
+
+    def covered(taint: Dict[str, Any]) -> bool:
+        for tol in tolerations:
+            op = tol.get("operator", "Equal")
+            if tol.get("effect") and tol.get("effect") != taint.get("effect"):
+                continue
+            if not tol.get("key"):
+                if op == "Exists":
+                    return True
+                continue
+            if tol.get("key") != taint.get("key"):
+                continue
+            if op == "Exists" or tol.get("value") == taint.get("value"):
+                return True
+        return False
+
+    return all(
+        covered(t)
+        for t in taints
+        if t.get("effect") in ("NoSchedule", "NoExecute")
+    )
 
 
 class PodGroupPhase(str, enum.Enum):

@@ -22,6 +22,7 @@ from training_operator_tpu.cluster.objects import (
     Node,
     Pod,
     PodPhase,
+    tolerates,
 )
 
 ANNOTATION_SIM_DURATION = "sim.tpu.dev/run-seconds"
@@ -243,6 +244,8 @@ class DefaultScheduler:
                 if node.unschedulable or name not in free:
                     continue
                 if pod.spec.node_selector and not node.matches_selector(pod.spec.node_selector):
+                    continue
+                if node.taints and not tolerates(node.taints, pod.spec.tolerations):
                     continue
                 if request_fits(req, free[name]):
                     bind_pod(self.cluster.api, pod, name, now=self.cluster.clock.now())

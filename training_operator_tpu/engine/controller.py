@@ -393,15 +393,28 @@ class JobController:
             self.requeue_after(key, float(job.run_policy.active_deadline_seconds))
 
     def get_pods_for_job(self, job: Job) -> List[Pod]:
-        """Cache list by job-name label, filtered to our ownership
-        (reference GetPodsForJob + ClaimPods adoption, common/pod.go:219-254;
-        adoption here is by owner uid match since labels travel with pods)."""
+        """List by job-name label, then CLAIM: adopt selector-matching
+        orphans (operator restart with a fresh uid counter strands them
+        otherwise), release relabeled dependents, ignore foreign-owned pods
+        (reference GetPodsForJob + ClaimPods, common/pod.go:219-254 via
+        control/controller_ref_manager.go:380)."""
+        from training_operator_tpu.engine.claim import ControllerRefManager
+
         pods = self.api.list("Pod", job.namespace, {JOB_NAME_LABEL: job.name})
-        return [p for p in pods if p.metadata.owner_uid in (None, job.uid)]
+        mgr = ControllerRefManager(
+            self.api, job, core.base_labels(job.kind, job), "Pod"
+        )
+        return mgr.claim(pods)
 
     def get_services_for_job(self, job: Job) -> List[Service]:
+        """Same claim semantics as pods (reference common/service.go)."""
+        from training_operator_tpu.engine.claim import ControllerRefManager
+
         svcs = self.api.list("Service", job.namespace, {JOB_NAME_LABEL: job.name})
-        return [s for s in svcs if s.metadata.owner_uid in (None, job.uid)]
+        mgr = ControllerRefManager(
+            self.api, job, core.base_labels(job.kind, job), "Service"
+        )
+        return mgr.claim(svcs)
 
     def _satisfied_expectations(self, job: Job) -> bool:
         key = job.key()

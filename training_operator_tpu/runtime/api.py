@@ -86,6 +86,7 @@ class PodSpecOverride:
     target_replica_types: List[str] = field(default_factory=list)
     node_selector: Dict[str, str] = field(default_factory=dict)
     tolerations: List[Dict[str, Any]] = field(default_factory=list)
+    volumes: List[Dict[str, Any]] = field(default_factory=list)
     service_account: Optional[str] = None
     init_containers: List[Container] = field(default_factory=list)
 

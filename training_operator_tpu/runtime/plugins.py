@@ -227,10 +227,16 @@ class WorkloadBuilderPlugin:
             )
 
     def _apply_pod_overrides(self, template, job: TrainJob) -> None:
+        """Full PodSpecOverride application (reference trainjob_types.go:
+        310-357): selector, tolerations, volumes, service account, init
+        containers — tolerations/volumes travel on the template all the way
+        to pods, where the substrate's taint gate consumes them."""
         for ov in job.pod_spec_overrides:
             if ov.target_replica_types and REPLICA_WORKER not in ov.target_replica_types:
                 continue
             template.node_selector.update(ov.node_selector)
+            template.tolerations.extend(copy.deepcopy(ov.tolerations))
+            template.volumes.extend(copy.deepcopy(ov.volumes))
             if ov.service_account:
                 template.service_account = ov.service_account
             template.init_containers.extend(copy.deepcopy(ov.init_containers))

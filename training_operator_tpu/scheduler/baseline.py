@@ -83,7 +83,9 @@ class BaselinePlacer:
                 for mask in cset.masks:  # first feasible candidate wins
                     hosts = [sl.host_nodes[h] for h, used in enumerate(mask) if used]
                     if all(
-                        snapshot.host_free(n, sl.chips_per_host) for n in hosts
+                        snapshot.host_free(n, sl.chips_per_host)
+                        and snapshot.tolerated(n, req.tolerations)
+                        for n in hosts
                     ):
                         for pod, node in zip(pods[cursor : cursor + need], hosts):
                             assignments[pod.name] = node
@@ -126,9 +128,11 @@ class BaselinePlacer:
                 if pods_per_slice > sl.num_hosts:
                     continue
                 if not all(
-                    snapshot.host_free(n, sl.chips_per_host) for n in sl.host_nodes
+                    snapshot.host_free(n, sl.chips_per_host)
+                    and snapshot.tolerated(n, req.tolerations)
+                    for n in sl.host_nodes
                 ):
-                    continue  # whole slice must be free
+                    continue  # whole slice must be free and tolerable
                 for pod, node in zip(
                     pods[cursor : cursor + pods_per_slice], sl.host_nodes
                 ):
@@ -168,7 +172,9 @@ class BaselinePlacer:
         for pod in req.sorted_pods():
             placed = False
             for name in node_names:  # first fit
-                if snapshot.fits(name, pod.resources):
+                if snapshot.fits(name, pod.resources) and snapshot.tolerated(
+                    name, pod.tolerations
+                ):
                     assignments[pod.name] = name
                     snapshot.commit(pod.resources, name)
                     committed.append((pod.resources, name))

@@ -254,15 +254,33 @@ class TPUPacker:
     # TPU batch solve
     # ------------------------------------------------------------------
 
-    def _cand_tensors(self, slices: List[SliceInfo], h_max: int):
+    @staticmethod
+    def _node_taint_sig(snapshot: ClusterSnapshot, node_name: str) -> Tuple:
+        from training_operator_tpu.cluster.objects import toleration_key
+
+        node = snapshot.nodes.get(node_name)
+        if node is None or not node.taints:
+            return ()
+        return tuple(sorted(toleration_key(t) for t in node.taints))
+
+    def _cand_tensors(self, slices: List[SliceInfo], h_max: int, snapshot: ClusterSnapshot):
         """Cached (class_ids, class_cands, device tensors) for this inventory.
 
-        Invalidated when the slice set changes; extended in place when a new
-        request class first appears. The packed/device tensors are only
-        rebuilt on those events — steady-state cycles reuse them untouched.
+        Invalidated when the slice set OR any host's taints change; extended
+        in place when a new request class first appears. The packed/device
+        tensors are only rebuilt on those events — steady-state cycles reuse
+        them untouched. (Taints are part of the signature because class
+        candidates bake in taint feasibility — see _class_of.)
         """
         sig = tuple(
-            (sl.slice_id, sl.tpu_type, sl.topology, sl.chips_per_host, tuple(sl.host_nodes))
+            (
+                sl.slice_id,
+                sl.tpu_type,
+                sl.topology,
+                sl.chips_per_host,
+                tuple(sl.host_nodes),
+                tuple(self._node_taint_sig(snapshot, n) for n in sl.host_nodes),
+            )
             for sl in slices
         )
         cache = self._tensor_cache
@@ -283,12 +301,16 @@ class TPUPacker:
         h_max: int,
         req: GangRequest,
         pods_per_slice: int,
+        snapshot: ClusterSnapshot,
     ) -> Optional[int]:
-        """Request class id: (tpu_type, topology, pods_per_slice) — each class
-        owns the concatenation of its candidates across ALL compatible
-        slices, so one argmax ranges over every legal placement at once."""
-        class_ids: Dict[Tuple[str, str, int], int] = cache["class_ids"]
-        key = (req.tpu_type, req.topology, pods_per_slice)
+        """Request class id: (tpu_type, topology, pods_per_slice, toleration
+        signature) — each class owns the concatenation of its candidates
+        across ALL compatible slices, so one argmax ranges over every legal
+        placement at once. Candidates touching hosts whose taints the class
+        does not tolerate are dropped at build time (the cache signature
+        includes taints, so a taint change rebuilds)."""
+        class_ids: Dict[Tuple, Optional[int]] = cache["class_ids"]
+        key = (req.tpu_type, req.topology, pods_per_slice, req.toleration_sig())
         if key in class_ids:
             return class_ids[key]
         cands: List[Tuple[int, np.ndarray, int]] = []
@@ -301,7 +323,12 @@ class TPUPacker:
             cset = self.candidates.get(sl.topology, sl.chips_per_host, req.topology)
             if cset is None or cset.hosts_per_slice != sl.num_hosts:
                 continue
+            host_ok = [
+                snapshot.tolerated(n, req.tolerations) for n in sl.host_nodes
+            ]
             for mask, rank in zip(cset.masks, cset.origin_rank):
+                if not all(ok for ok, used in zip(host_ok, mask) if used):
+                    continue  # intolerable host inside the sub-mesh
                 m = np.zeros(h_max, dtype=bool)
                 m[: len(mask)] = mask
                 cands.append((i, m, rank))
@@ -327,9 +354,9 @@ class TPUPacker:
         # Score packing in _solve_batch needs h^3 + h^2 < 2^30 or infeasible
         # candidates could outrank feasible ones past the _NEG sentinel.
         assert h_max <= 512, f"slice host count {h_max} overflows the solver score packing"
-        cache = self._cand_tensors(slices, h_max)
+        cache = self._cand_tensors(slices, h_max, snapshot)
         class_cands: List[List[Tuple[int, np.ndarray, int]]] = cache["class_cands"]
-        class_ids: Dict[Tuple[str, str, int], int] = cache["class_ids"]
+        class_ids: Dict[Tuple, Optional[int]] = cache["class_ids"]
 
         free = np.zeros((len(slices), h_max), dtype=bool)
         for i, sl in enumerate(slices):
@@ -350,7 +377,7 @@ class TPUPacker:
             if req.num_slices <= 0 or len(pods) % req.num_slices:
                 continue
             pods_per_slice = len(pods) // req.num_slices
-            k = self._class_of(cache, slices, h_max, req, pods_per_slice)
+            k = self._class_of(cache, slices, h_max, req, pods_per_slice, snapshot)
             if k is None:
                 continue
             for sub in range(req.num_slices):
@@ -424,7 +451,7 @@ class TPUPacker:
             subs = sorted(partial[req.key])
             pods = req.sorted_pods()
             pods_per_slice = len(pods) // req.num_slices
-            k = class_ids[(req.tpu_type, req.topology, pods_per_slice)]
+            k = class_ids[(req.tpu_type, req.topology, pods_per_slice, req.toleration_sig())]
 
             # Distinct-slice constraint: each sub-request owns its own
             # physical slice (inter-slice traffic rides DCN; two sub-meshes
@@ -540,6 +567,11 @@ class TPUPacker:
                 for p in r.pods
             )
 
+        # Taints are rare; only tainted node columns pay per-pod matching.
+        tainted_cols = [
+            i for i, n in enumerate(node_names) if snapshot.nodes[n].taints
+        ]
+
         ordered = self._order(requests, now, demand)
         for req in ordered:
             assignments: Dict[str, str] = {}
@@ -553,6 +585,9 @@ class TPUPacker:
                     elif v > 0:
                         rv[:] = np.inf  # unsatisfiable resource
                 feas = np.all(free >= rv, axis=1)
+                for i in tainted_cols:
+                    if not snapshot.tolerated(node_names[i], pod.tolerations):
+                        feas[i] = False
                 if not feas.any():
                     for vec, i in committed:
                         free[i] += vec

@@ -15,7 +15,13 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 from training_operator_tpu.api.jobs import Job
 from training_operator_tpu.cluster.apiserver import APIServer
 from training_operator_tpu.cluster.inventory import TPU_RESOURCE, parse_topology
-from training_operator_tpu.cluster.objects import Node, PodGroup, PodGroupPhase
+from training_operator_tpu.cluster.objects import (
+    Node,
+    PodGroup,
+    PodGroupPhase,
+    toleration_key,
+    tolerates,
+)
 from training_operator_tpu.engine.core import gen_general_name
 
 
@@ -45,6 +51,7 @@ class PodRequest:
     replica_type: str
     index: int
     resources: Dict[str, float]
+    tolerations: List[Dict[str, object]] = field(default_factory=list)
 
 
 @dataclass
@@ -57,8 +64,18 @@ class GangRequest:
     topology: Optional[str] = None
     num_slices: int = 1
     tpu_type: str = ""
+    # INTERSECTION of the member pods' tolerations — TPU gang placement
+    # zips pods across a sub-mesh's hosts with no per-pod choice, so a host
+    # is only usable if EVERY member tolerates its taints (k8s would leave
+    # an untolerated member Pending). The generic path gates per pod via
+    # PodRequest.tolerations.
+    tolerations: List[Dict[str, object]] = field(default_factory=list)
     _sorted_pods: Optional[List[PodRequest]] = None
     _total_chips: Optional[float] = None
+
+    def toleration_sig(self) -> Tuple:
+        """Canonical hashable form — part of the solver's class identity."""
+        return tuple(sorted(toleration_key(t) for t in self.tolerations))
 
     @property
     def key(self) -> str:
@@ -232,6 +249,13 @@ class ClusterSnapshot:
             return False
         return all(avail.get(k, 0.0) >= v for k, v in req.items())
 
+    def tolerated(self, node_name: str, tolerations) -> bool:
+        """Taint gate (k8s semantics; see objects.tolerates)."""
+        node = self.nodes.get(node_name)
+        if node is None or not node.taints:
+            return True
+        return tolerates(node.taints, tolerations)
+
     def commit(self, req: Dict[str, float], node_name: str) -> None:
         """Consume capacity inside a solve so later gangs in the same batch
         see it taken."""
@@ -277,8 +301,23 @@ def build_gang_request(api: APIServer, pg: PodGroup) -> Optional[GangRequest]:
     if job is None:
         return None
     pods: List[PodRequest] = []
+    # Gang tolerations = intersection across replica templates (see
+    # GangRequest.tolerations): a toleration only counts if every member
+    # pod carries it.
+    tol_sets = []
+    by_key: Dict[tuple, Dict[str, object]] = {}
+    for rtype, spec in sorted(job.replica_specs.items()):
+        keys = set()
+        for t in spec.template.tolerations:
+            k = toleration_key(t)
+            keys.add(k)
+            by_key[k] = dict(t)
+        tol_sets.append(keys)
+    common = set.intersection(*tol_sets) if tol_sets else set()
+    gang_tolerations = [by_key[k] for k in sorted(common)]
     for rtype, spec in sorted(job.replica_specs.items()):
         per_pod = spec.template.resources()
+        tols = [dict(t) for t in spec.template.tolerations]
         for i in range(spec.replicas or 0):
             pods.append(
                 PodRequest(
@@ -286,6 +325,7 @@ def build_gang_request(api: APIServer, pg: PodGroup) -> Optional[GangRequest]:
                     replica_type=rtype,
                     index=i,
                     resources=dict(per_pod),
+                    tolerations=tols,
                 )
             )
     topology = pg.topology_request
@@ -300,6 +340,7 @@ def build_gang_request(api: APIServer, pg: PodGroup) -> Optional[GangRequest]:
         topology=topology,
         num_slices=max(1, pg.num_slices),
         tpu_type=tpu_type,
+        tolerations=gang_tolerations,
     )
 
 
