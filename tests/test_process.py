@@ -1,0 +1,122 @@
+"""Operator process + config tests (reference cmd/training-operator.v1/
+main.go flag surface and pkg/config/config.go defaults)."""
+
+import json
+
+import pytest
+
+from training_operator_tpu import __main__ as process
+from training_operator_tpu.config import OperatorConfig, current, set_current
+
+
+def run_main(tmp_path, cluster, workload, extra_args=()):
+    cpath = tmp_path / "cluster.json"
+    cpath.write_text(json.dumps(cluster))
+    argv = ["--cluster", str(cpath), "--virtual-clock", *extra_args]
+    if workload is not None:
+        wpath = tmp_path / "workload.json"
+        wpath.write_text(json.dumps(workload))
+        argv += ["--workload", str(wpath)]
+    return process.main(argv)
+
+
+CLUSTER = {
+    "tpu_pools": [{"slices": 1, "topology": "4x4"}],
+    "cpu_pools": [{"nodes": 2, "cpu_per_node": 8.0}],
+}
+
+
+class TestConfig:
+    def test_defaults_valid(self):
+        OperatorConfig().validate()
+
+    def test_unknown_scheme_rejected(self):
+        with pytest.raises(ValueError):
+            OperatorConfig(enabled_schemes=["jax", "caffe"]).validate()
+
+    def test_unknown_gang_scheduler_rejected(self):
+        with pytest.raises(ValueError):
+            OperatorConfig(gang_scheduler_name="volcano").validate()
+
+    def test_from_file_rejects_unknown_keys(self, tmp_path):
+        p = tmp_path / "cfg.json"
+        p.write_text('{"no_such_knob": 1}')
+        with pytest.raises(ValueError):
+            OperatorConfig.from_file(str(p))
+
+    def test_config_image_reaches_pytorch_init_container(self, tmp_path):
+        prev = current()
+        try:
+            set_current(OperatorConfig(pytorch_init_container_image="busybox:9"))
+            rc = run_main(
+                tmp_path,
+                CLUSTER,
+                [{"kind": "pytorch", "name": "ddp", "workers": 1, "master": True,
+                  "cpu": 1.0, "run_seconds": 1}],
+                extra_args=["--gang-scheduler-name", "none", "--disable-v2"],
+            )
+            assert rc == 0
+        finally:
+            set_current(prev)
+
+
+class TestProcess:
+    def test_end_to_end_mixed_workload(self, tmp_path):
+        rc = run_main(
+            tmp_path,
+            CLUSTER,
+            [
+                {"kind": "jax", "name": "pre", "workers": 4, "chips": 4.0,
+                 "topology": "4x4", "run_seconds": 2},
+                {"kind": "tensorflow", "name": "etl", "workers": 2, "cpu": 1.0,
+                 "run_seconds": 1},
+            ],
+        )
+        assert rc == 0
+
+    def test_disabled_scheme_rejects_submission(self, tmp_path):
+        # Only jax enabled: a pytorch workload entry cannot be reconciled, so
+        # its job never finishes and the process exits non-zero.
+        rc = run_main(
+            tmp_path,
+            CLUSTER,
+            [{"kind": "pytorch", "name": "ddp", "workers": 1, "cpu": 1.0,
+              "run_seconds": 1}],
+            extra_args=["--enable-scheme", "jax", "--run-seconds", "30",
+                        "--gang-scheduler-name", "none", "--disable-v2"],
+        )
+        assert rc == 1
+
+    def test_namespace_scoped_manager_ignores_out_of_scope(self, tmp_path):
+        rc = run_main(
+            tmp_path,
+            CLUSTER,
+            [{"kind": "jax", "name": "other", "namespace": "other-ns",
+              "workers": 1, "cpu": 1.0, "run_seconds": 1}],
+            extra_args=["--namespace", "prod", "--run-seconds", "30",
+                        "--gang-scheduler-name", "none", "--disable-v2"],
+        )
+        assert rc == 1  # out-of-scope job is never reconciled
+
+    def test_gang_scheduler_selection_baseline(self, tmp_path):
+        rc = run_main(
+            tmp_path,
+            CLUSTER,
+            [{"kind": "jax", "name": "pre", "workers": 4, "chips": 4.0,
+              "topology": "4x4", "run_seconds": 1}],
+            extra_args=["--gang-scheduler-name", "baseline"],
+        )
+        assert rc == 0
+
+    def test_metrics_dump(self, tmp_path):
+        out = tmp_path / "metrics.txt"
+        rc = run_main(
+            tmp_path,
+            CLUSTER,
+            [{"kind": "jax", "name": "pre", "workers": 1, "cpu": 1.0,
+              "run_seconds": 1}],
+            extra_args=["--metrics-dump", str(out), "--gang-scheduler-name", "none"],
+        )
+        assert rc == 0
+        text = out.read_text()
+        assert "training_operator_jobs_created_total" in text

@@ -1,0 +1,348 @@
+"""The operator process: `python -m training_operator_tpu`.
+
+Mirrors the reference binaries' flag surface (cmd/training-operator.v1/
+main.go:72-223 and cmd/training-operator.v2alpha1/main.go:63-148): scheme
+selection, gang-scheduler choice, namespace scope, controller threads, probe
+endpoints, plus the config-file path that replaces pkg/config's image
+defaults. Assembles the full in-process stack — API server, default
+scheduler, sim kubelet, gang scheduler, v1 OperatorManager with the enabled
+controllers, v2 TrainJobManager — against a cluster described by a JSON
+inventory, optionally submits a workload file, and runs the loop.
+
+Cluster file schema (all sections optional):
+  {"tpu_pools":  [{"slices": 4, "topology": "4x4", "chips_per_host": 4,
+                   "tpu_type": "v5e"}],
+   "gpu_pools":  [{"nodes": 2, "gpus_per_node": 8,
+                   "nodes_per_nvlink_domain": 4}],
+   "cpu_pools":  [{"nodes": 2, "cpu_per_node": 64.0}]}
+
+Workload file schema: a list of
+  {"kind": "jax"|"pytorch"|"tensorflow"|"xgboost"|"paddle"|"mpi",
+   "name": str, "workers": int, "master": bool?, "cpu": float?,
+   "gpus": float?, "chips": float?, "topology": str?, "num_slices": int?,
+   "run_seconds": float?}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+import time
+
+from training_operator_tpu.api.common import Container, PodTemplateSpec, ReplicaSpec
+from training_operator_tpu.api import jobs as jobs_api
+from training_operator_tpu.api.jobs import ObjectMeta, TPUPolicy
+from training_operator_tpu.cluster.inventory import (
+    GPU_RESOURCE,
+    TPU_RESOURCE,
+    make_cpu_pool,
+    make_gpu_pool,
+    make_tpu_pool,
+)
+from training_operator_tpu.cluster.runtime import (
+    ANNOTATION_SIM_DURATION,
+    Clock,
+    Cluster,
+    DefaultScheduler,
+    SimKubelet,
+    VirtualClock,
+)
+from training_operator_tpu.config import ALL_SCHEMES, OperatorConfig, set_current
+from training_operator_tpu.controllers import OperatorManager
+from training_operator_tpu.controllers.jax import JAXController
+from training_operator_tpu.controllers.mpi import MPIController
+from training_operator_tpu.controllers.paddle import PaddleController
+from training_operator_tpu.controllers.pytorch import PyTorchController
+from training_operator_tpu.controllers.tensorflow import TensorFlowController
+from training_operator_tpu.controllers.xgboost import XGBoostController
+from training_operator_tpu.scheduler import BaselinePlacer, GangScheduler, TPUPacker
+from training_operator_tpu.utils import metrics
+
+log = logging.getLogger("training_operator_tpu")
+
+SCHEME_CONTROLLERS = {
+    "jax": JAXController,
+    "pytorch": PyTorchController,
+    "tensorflow": TensorFlowController,
+    "xgboost": XGBoostController,
+    "paddle": PaddleController,
+    "mpi": MPIController,
+}
+
+JOB_KINDS = {
+    "jax": (jobs_api.JAXJob, "jax"),
+    "pytorch": (jobs_api.PyTorchJob, "pytorch"),
+    "tensorflow": (jobs_api.TFJob, "tensorflow"),
+    "xgboost": (jobs_api.XGBoostJob, "xgboost"),
+    "paddle": (jobs_api.PaddleJob, "paddle"),
+    "mpi": (jobs_api.MPIJob, "mpi"),
+}
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    ap = argparse.ArgumentParser(
+        prog="python -m training_operator_tpu",
+        description="TPU-native training operator process",
+    )
+    ap.add_argument("--config", help="OperatorConfig JSON file (see config.py)")
+    ap.add_argument(
+        "--enable-scheme", action="append", default=None, metavar="SCHEME",
+        help=f"enable a job scheme (repeatable); default: all of {ALL_SCHEMES}",
+    )
+    ap.add_argument(
+        "--gang-scheduler-name", default=None,
+        choices=("none", "tpu-packer", "baseline", "baseline-firstfit"),
+        help="gang scheduling backend (default from config: tpu-packer)",
+    )
+    ap.add_argument("--namespace", default=None, help="namespace scope (default: all)")
+    ap.add_argument("--controller-threads", type=int, default=None,
+                    help="reconciles drained per manager tick")
+    ap.add_argument("--health-probe-port", type=int, default=None,
+                    help="serve /healthz /readyz /metrics on this port (0 = off)")
+    ap.add_argument("--enable-v2", dest="enable_v2", action="store_true", default=None,
+                    help="run the v2 TrainJob/TrainingRuntime stack too")
+    ap.add_argument("--disable-v2", dest="enable_v2", action="store_false")
+    ap.add_argument("--cluster", help="cluster inventory JSON file")
+    ap.add_argument("--workload", help="workload JSON file to submit at start")
+    ap.add_argument("--virtual-clock", action="store_true",
+                    help="simulate on a virtual clock (runs workload to completion)")
+    ap.add_argument("--run-seconds", type=float, default=None,
+                    help="exit after this much (clock) time; default: run forever "
+                         "(real clock) or until the workload finishes (virtual)")
+    ap.add_argument("--metrics-dump", help="write the metrics registry here on exit")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    return ap.parse_args(argv)
+
+
+def build_config(args: argparse.Namespace) -> OperatorConfig:
+    cfg = OperatorConfig.from_file(args.config) if args.config else OperatorConfig()
+    if args.enable_scheme:
+        cfg.enabled_schemes = list(dict.fromkeys(args.enable_scheme))
+    if args.gang_scheduler_name is not None:
+        cfg.gang_scheduler_name = args.gang_scheduler_name
+    if args.namespace is not None:
+        cfg.namespace = args.namespace
+    if args.controller_threads is not None:
+        cfg.controller_threads = args.controller_threads
+    if args.health_probe_port is not None:
+        cfg.health_port = args.health_probe_port
+    if args.enable_v2 is not None:
+        cfg.enable_v2 = args.enable_v2
+    cfg.validate()
+    return cfg
+
+
+def build_cluster(args: argparse.Namespace) -> Cluster:
+    cluster = Cluster(VirtualClock() if args.virtual_clock else Clock())
+    if args.cluster:
+        with open(args.cluster) as f:
+            inv = json.load(f)
+    else:
+        inv = {"cpu_pools": [{"nodes": 2, "cpu_per_node": 8.0}]}
+    for pool in inv.get("tpu_pools", []):
+        cluster.add_nodes(
+            make_tpu_pool(
+                pool.get("slices", 1),
+                slice_topology=pool.get("topology", "4x4"),
+                chips_per_host=pool.get("chips_per_host", 4),
+                tpu_type=pool.get("tpu_type", "v5e"),
+            )
+        )
+    for pool in inv.get("gpu_pools", []):
+        cluster.add_nodes(
+            make_gpu_pool(
+                pool.get("nodes", 1),
+                gpus_per_node=pool.get("gpus_per_node", 8),
+                nodes_per_nvlink_domain=pool.get("nodes_per_nvlink_domain", 4),
+            )
+        )
+    for pool in inv.get("cpu_pools", []):
+        cluster.add_nodes(
+            make_cpu_pool(pool.get("nodes", 1), cpu_per_node=pool.get("cpu_per_node", 8.0))
+        )
+    return cluster
+
+
+def build_stack(cluster: Cluster, cfg: OperatorConfig):
+    DefaultScheduler(cluster)
+    SimKubelet(cluster)
+    gang_enabled = cfg.gang_scheduler_name != "none"
+    if gang_enabled:
+        placer = {
+            "tpu-packer": lambda: TPUPacker(),
+            "baseline": lambda: BaselinePlacer(whole_slice=True),
+            "baseline-firstfit": lambda: BaselinePlacer(whole_slice=False),
+        }[cfg.gang_scheduler_name]()
+        GangScheduler(
+            cluster,
+            placer,
+            prewarm=cfg.gang_scheduler_name == "tpu-packer",
+            resolve_period=cfg.resolve_period,
+            min_solve_interval=cfg.min_solve_interval,
+        )
+    mgr = OperatorManager(
+        cluster,
+        gang_enabled=gang_enabled,
+        reconciles_per_tick=cfg.controller_threads,
+        namespace=cfg.namespace,
+    )
+    for scheme in cfg.enabled_schemes:
+        mgr.register(SCHEME_CONTROLLERS[scheme](cluster.api))
+    v2 = None
+    if cfg.enable_v2:
+        from training_operator_tpu.runtime.controller import TrainJobManager
+
+        v2 = TrainJobManager(cluster)
+    return mgr, v2
+
+
+def load_workload(path: str, mgr: OperatorManager):
+    with open(path) as f:
+        specs = json.load(f)
+    submitted = []
+    for spec in specs:
+        kind_cls, container_name = JOB_KINDS[spec["kind"]]
+        resources = {}
+        if spec.get("cpu"):
+            resources["cpu"] = float(spec["cpu"])
+        if spec.get("gpus"):
+            resources[GPU_RESOURCE] = float(spec["gpus"])
+        if spec.get("chips"):
+            resources[TPU_RESOURCE] = float(spec["chips"])
+        template = PodTemplateSpec(
+            containers=[Container(name=container_name, image=spec.get("image", "trainer"),
+                                  resources=resources or {"cpu": 1.0})]
+        )
+        if spec.get("run_seconds") is not None:
+            template.annotations[ANNOTATION_SIM_DURATION] = str(spec["run_seconds"])
+        replica_specs = {}
+        if spec.get("master"):
+            replica_specs["Master"] = ReplicaSpec(replicas=1, template=template.copy())
+        replica_specs["Worker"] = ReplicaSpec(
+            replicas=int(spec.get("workers", 1)), template=template
+        )
+        kwargs = {}
+        if spec.get("topology"):
+            chips = 1
+            for d in str(spec["topology"]).split("x"):
+                chips *= int(d)
+            kwargs["tpu_policy"] = TPUPolicy(
+                accelerator=spec.get("accelerator", f"v5e-{chips}"),
+                topology=spec["topology"],
+                num_slices=int(spec.get("num_slices", 1)),
+            )
+        job = kind_cls(
+            metadata=ObjectMeta(name=spec["name"], namespace=spec.get("namespace", "default")),
+            replica_specs=replica_specs,
+            **kwargs,
+        )
+        submitted.append(mgr.submit(job))
+    return submitted
+
+
+def serve_probes(cluster: Cluster, port: int) -> threading.Thread:
+    """Tiny stdlib probe server: /healthz, /readyz, /metrics (reference
+    health-probe + metrics bind addresses collapsed into one listener)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path in ("/healthz", "/readyz"):
+                body = b"ok"
+                ctype = "text/plain"
+            elif self.path == "/metrics":
+                body = metrics.registry.render().encode()
+                ctype = "text/plain; version=0.0.4"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    log.info("probe server on 127.0.0.1:%d (/healthz /readyz /metrics)", port)
+    return t
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    cfg = set_current(build_config(args))
+    cluster = build_cluster(args)
+    mgr, _v2 = build_stack(cluster, cfg)
+    log.info(
+        "operator up: schemes=%s gang=%s namespace=%s v2=%s",
+        ",".join(cfg.enabled_schemes), cfg.gang_scheduler_name,
+        cfg.namespace or "<all>", cfg.enable_v2,
+    )
+    if cfg.health_port:
+        serve_probes(cluster, cfg.health_port)
+
+    jobs = []
+    if args.workload:
+        jobs = load_workload(args.workload, mgr)
+        log.info("submitted %d job(s) from %s", len(jobs), args.workload)
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        log.info("signal %s: shutting down", signum)
+        stop.set()
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, on_signal)
+        except ValueError:
+            pass  # non-main thread (tests)
+
+    from training_operator_tpu.api import common as capi
+
+    def workload_done() -> bool:
+        if not jobs:
+            return False
+        live = [cluster.live(j) for j in jobs]
+        return all(j is not None and capi.is_finished(j.status) for j in live)
+
+    deadline = None
+    if args.run_seconds is not None:
+        deadline = cluster.clock.now() + args.run_seconds
+    if isinstance(cluster.clock, VirtualClock):
+        timeout = args.run_seconds if args.run_seconds is not None else 1e9
+        cluster.run_until(lambda: stop.is_set() or workload_done(), timeout=timeout)
+    else:
+        while not stop.is_set():
+            cluster.step()
+            if jobs and workload_done():
+                break
+            if deadline is not None and cluster.clock.now() >= deadline:
+                break
+            time.sleep(0.01)
+
+    done = sum(1 for j in jobs if (lj := cluster.live(j)) is not None and capi.is_finished(lj.status))
+    if jobs:
+        log.info("workload: %d/%d jobs finished", done, len(jobs))
+    if args.metrics_dump:
+        with open(args.metrics_dump, "w") as f:
+            f.write(metrics.registry.render())
+        log.info("metrics written to %s", args.metrics_dump)
+    return 0 if (not jobs or done == len(jobs)) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,89 @@
+"""Operator configuration (reference pkg/config/config.go:36 + the flag
+surface of cmd/training-operator.v1/main.go:72-223).
+
+`OperatorConfig` carries everything the process entry point wires: which job
+kinds are enabled (the reference's --enable-scheme repeated flag), which gang
+scheduler backs PodGroups (--gang-scheduler-name), the namespace scope
+(--namespace), reconcile batch width (--controller-threads analogue), solver
+cadence, probe/metrics ports, and the default images the reference keeps in
+config.Config (e.g. the PyTorch master-wait init container).
+
+A module-level `current()` config replaces the reference's package-global
+config.Config; controllers read defaults through it so deployments can
+override images without touching controller code.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, fields
+from typing import Dict, List, Optional
+
+ALL_SCHEMES = ("jax", "pytorch", "tensorflow", "xgboost", "paddle", "mpi")
+GANG_SCHEDULERS = ("none", "tpu-packer", "baseline", "baseline-firstfit")
+
+
+@dataclass
+class OperatorConfig:
+    # Which job kinds get controllers (reference --enable-scheme; empty =
+    # all, matching the reference's default of every registered scheme).
+    enabled_schemes: List[str] = field(default_factory=lambda: list(ALL_SCHEMES))
+    # Gang scheduling backend: "none" disables PodGroup gating entirely;
+    # "tpu-packer" is the batched placement engine; "baseline"/"baseline-
+    # firstfit" are the comparison placers (reference --gang-scheduler-name,
+    # which selects volcano vs scheduler-plugins).
+    gang_scheduler_name: str = "tpu-packer"
+    # Namespace scope; None/"" watches all namespaces (reference --namespace).
+    namespace: Optional[str] = None
+    # Reconciles drained per manager tick (reference --controller-threads).
+    controller_threads: int = 256
+    # Gang solve cadence (GangScheduler knobs).
+    resolve_period: float = 15.0
+    min_solve_interval: float = 0.0
+    # Probe/metrics HTTP port; 0 disables (reference --health-probe-bind-
+    # address / --metrics-bind-address, collapsed to one server here).
+    health_port: int = 0
+    # Default images (reference pkg/config/config.go Config struct).
+    pytorch_init_container_image: str = "alpine:3.10"
+    init_container_max_tries: int = 100
+    # Enable the v2 TrainJob/TrainingRuntime stack alongside v1.
+    enable_v2: bool = True
+
+    def validate(self) -> None:
+        unknown = [s for s in self.enabled_schemes if s not in ALL_SCHEMES]
+        if unknown:
+            raise ValueError(f"unknown scheme(s) {unknown}; choose from {ALL_SCHEMES}")
+        if self.gang_scheduler_name not in GANG_SCHEDULERS:
+            raise ValueError(
+                f"unknown gang scheduler {self.gang_scheduler_name!r}; "
+                f"choose from {GANG_SCHEDULERS}"
+            )
+        if self.controller_threads < 1:
+            raise ValueError("controller_threads must be >= 1")
+
+    @classmethod
+    def from_file(cls, path: str) -> "OperatorConfig":
+        with open(path) as f:
+            data = json.load(f)
+        known = {f.name for f in fields(cls)}
+        unknown = set(data) - known
+        if unknown:
+            raise ValueError(f"unknown config key(s): {sorted(unknown)}")
+        cfg = cls(**data)
+        cfg.validate()
+        return cfg
+
+
+_current = OperatorConfig()
+
+
+def current() -> OperatorConfig:
+    """The process-wide config (reference package-global config.Config)."""
+    return _current
+
+
+def set_current(cfg: OperatorConfig) -> OperatorConfig:
+    global _current
+    cfg.validate()
+    _current = cfg
+    return cfg

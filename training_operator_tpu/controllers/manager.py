@@ -37,11 +37,15 @@ class OperatorManager:
         cluster: Cluster,
         gang_enabled: bool = False,
         reconciles_per_tick: int = 256,
+        namespace: Optional[str] = None,
     ):
         self.cluster = cluster
         self.api = cluster.api
         self.gang_enabled = gang_enabled
         self.reconciles_per_tick = reconciles_per_tick
+        # Namespace scope (reference --namespace / cache.Options.Namespaces):
+        # events outside the scope are ignored entirely.
+        self.namespace = namespace or None
         self.queue = RateLimitingQueue()
         self.controllers: Dict[str, Tuple[object, JobController]] = {}
         self._watch = self.api.watch()
@@ -105,6 +109,11 @@ class OperatorManager:
     def _handle_event(self, ev) -> None:
         kind = ev.kind
         obj = ev.obj
+        if (
+            self.namespace is not None
+            and getattr(obj.metadata, "namespace", None) not in (None, "", self.namespace)
+        ):
+            return  # out of scope
         if kind in self.controllers:
             if ev.status_only:
                 return  # our own status write echoing back; no work to do

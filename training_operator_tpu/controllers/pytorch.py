@@ -30,7 +30,6 @@ from training_operator_tpu.controllers.base import BaseController
 from training_operator_tpu.engine.core import gen_general_name
 
 INIT_CONTAINER_NAME = "pytorch-init"
-INIT_CONTAINER_IMAGE = "alpine:3.10"  # reference config.Config default
 
 
 class PyTorchController(BaseController):
@@ -91,11 +90,15 @@ class PyTorchController(BaseController):
         # initcontainer.go:104-136 injects an nslookup loop).
         if has_master and rtype == REPLICA_WORKER:
             if not any(c.name == INIT_CONTAINER_NAME for c in template.init_containers):
+                from training_operator_tpu.config import current
+
                 master_addr = gen_general_name(job.name, REPLICA_MASTER, 0)
                 template.init_containers.append(
                     Container(
                         name=INIT_CONTAINER_NAME,
-                        image=INIT_CONTAINER_IMAGE,
+                        # Image comes from the operator config (reference
+                        # pkg/config/config.go default), not a constant.
+                        image=current().pytorch_init_container_image,
                         command=["sh", "-c", f"until nslookup {master_addr}; do sleep 1; done"],
                     )
                 )

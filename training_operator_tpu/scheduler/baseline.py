@@ -80,12 +80,15 @@ class BaselinePlacer:
                 cset = self.candidates.get(sl.topology, sl.chips_per_host, req.topology)
                 if cset is None or cset.hosts_per_slice != sl.num_hosts:
                     continue
+                host_ok = [
+                    snapshot.tolerated(n, req.tolerations) for n in sl.host_nodes
+                ]
                 for mask in cset.masks:  # first feasible candidate wins
                     hosts = [sl.host_nodes[h] for h, used in enumerate(mask) if used]
                     if all(
-                        snapshot.host_free(n, sl.chips_per_host)
-                        and snapshot.tolerated(n, req.tolerations)
-                        for n in hosts
+                        ok for ok, used in zip(host_ok, mask) if used
+                    ) and all(
+                        snapshot.host_free(n, sl.chips_per_host) for n in hosts
                     ):
                         for pod, node in zip(pods[cursor : cursor + need], hosts):
                             assignments[pod.name] = node

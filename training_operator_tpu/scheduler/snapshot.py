@@ -307,6 +307,8 @@ def build_gang_request(api: APIServer, pg: PodGroup) -> Optional[GangRequest]:
     tol_sets = []
     by_key: Dict[tuple, Dict[str, object]] = {}
     for rtype, spec in sorted(job.replica_specs.items()):
+        if not (spec.replicas or 0):
+            continue  # contributes no pods; must not strip the intersection
         keys = set()
         for t in spec.template.tolerations:
             k = toleration_key(t)
