@@ -148,3 +148,149 @@ class TestAutoscaler:
         cluster.run_for(60)  # well past the downscale stabilization window
         job = cluster.api.get("PyTorchJob", "default", "el")
         assert job.replica_specs["Worker"].replicas == 2
+
+
+class TestLiveMetricsAndTPUResize:
+    def test_live_pod_annotation_signal_drives_scaling(self):
+        """No test pokes the metrics source: pods carry a load profile, the
+        ClusterMetricsSource interpolates it as the virtual clock advances,
+        and the HPA grows the job end-to-end."""
+        import json as _json
+
+        from training_operator_tpu.scheduler.elastic import (
+            ANNOTATION_LOAD_PROFILE_PREFIX,
+            ClusterMetricsSource,
+        )
+
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_gpu_pool(8, gpus_per_node=8, nodes_per_nvlink_domain=4))
+        DefaultScheduler(cluster)
+        SimKubelet(cluster)
+        HorizontalAutoscaler(
+            cluster, ClusterMetricsSource(cluster),
+            sync_period=5.0, stabilization_seconds=10.0,
+        )
+        GangScheduler(cluster, TPUPacker())
+        mgr = OperatorManager(cluster, gang_enabled=True)
+        register_all(mgr)
+
+        # max_r=4 pins the fixpoint: after the grow, the new pods' profiles
+        # restart at their own start_time (70), the mix averages above
+        # target, and an unbounded HPA would keep growing past the asserted
+        # size by tick timing.
+        job = elastic_job(max_r=4)
+        # Utilization starts at target (70) and jumps to 140 at t=+30s.
+        profile = _json.dumps([[0, 70.0], [30, 140.0]])
+        for spec in job.replica_specs.values():
+            spec.template.annotations[
+                ANNOTATION_LOAD_PROFILE_PREFIX + "gpu_util"
+            ] = profile
+        mgr.submit(job)
+        assert cluster.run_until(lambda: len(worker_pods(cluster, "el")) == 2, timeout=60)
+        # Before the ramp nothing scales; after t+30 the signal doubles and
+        # desired = ceil(2 * 140/70) = 4.
+        assert cluster.run_until(lambda: len(worker_pods(cluster, "el")) == 4, timeout=200)
+        job = cluster.api.get("PyTorchJob", "default", "el")
+        assert job.replica_specs["Worker"].replicas == 4
+
+    def test_tpu_gang_resize_restarts_whole_gang(self):
+        """TPU elastic contract: scaling moves in whole-slice units — on
+        grow, the gang is re-admitted atomically with more slices and every
+        pod restarts with fresh world-size env."""
+        from training_operator_tpu.api.jobs import JAXJob, TPUPolicy
+        from training_operator_tpu.cluster.inventory import TPU_RESOURCE, make_tpu_pool
+
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_tpu_pool(4, slice_topology="2x4"))
+        DefaultScheduler(cluster)
+        SimKubelet(cluster)
+        GangScheduler(cluster, TPUPacker())
+        mgr = OperatorManager(cluster, gang_enabled=True)
+        register_all(mgr)
+
+        t = PodTemplateSpec(
+            containers=[Container(name="jax", image="trainer",
+                                  resources={TPU_RESOURCE: 4.0})]
+        )
+        job = JAXJob(
+            metadata=ObjectMeta(name="mesh"),
+            replica_specs={"Worker": ReplicaSpec(replicas=2, template=t)},
+            tpu_policy=TPUPolicy(accelerator="v5e-8", topology="2x4", num_slices=1),
+        )
+        mgr.submit(job)
+        assert cluster.run_until(lambda: len(worker_pods(cluster, "mesh")) == 2, timeout=60)
+        first_gen = {p.metadata.uid for p in worker_pods(cluster, "mesh")}
+        assert worker_pods(cluster, "mesh")[0].spec.containers[0].env["NUM_PROCESSES"] == "2"
+
+        # Operator (or HPA) grows the job by one whole slice: 2 -> 4 workers.
+        live = cluster.api.get("JAXJob", "default", "mesh")
+        live.replica_specs["Worker"].replicas = 4
+        cluster.api.update(live)
+
+        assert cluster.run_until(lambda: len(worker_pods(cluster, "mesh")) == 4, timeout=120)
+        pods = worker_pods(cluster, "mesh")
+        # Whole-gang restart: no first-generation pod survived.
+        assert first_gen.isdisjoint({p.metadata.uid for p in pods})
+        # Fresh world-size env everywhere.
+        assert {p.spec.containers[0].env["NUM_PROCESSES"] for p in pods} == {"4"}
+        # The group re-admitted as a 2-slice gang on distinct slices.
+        pg = cluster.api.get("PodGroup", "default", "mesh")
+        assert pg.num_slices == 2 and pg.phase == PodGroupPhase.RUNNING
+        slices_used = {p.node_name.rsplit("-host-", 1)[0] for p in pods}
+        assert len(slices_used) == 2
+        jj = cluster.api.get("JAXJob", "default", "mesh")
+        assert jj.tpu_policy.num_slices == 2
+
+    def test_resize_remesh_restores_trainer_state(self, tmp_path):
+        """The full elastic TPU story: train on a small mesh, checkpoint; the
+        operator grows the job (whole-gang restart); the trainer rebuilds a
+        LARGER mesh for the new world size and resumes from the checkpoint —
+        step count carries over and the loss keeps improving."""
+        import jax
+        import jax.numpy as jnp
+
+        from training_operator_tpu.trainer.checkpoint import (
+            Checkpointer,
+            restore_into_mesh,
+        )
+        from training_operator_tpu.trainer.mesh import MeshSpec, batch_sharding, build_mesh
+        from training_operator_tpu.trainer.model import TransformerConfig
+        from training_operator_tpu.trainer.train import (
+            init_train_state,
+            make_example_batch,
+            make_optimizer,
+            make_train_step,
+        )
+
+        config = TransformerConfig(
+            vocab_size=128, d_model=64, n_layers=1, n_heads=4, n_kv_heads=4,
+            d_ff=128, max_seq_len=64,
+        )
+        devices = jax.devices("cpu")
+        optimizer = make_optimizer(total_steps=20)
+        key = jax.random.PRNGKey(0)
+        batch = make_example_batch(config, batch=4, seq=64, key=key)
+
+        # Phase 1: world size 2 (the 2-worker gang's mesh).
+        mesh_a = build_mesh(MeshSpec({"data": 2}), devices[:2])
+        state = init_train_state(config, optimizer, key, mesh_a)
+        step = make_train_step(config, optimizer, mesh_a)
+        losses = []
+        for _ in range(4):
+            state, metrics = step(state, jax.device_put(batch, batch_sharding(mesh_a)))
+            losses.append(float(metrics["loss"]))
+        ckpt = Checkpointer(str(tmp_path))
+        ckpt.save(state, force=True)
+        ckpt.close()
+
+        # Phase 2: the operator grew the gang to 4 workers -> world size 4.
+        mesh_b = build_mesh(MeshSpec({"data": 4}), devices[:4])
+        resumed = restore_into_mesh(str(tmp_path), config, optimizer, mesh_b)
+        assert int(resumed.step) == int(state.step)  # step carried over
+        step_b = make_train_step(config, optimizer, mesh_b)
+        for _ in range(4):
+            resumed, metrics = step_b(
+                resumed, jax.device_put(batch, batch_sharding(mesh_b))
+            )
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]  # loss kept improving across the resize

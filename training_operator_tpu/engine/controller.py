@@ -454,9 +454,18 @@ class JobController:
                 topology_request=topo,
                 num_slices=num_slices,
             )
-        elif pg.min_member != min_member or pg.min_resources != min_resources:
+        elif (
+            pg.min_member != min_member
+            or pg.min_resources != min_resources
+            or pg.topology_request != topo
+        ):
+            # num_slices is deliberately NOT force-synced here: on elastic
+            # TPU resize the repack path owns the num_slices transition
+            # (derived from the whole-slice contract) together with the
+            # placement release — racing it from here would flap the group.
             pg.min_member = min_member
             pg.min_resources = min_resources
+            pg.topology_request = topo
             self.podgroup_control.update_podgroup(pg)
         return pg
 

@@ -51,6 +51,63 @@ class StaticMetricsSource:
         return self._values.get((namespace, target, metric))
 
 
+# Pods publish custom metrics via annotations; the live source averages them
+# over the target job's running pods:
+#   metrics.tpu.dev/<metric>            — a static current value, or
+#   sim.tpu.dev/load-profile-<metric>   — JSON [[t, v], ...] relative to pod
+#                                         start, step-interpolated at read
+#                                         time (the signal evolves with the
+#                                         clock — nothing pokes the source).
+ANNOTATION_METRIC_PREFIX = "metrics.tpu.dev/"
+ANNOTATION_LOAD_PROFILE_PREFIX = "sim.tpu.dev/load-profile-"
+
+
+class ClusterMetricsSource:
+    """Live custom-metrics feed (the role the reference delegates to a
+    metrics adapter between training pods and the HPA controller,
+    pytorch/hpa.go consuming autoscaling/v2 custom metrics)."""
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+
+    def get(self, namespace: str, target: str, metric: str) -> Optional[float]:
+        import json
+
+        from training_operator_tpu.api.common import JOB_NAME_LABEL
+
+        from training_operator_tpu.cluster.objects import PodPhase
+
+        now = self.cluster.clock.now()
+        values = []
+        for pod in self.cluster.informer.list("Pod"):
+            # RUNNING pods only (k8s HPA semantics): a Pending replica does
+            # no work and must not count toward the average.
+            if pod.namespace != namespace or pod.status.phase != PodPhase.RUNNING:
+                continue
+            if pod.metadata.labels.get(JOB_NAME_LABEL) != target:
+                continue
+            raw = pod.spec.annotations.get(ANNOTATION_METRIC_PREFIX + metric)
+            if raw is None:
+                profile = pod.spec.annotations.get(
+                    ANNOTATION_LOAD_PROFILE_PREFIX + metric
+                )
+                if profile is None or pod.status.start_time is None:
+                    continue
+                t = now - pod.status.start_time
+                value = None
+                for t0, v in json.loads(profile):
+                    if t >= t0:
+                        value = v
+                    else:
+                        break
+                if value is None:
+                    continue
+                values.append(float(value))
+            else:
+                values.append(float(raw))
+        return sum(values) / len(values) if values else None
+
+
 class HorizontalAutoscaler:
     """The HPA control loop (what kube-controller-manager provides upstream)."""
 
@@ -63,7 +120,9 @@ class HorizontalAutoscaler:
     ):
         self.cluster = cluster
         self.api = cluster.api
-        self.metrics = metrics or StaticMetricsSource()
+        # Default to the LIVE pod-annotation feed; tests that want manual
+        # control pass a StaticMetricsSource explicitly.
+        self.metrics = metrics or ClusterMetricsSource(cluster)
         self.sync_period = sync_period
         self.stabilization_seconds = stabilization_seconds
         self._last_scale: Dict[str, float] = {}
@@ -113,7 +172,7 @@ class HorizontalAutoscaler:
 
 
 def repack_grown_gangs(
-    api, placer, snapshot_factory: Callable[[], ClusterSnapshot]
+    api, placer, snapshot_factory: Callable[[], ClusterSnapshot], now: float = 0.0
 ) -> Tuple[int, int]:
     """Incrementally place missing members of admitted gangs.
 
@@ -144,6 +203,11 @@ def repack_grown_gangs(
         req = build_gang_request(api, pg)
         if req is None:
             continue
+        if req.is_tpu():
+            ok, unsat = _resize_tpu_gang(api, placer, snapshot_factory, pg, job, req, now)
+            updated += ok
+            unsatisfied += unsat
+            continue
         want = {p.name for p in req.pods}
         have = set(pg.placement)
         stale = have - want
@@ -155,11 +219,10 @@ def repack_grown_gangs(
         for name in stale:
             pg.placement.pop(name, None)
         if missing:
-            # Elastic membership is a generic (CPU/GPU) concern — the
-            # reference's ElasticPolicy is PyTorchJob-only; TPU gangs keep
-            # fixed meshes. topology=None routes the delta through the
-            # generic best-fit path (NVLink-locality bonus pulls new members
-            # toward the gang's existing domain).
+            # Generic (CPU/GPU) elastic membership: place ONLY the delta.
+            # topology=None routes it through the generic best-fit path
+            # (NVLink-locality bonus pulls new members toward the gang's
+            # existing domain).
             delta = GangRequest(group=pg, pods=missing, topology=None, num_slices=1)
             placements = placer.place([delta], snapshot)
             placement = placements.get(delta.key)
@@ -171,3 +234,89 @@ def repack_grown_gangs(
         api.update(pg, check_version=False)
         updated += 1
     return updated, unsatisfied
+
+
+_REJECTED_SIZE_ANNOTATION = "elastic.tpu.dev/rejected-size"
+
+
+def _resize_tpu_gang(
+    api, placer, snapshot_factory, pg, job, req, now: float
+) -> Tuple[int, int]:
+    """TPU mesh resize = ADMIT-THEN-RESTART (BASELINE.md config 4's TPU arm).
+
+    Membership defines the ICI mesh, so a resized TPU gang cannot be patched
+    member-by-member the way torchrun handles GPU elasticity. The contract:
+    the per-slice worker count is fixed by the topology, and elastic scaling
+    moves in whole-slice units (data parallelism across slices).
+
+    The new shape is solved FIRST, against a trial snapshot with this gang's
+    own capacity released — only a feasible resize tears the running gang
+    down (a grow that cannot fit must not take N running workers to zero;
+    it stays as-is, counted unsatisfied, retried when capacity frees). On a
+    feasible resize, every pod of the job is deleted (not just placed ones —
+    the engine may have pre-created delta pods with stale world-size env)
+    and the group is re-admitted atomically with the precomputed placement;
+    the engine recreates the full pod set with fresh env, and the trainer
+    resumes from its latest checkpoint via restore_into_mesh.
+
+    Non-whole-slice sizes are rejected with a Warning event (deduped via an
+    annotation) — there is no placeable shape to retry.
+
+    Returns (updated, unsatisfied).
+    """
+    from training_operator_tpu.api.common import JOB_NAME_LABEL
+    from training_operator_tpu.cluster.inventory import TPU_RESOURCE
+    from training_operator_tpu.cluster.objects import Event
+
+    old_total = len(pg.placement)
+    new_total = job.total_replicas()
+    per_slice = old_total // max(1, pg.num_slices)
+    if per_slice <= 0 or new_total % per_slice:
+        if pg.metadata.annotations.get(_REJECTED_SIZE_ANNOTATION) != str(new_total):
+            pg.metadata.annotations[_REJECTED_SIZE_ANNOTATION] = str(new_total)
+            api.update(pg, check_version=False)
+            api.record_event(Event(
+                object_kind="PodGroup", object_name=pg.name, namespace=pg.namespace,
+                event_type="Warning", reason="InvalidResize",
+                message=f"TPU gang resize to {new_total} is not a whole number "
+                        f"of {per_slice}-worker slices; keeping {old_total}",
+                timestamp=now,
+            ))
+        return 0, 0
+    new_slices = new_total // per_slice
+
+    # Trial solve: release this gang's own capacity in a throwaway snapshot,
+    # then place the new shape.
+    snapshot = snapshot_factory()
+    own_pods = api.list("Pod", pg.namespace, {JOB_NAME_LABEL: pg.name})
+    for pod in own_pods:
+        if pod.node_name and not pod.is_terminal():
+            avail = snapshot.free.get(pod.node_name)
+            if avail is not None:
+                for k, v in pod.resources().items():
+                    avail[k] = avail.get(k, 0.0) + v
+    for node_name in pg.reserved_nodes:
+        node = snapshot.nodes.get(node_name)
+        avail = snapshot.free.get(node_name)
+        if node is not None and avail is not None:
+            chips = node.capacity.get(TPU_RESOURCE, 0.0)
+            if chips:
+                avail[TPU_RESOURCE] = avail.get(TPU_RESOURCE, 0.0) + chips
+    req.num_slices = new_slices
+    placement = placer.place([req], snapshot, now=now).get(req.key)
+    if placement is None:
+        return 0, 1  # keep running at the old size; retry when capacity frees
+
+    if job.tpu_policy is not None and job.tpu_policy.num_slices != new_slices:
+        job.tpu_policy.num_slices = new_slices
+        api.update(job, check_version=False)
+    for pod in own_pods:
+        api.try_delete("Pod", pod.namespace, pod.name)
+    pg.metadata.annotations.pop(_REJECTED_SIZE_ANNOTATION, None)
+    pg.placement = dict(placement.assignments)
+    pg.reserved_nodes = list(placement.reserved_nodes)
+    pg.num_slices = new_slices
+    pg.min_member = new_total
+    pg.phase = PodGroupPhase.INQUEUE  # pre-admitted with the trial placement
+    api.update(pg, check_version=False)
+    return 1, 0

@@ -205,7 +205,7 @@ class GangScheduler:
 
             self._repack_dirty = False
             updated, unsatisfied = repack_grown_gangs(
-                self.api, self.placer, self._snapshot
+                self.api, self.placer, self._snapshot, now=self.cluster.clock.now()
             )
             self._repack_unsatisfied = unsatisfied > 0
             if updated:
