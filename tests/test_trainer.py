@@ -156,6 +156,77 @@ class TestShardedTraining:
         sharded = self._run_steps(mesh, config)
         np.testing.assert_allclose(single, sharded, rtol=2e-3)
 
+    def test_pipeline_matches_single_device(self):
+        """GPipe schedule over a pipeline=2 mesh: same seed, same loss
+        trajectory as one device — the rotating-buffer schedule must not
+        change the math (VERDICT r1 #10 done-criterion)."""
+        config = tiny_config(n_layers=4, remat=False, pipeline_microbatches=4)
+        single = self._run_steps(None, config)
+        mesh = cpu_mesh(pipeline=2, data=2)
+        piped = self._run_steps(mesh, config)
+        np.testing.assert_allclose(single, piped, rtol=2e-3)
+
+    def test_pipeline_with_tensor_and_fsdp(self):
+        """pipeline composes with tensor + fsdp sharding in one program."""
+        config = tiny_config(n_layers=4, pipeline_microbatches=2)
+        mesh = cpu_mesh(pipeline=2, fsdp=2, tensor=2)
+        losses = self._run_steps(mesh, config)
+        assert all(np.isfinite(l) for l in losses)
+
+    def test_moe_expert_parallel_matches_flat(self):
+        """Switch-MoE with experts sharded over the expert axis: trajectory
+        matches the unsharded run (dispatch/combine all-to-alls are pure
+        data movement)."""
+        config = tiny_config(n_experts=4, remat=False)
+        single = self._run_steps(None, config)
+        mesh = cpu_mesh(expert=2, data=2)
+        sharded = self._run_steps(mesh, config)
+        np.testing.assert_allclose(single, sharded, rtol=2e-2)
+
+    def test_moe_loss_decreases_and_balances(self):
+        """MoE training converges on a fixed batch and the router spreads
+        load: by the end every expert receives a nonzero token share."""
+        import jax.numpy as jnp
+
+        from training_operator_tpu.trainer.model import forward_with_aux
+
+        config = tiny_config(n_experts=4, d_ff=32)
+        mesh = cpu_mesh(expert=2, fsdp=2)
+        optimizer = make_optimizer(learning_rate=1e-2, warmup_steps=1, total_steps=50)
+        state = init_train_state(config, optimizer, jax.random.PRNGKey(0), mesh)
+        step = make_train_step(config, optimizer, mesh)
+        batch = make_example_batch(config, 4, 32, jax.random.PRNGKey(0))
+        batch = jax.device_put(batch, batch_sharding(mesh))
+        first = last = None
+        for _ in range(10):
+            state, metrics = step(state, batch)
+            if first is None:
+                first = float(metrics["loss"])
+            last = float(metrics["loss"])
+        assert last < first - 0.5, (first, last)
+        # Aux (load-balance) loss near its uniform-routing minimum of 1.0,
+        # and no expert starved: every expert gets a nonzero token share.
+        _, aux = jax.jit(
+            lambda p, t: forward_with_aux(p, t, config, mesh)
+        )(state.params, batch["tokens"])
+        assert float(aux["router_balance"]) < 1.6
+        from training_operator_tpu.trainer.model import forward
+
+        logits_fn = jax.jit(lambda p, t: forward(p, t, config, mesh))
+        tokens = batch["tokens"]
+        router = state.params["layers"]["router"][0]  # first layer [D, E]
+        embeds = state.params["embed"][tokens.reshape(-1)]  # rough probe
+        choice = jnp.argmax(embeds.astype(jnp.float32) @ router.astype(jnp.float32), -1)
+        shares = jnp.bincount(choice, length=config.n_experts) / choice.shape[0]
+        assert float(shares.min()) > 0.0, shares
+
+    def test_pipeline_moe_tensor_together(self):
+        """PP + EP + TP in one jitted program on an 8-device mesh."""
+        config = tiny_config(n_layers=4, n_experts=2, pipeline_microbatches=2)
+        mesh = cpu_mesh(pipeline=2, expert=2, tensor=2)
+        losses = self._run_steps(mesh, config)
+        assert all(np.isfinite(l) for l in losses)
+
     def test_loss_decreases_on_fixed_batch(self):
         config = tiny_config()
         mesh = cpu_mesh(fsdp=2)

@@ -69,33 +69,40 @@ class ClusterMetricsSource:
 
     def __init__(self, cluster: Cluster):
         self.cluster = cluster
+        # Parsed load profiles memoized by pod uid (annotations are
+        # immutable post-create; re-parsing JSON every HPA sync is waste).
+        self._profiles: Dict[tuple, list] = {}
 
-    def get(self, namespace: str, target: str, metric: str) -> Optional[float]:
+    def _profile(self, pod, metric: str) -> Optional[list]:
         import json
 
-        from training_operator_tpu.api.common import JOB_NAME_LABEL
+        key = (pod.metadata.uid, metric)
+        if key not in self._profiles:
+            raw = pod.spec.annotations.get(ANNOTATION_LOAD_PROFILE_PREFIX + metric)
+            self._profiles[key] = json.loads(raw) if raw is not None else None
+        return self._profiles[key]
 
+    def get(self, namespace: str, target: str, metric: str) -> Optional[float]:
+        from training_operator_tpu.api.common import JOB_NAME_LABEL
         from training_operator_tpu.cluster.objects import PodPhase
 
         now = self.cluster.clock.now()
         values = []
-        for pod in self.cluster.informer.list("Pod"):
+        # Index-backed list: only the target job's pods, not the cluster.
+        pods = self.cluster.api.list("Pod", namespace, {JOB_NAME_LABEL: target})
+        for pod in pods:
             # RUNNING pods only (k8s HPA semantics): a Pending replica does
             # no work and must not count toward the average.
-            if pod.namespace != namespace or pod.status.phase != PodPhase.RUNNING:
-                continue
-            if pod.metadata.labels.get(JOB_NAME_LABEL) != target:
+            if pod.status.phase != PodPhase.RUNNING:
                 continue
             raw = pod.spec.annotations.get(ANNOTATION_METRIC_PREFIX + metric)
             if raw is None:
-                profile = pod.spec.annotations.get(
-                    ANNOTATION_LOAD_PROFILE_PREFIX + metric
-                )
+                profile = self._profile(pod, metric)
                 if profile is None or pod.status.start_time is None:
                     continue
                 t = now - pod.status.start_time
                 value = None
-                for t0, v in json.loads(profile):
+                for t0, v in profile:
                     if t >= t0:
                         value = v
                     else:

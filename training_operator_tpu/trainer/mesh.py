@@ -2,11 +2,13 @@
 
 The operator exports the requested logical mesh as TPU_MESH_AXES (see
 controllers/jax.py); the trainer builds the physical mesh here. Axis order is
-fixed so collectives ride the right links: `data` and `fsdp` outermost (their
-all-reduces are the biggest but least frequent), `tensor` innermost (its
-all-gathers/reduce-scatters happen per-layer and must ride the fastest ICI
-hops), `sequence` between (ring attention's ppermute is neighbor-only, so any
-contiguous placement works).
+fixed so collectives ride the right links: `pipeline` outermost (stage
+hand-offs are point-to-point and the least bandwidth-hungry — on multi-slice
+jobs this is the axis that rides DCN), then `data`/`fsdp`/`expert` (their
+all-reduces/all-to-alls are big but once-per-step or once-per-layer),
+`tensor` innermost (its all-gathers/reduce-scatters happen per-matmul and
+must ride the fastest ICI hops), `sequence` between (ring attention's
+ppermute is neighbor-only, so any contiguous placement works).
 """
 
 from __future__ import annotations
@@ -19,11 +21,13 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXIS_ORDER = ("data", "fsdp", "sequence", "tensor")
+AXIS_ORDER = ("pipeline", "data", "fsdp", "expert", "sequence", "tensor")
 
-# Batch dims shard over both data-parallel axes; fsdp additionally shards
-# parameters. This is the standard 2D data/weight sharding layout.
-BATCH_AXES = ("data", "fsdp")
+# Batch dims shard over every data-parallel-like axis: `data`, `fsdp` (which
+# additionally shards parameters), and `expert` (whose devices act as data
+# parallel outside MoE layers and receive their experts' tokens via the
+# dispatch all-to-all inside them — the GShard layout).
+BATCH_AXES = ("data", "fsdp", "expert")
 
 
 @dataclass

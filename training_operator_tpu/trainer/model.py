@@ -44,6 +44,15 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # Mixture-of-experts: n_experts > 0 replaces every layer's dense MLP with
+    # a switch (top-1) MoE — experts sharded over the mesh's `expert` axis
+    # (GShard dispatch/combine einsums; XLA inserts the all-to-alls).
+    n_experts: int = 0
+    expert_capacity: float = 1.25  # slots per expert = cap * tokens / E
+    router_aux_coef: float = 0.01  # switch load-balancing loss weight
+    # Pipeline parallelism: microbatch count for the GPipe schedule when the
+    # mesh has a `pipeline` axis (0 = one microbatch per stage).
+    pipeline_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -57,9 +66,23 @@ class TransformerConfig:
 def param_specs(config: TransformerConfig) -> Dict[str, Any]:
     """PartitionSpecs per parameter. Megatron TP: QKV/W1/W3 column-parallel
     (output dim on `tensor`), WO/W2 row-parallel (input dim on `tensor`);
-    `fsdp` shards the complementary dimension. Layer-stacked tensors lead
-    with an unsharded [L] axis. Vocab is tensor-column-parallel in the head
-    (sharded logits feed a sharded-softmax loss)."""
+    `fsdp` shards the complementary dimension; MoE expert stacks lead with
+    the `expert` axis. Layer-stacked tensors lead with an unsharded [L]
+    axis. Vocab is tensor-column-parallel in the head (sharded logits feed
+    a sharded-softmax loss)."""
+    if config.n_experts > 0:
+        mlp = {
+            "router": P(None, None, None),
+            "w1": P(None, "expert", "fsdp", "tensor"),
+            "w3": P(None, "expert", "fsdp", "tensor"),
+            "w2": P(None, "expert", "tensor", "fsdp"),
+        }
+    else:
+        mlp = {
+            "w1": P(None, "fsdp", "tensor"),
+            "w3": P(None, "fsdp", "tensor"),
+            "w2": P(None, "tensor", "fsdp"),
+        }
     return {
         "embed": P(None, ("fsdp", "tensor")),
         "layers": {
@@ -69,9 +92,7 @@ def param_specs(config: TransformerConfig) -> Dict[str, Any]:
             "wv": P(None, "fsdp", "tensor"),
             "wo": P(None, "tensor", "fsdp"),
             "ln2": P(None, None),
-            "w1": P(None, "fsdp", "tensor"),
-            "w3": P(None, "fsdp", "tensor"),
-            "w2": P(None, "tensor", "fsdp"),
+            **mlp,
         },
         "ln_f": P(None),
         "lm_head": P("fsdp", "tensor"),
@@ -101,6 +122,23 @@ def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
     std = dm ** -0.5
     resid_std = std / (2 * c.n_layers) ** 0.5
     L = c.n_layers
+    if c.n_experts > 0:
+        E = c.n_experts
+        # fold_in (not a wider split) so dense-model init for a fixed seed
+        # is bit-identical to pre-MoE builds.
+        k_router = jax.random.fold_in(k_layers, 7)
+        mlp = {
+            "router": normal(k_router, (L, dm, E), std),
+            "w1": normal(ks[4], (L, E, dm, dff), std),
+            "w3": normal(ks[5], (L, E, dm, dff), std),
+            "w2": normal(ks[6], (L, E, dff, dm), resid_std),
+        }
+    else:
+        mlp = {
+            "w1": normal(ks[4], (L, dm, dff), std),
+            "w3": normal(ks[5], (L, dm, dff), std),
+            "w2": normal(ks[6], (L, dff, dm), resid_std),
+        }
     return {
         "embed": normal(k_embed, (c.vocab_size, dm), 1.0),
         "layers": {
@@ -110,9 +148,7 @@ def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
             "wv": normal(ks[2], (L, dm, kv_dim), std),
             "wo": normal(ks[3], (L, q_dim, dm), resid_std),
             "ln2": jnp.ones((L, dm), jnp.float32),
-            "w1": normal(ks[4], (L, dm, dff), std),
-            "w3": normal(ks[5], (L, dm, dff), std),
-            "w2": normal(ks[6], (L, dff, dm), resid_std),
+            **mlp,
         },
         "ln_f": jnp.ones((dm,), jnp.float32),
         "lm_head": normal(k_head, (dm, c.vocab_size), std),
@@ -143,50 +179,141 @@ def _constrain(x: jax.Array, mesh: Optional[Mesh], spec: P) -> jax.Array:
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
+def _moe_mlp(
+    h: jax.Array, lp: Dict[str, jax.Array], config: TransformerConfig,
+    mesh: Optional[Mesh],
+):
+    """Switch (top-1) MoE MLP, GShard dense-dispatch formulation: one-hot
+    dispatch/combine einsums with a static per-expert capacity, experts
+    sharded over the `expert` axis — XLA lowers the dispatch/combine
+    contractions to all-to-alls over that axis. Returns (out, aux) where aux
+    is the switch load-balancing loss for this layer."""
+    c = config
+    b, s, d = h.shape
+    T = b * s
+    E = c.n_experts
+    cap = max(1, int(c.expert_capacity * T / E))
+    x = h.reshape(T, d)
+
+    router_logits = x.astype(jnp.float32) @ lp["router"].astype(jnp.float32)  # [T, E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    gate = probs.max(axis=-1)  # [T]
+    choice = probs.argmax(axis=-1)  # [T]
+    onehot = jax.nn.one_hot(choice, E, dtype=jnp.float32)  # [T, E]
+    # Position of each token within its expert's queue; tokens past the
+    # static capacity are dropped (standard switch behavior — the residual
+    # connection carries them through unchanged).
+    position = (jnp.cumsum(onehot, axis=0) - onehot) * onehot  # [T, E]
+    keep = onehot * (position < cap)  # [T, E]
+    slot = keep[..., None] * jax.nn.one_hot(
+        position.sum(axis=-1).astype(jnp.int32), cap, dtype=jnp.float32
+    )[:, None, :]  # [T, E, cap]
+
+    xin = jnp.einsum("tec,td->ecd", slot.astype(c.dtype), x)  # [E, cap, D]
+    xin = _constrain(xin, mesh, P("expert", None, None))
+    gate_h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xin, lp["w1"].astype(c.dtype)))
+    up = jnp.einsum("ecd,edf->ecf", xin, lp["w3"].astype(c.dtype))
+    y = jnp.einsum("ecf,efd->ecd", gate_h * up, lp["w2"].astype(c.dtype))
+    y = _constrain(y, mesh, P("expert", None, None))
+    combine = (slot * gate[:, None, None]).astype(c.dtype)  # [T, E, cap]
+    out = jnp.einsum("tec,ecd->td", combine, y).reshape(b, s, d)
+
+    # Switch load-balancing loss: E * sum_e (fraction of tokens routed to e)
+    # * (mean router prob of e); minimized by a uniform router.
+    frac = onehot.mean(axis=0)  # [E]
+    mean_prob = probs.mean(axis=0)  # [E]
+    aux = E * jnp.sum(frac * mean_prob)
+    return out, aux
+
+
+def decoder_layer(
+    x: jax.Array,
+    lp: Dict[str, jax.Array],
+    config: TransformerConfig,
+    positions: jax.Array,
+    mesh: Optional[Mesh] = None,
+    attn_impl: str = "auto",
+):
+    """One pre-norm decoder block on [b, s, d]; returns (x, aux). Shared by
+    the flat scan-over-layers path and the pipeline stages (which call it
+    with mesh=None — stage-local activations are constrained at the buffer
+    level by the schedule, see pipeline.py)."""
+    c = config
+    act_spec = P(BATCH_AXES, "sequence", None)
+    b, s, _ = x.shape
+    h = _rms_norm(x, lp["ln1"])
+    q = (h @ lp["wq"].astype(c.dtype)).reshape(b, s, c.n_heads, c.head_dim)
+    k = (h @ lp["wk"].astype(c.dtype)).reshape(b, s, c.n_kv_heads, c.head_dim)
+    v = (h @ lp["wv"].astype(c.dtype)).reshape(b, s, c.n_kv_heads, c.head_dim)
+    q = _rope(q, positions, c.rope_theta)
+    k = _rope(k, positions, c.rope_theta)
+    if c.n_kv_heads != c.n_heads:
+        rep = c.n_heads // c.n_kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    attn = attention(q, k, v, mesh, causal=True, impl=attn_impl)
+    x = x + _constrain(
+        attn.reshape(b, s, c.n_heads * c.head_dim) @ lp["wo"].astype(c.dtype),
+        mesh, act_spec,
+    )
+    h = _rms_norm(x, lp["ln2"])
+    if c.n_experts > 0:
+        moe_out, aux = _moe_mlp(h, lp, c, mesh)
+        x = x + _constrain(moe_out, mesh, act_spec)
+    else:
+        gate = jax.nn.silu(h @ lp["w1"].astype(c.dtype))
+        up = h @ lp["w3"].astype(c.dtype)
+        x = x + _constrain((gate * up) @ lp["w2"].astype(c.dtype), mesh, act_spec)
+        aux = jnp.zeros((), jnp.float32)
+    return x, aux
+
+
+def forward_with_aux(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    config: TransformerConfig,
+    mesh: Optional[Mesh] = None,
+):
+    """tokens [B, S] (S sequence-sharded) -> (logits [B, S, V] float32
+    (V tensor-sharded), aux losses dict). Dispatches to the GPipe schedule
+    when the mesh has a pipeline axis."""
+    from training_operator_tpu.trainer.mesh import axis_size
+
+    c = config
+    act_spec = P(BATCH_AXES, "sequence", None)
+    b, s = tokens.shape
+
+    x = params["embed"].astype(c.dtype)[tokens]
+
+    if mesh is not None and axis_size(mesh, "pipeline") > 1:
+        from training_operator_tpu.trainer.pipeline import pipeline_apply
+
+        x, aux = pipeline_apply(params["layers"], x, config, mesh)
+    else:
+        x = _constrain(x, mesh, act_spec)
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+
+        def layer(x, lp):
+            return decoder_layer(x, lp, c, positions, mesh)
+
+        layer_fn = jax.checkpoint(layer) if c.remat else layer
+        x, aux_layers = jax.lax.scan(layer_fn, x, params["layers"])
+        aux = aux_layers.mean()
+
+    x = _rms_norm(x, params["ln_f"])
+    logits = x.astype(jnp.float32) @ params["lm_head"]
+    logits = _constrain(logits, mesh, P(BATCH_AXES, "sequence", "tensor"))
+    return logits, {"router_balance": aux}
+
+
 def forward(
     params: Dict[str, Any],
     tokens: jax.Array,
     config: TransformerConfig,
     mesh: Optional[Mesh] = None,
 ) -> jax.Array:
-    """tokens [B, S] (S sequence-sharded) -> logits [B, S, V] float32
-    (V tensor-sharded)."""
-    c = config
-    act_spec = P(BATCH_AXES, "sequence", None)
-    b, s = tokens.shape
-    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
-
-    x = params["embed"].astype(c.dtype)[tokens]
-    x = _constrain(x, mesh, act_spec)
-
-    def layer(x, lp):
-        h = _rms_norm(x, lp["ln1"])
-        q = (h @ lp["wq"].astype(c.dtype)).reshape(b, s, c.n_heads, c.head_dim)
-        k = (h @ lp["wk"].astype(c.dtype)).reshape(b, s, c.n_kv_heads, c.head_dim)
-        v = (h @ lp["wv"].astype(c.dtype)).reshape(b, s, c.n_kv_heads, c.head_dim)
-        q = _rope(q, positions, c.rope_theta)
-        k = _rope(k, positions, c.rope_theta)
-        if c.n_kv_heads != c.n_heads:
-            rep = c.n_heads // c.n_kv_heads
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-        attn = attention(q, k, v, mesh, causal=True)
-        x = x + _constrain(
-            attn.reshape(b, s, c.n_heads * c.head_dim) @ lp["wo"].astype(c.dtype),
-            mesh, act_spec,
-        )
-        h = _rms_norm(x, lp["ln2"])
-        gate = jax.nn.silu(h @ lp["w1"].astype(c.dtype))
-        up = h @ lp["w3"].astype(c.dtype)
-        x = x + _constrain((gate * up) @ lp["w2"].astype(c.dtype), mesh, act_spec)
-        return x, None
-
-    layer_fn = jax.checkpoint(layer) if c.remat else layer
-    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
-
-    x = _rms_norm(x, params["ln_f"])
-    logits = x.astype(jnp.float32) @ params["lm_head"]
-    return _constrain(logits, mesh, P(BATCH_AXES, "sequence", "tensor"))
+    """tokens [B, S] -> logits [B, S, V]; see forward_with_aux."""
+    return forward_with_aux(params, tokens, config, mesh)[0]
 
 
 def loss_fn(
@@ -195,10 +322,11 @@ def loss_fn(
     config: TransformerConfig,
     mesh: Optional[Mesh] = None,
 ) -> jax.Array:
-    """Mean next-token cross-entropy; `batch` = {tokens, targets, mask}.
-    Stable log-softmax in float32 over the (possibly tensor-sharded) vocab
-    axis — XLA turns the reductions into reduce-scatters on `tensor`."""
-    logits = forward(params, batch["tokens"], config, mesh)
+    """Mean next-token cross-entropy (+ router load-balancing aux when MoE);
+    `batch` = {tokens, targets, mask}. Stable log-softmax in float32 over
+    the (possibly tensor-sharded) vocab axis — XLA turns the reductions into
+    reduce-scatters on `tensor`."""
+    logits, aux = forward_with_aux(params, batch["tokens"], config, mesh)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     target_logit = jnp.take_along_axis(
         logits, batch["targets"][..., None].astype(jnp.int32), axis=-1
@@ -206,6 +334,10 @@ def loss_fn(
     nll = logz - target_logit
     mask = batch.get("mask")
     if mask is None:
-        return nll.mean()
-    mask = mask.astype(jnp.float32)
-    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        ce = nll.mean()
+    else:
+        mask = mask.astype(jnp.float32)
+        ce = (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    if config.n_experts > 0:
+        return ce + config.router_aux_coef * aux["router_balance"]
+    return ce

@@ -1,0 +1,136 @@
+"""Pipeline parallelism: a GPipe schedule as one SPMD program.
+
+The layer stack's leading [L] axis is folded to [P, L/P] and sharded over
+the mesh's `pipeline` axis; activations live in a rotating buffer
+[P, microbatch, S, D] whose leading axis is pipeline-sharded. Each schedule
+step runs every stage on its resident microbatch (a vmap over the stage
+axis — einsums contract only within a stage, so XLA keeps everything
+stage-local) and then `jnp.roll`s the buffer one stage forward — a roll on
+a sharded axis lowers to a single collective-permute per step, the
+point-to-point hand-off pipelining wants. Stage 0 feeds a fresh microbatch
+each step; the last stage's output is collected once the fill phase ends.
+
+This stays entirely in the jit + sharding-constraint world (no shard_map):
+the schedule is data movement XLA can see, the backward schedule falls out
+of AD (reverse rolls), and per-stage remat bounds activation memory to one
+microbatch per stage. Bubble fraction is (P-1)/(M+P-1) — pick
+`pipeline_microbatches` >= P for reasonable efficiency.
+
+Inside a stage the decoder layers run with mesh=None (no nested sharding
+constraints — the buffer-level constraint pins stage/data/sequence layout
+and XLA propagates it through the vmapped body); attention uses the XLA
+path, so `pipeline` composes with data/fsdp/tensor/expert axes, while
+`sequence` (ring attention's shard_map) is mutually exclusive with it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from training_operator_tpu.trainer.mesh import BATCH_AXES, axis_size
+
+
+def _stage_specs(layer_specs: Dict[str, P]) -> Dict[str, P]:
+    """Layer-stack specs [L, ...] -> stage-folded specs [P, L/P, ...]."""
+    return {
+        name: P("pipeline", None, *spec[1:]) for name, spec in layer_specs.items()
+    }
+
+
+def pipeline_apply(
+    layers: Dict[str, jax.Array],
+    x: jax.Array,
+    config: Any,
+    mesh: Mesh,
+) -> Tuple[jax.Array, jax.Array]:
+    """Run the decoder stack as a GPipe pipeline. `x` is the embedded input
+    [B, S, D]; returns (hidden states [B, S, D], mean router aux loss)."""
+    from training_operator_tpu.trainer.model import decoder_layer, param_specs
+
+    c = config
+    n_stages = axis_size(mesh, "pipeline")
+    if axis_size(mesh, "sequence") > 1:
+        raise ValueError(
+            "pipeline and sequence (ring attention) axes are mutually "
+            "exclusive; shard long sequences within a stage instead"
+        )
+    if c.n_layers % n_stages:
+        raise ValueError(f"n_layers={c.n_layers} not divisible by pipeline={n_stages}")
+    m = c.pipeline_microbatches or n_stages
+    b, s, d = x.shape
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by microbatches {m}")
+    mb = b // m
+    layers_per_stage = c.n_layers // n_stages
+
+    # Fold the layer stack onto stages and pin the stage axis.
+    staged = jax.tree.map(
+        lambda a: a.reshape((n_stages, layers_per_stage) + a.shape[1:]), layers
+    )
+    stage_specs = _stage_specs(param_specs(c)["layers"])
+    staged = {
+        name: jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, stage_specs[name])
+        )
+        for name, arr in staged.items()
+    }
+
+    buf_spec = NamedSharding(mesh, P("pipeline", BATCH_AXES, None, None))
+    x_mb = x.reshape(m, mb, s, d)
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (mb, s))
+
+    def stage_fn(stage_layers, x):
+        """One stage: scan its local layers over one microbatch."""
+
+        def one(x, lp):
+            return decoder_layer(x, lp, c, positions, mesh=None, attn_impl="xla")
+
+        layer_fn = jax.checkpoint(one) if c.remat else one
+        x, aux = jax.lax.scan(layer_fn, x, stage_layers)
+        return x, aux.sum()
+
+    vstages = jax.vmap(stage_fn)  # over the leading stage axis
+
+    n_steps = m + n_stages - 1
+    stage_ids = jnp.arange(n_stages)
+
+    def sched(carry, t):
+        buf, outs, aux = carry
+        # Stage 0 ingests microbatch t (clamped: feed values past the end are
+        # garbage that never reaches a collected output).
+        inp = jax.lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, m - 1), 0, keepdims=False
+        )
+        buf = buf.at[0].set(inp)
+        buf = jax.lax.with_sharding_constraint(buf, buf_spec)
+        y, aux_p = vstages(staged, buf)
+        # Stage p holds microbatch t - p; its aux only counts when that's a
+        # real microbatch (fill/drain steps run on garbage).
+        valid = ((t - stage_ids) >= 0) & ((t - stage_ids) < m)
+        aux = aux + jnp.sum(aux_p * valid)
+        # Collect the last stage's output. During fill (t < P-1) the clamped
+        # index 0 is written with garbage and overwritten at t = P-1; each
+        # index's FINAL write (at t = idx + P - 1) is the real value.
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs, y[-1], jnp.clip(t - (n_stages - 1), 0, m - 1), 0
+        )
+        # Hand activations to the next stage: one collective-permute.
+        buf = jnp.roll(y, 1, axis=0)
+        buf = jax.lax.with_sharding_constraint(buf, buf_spec)
+        return (buf, outs, aux), None
+
+    buf0 = jnp.zeros((n_stages, mb, s, d), x.dtype)
+    outs0 = jnp.zeros((m, mb, s, d), x.dtype)
+    (_, outs, aux), _ = jax.lax.scan(
+        sched, (buf0, outs0, jnp.zeros((), jnp.float32)), jnp.arange(n_steps)
+    )
+    # Mean aux per (layer, microbatch) — matches the flat path's aux.mean().
+    aux = aux / (m * c.n_layers)
+    out = outs.reshape(b, s, d)
+    return jax.lax.with_sharding_constraint(
+        out, NamedSharding(mesh, P(BATCH_AXES, None, None))
+    ), aux
