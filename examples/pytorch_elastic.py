@@ -1,0 +1,89 @@
+"""Elastic PyTorch DDP with a live utilization signal driving the HPA.
+
+The TPU-native analogue of the reference's examples/pytorch/elastic (echo /
+imagenet with torchrun --nnodes MIN:MAX): an ElasticPolicy on the job makes
+the controller create an HPA; the pods publish a utilization profile that
+RISES mid-run, the live ClusterMetricsSource picks it up, the HPA grows the
+worker count, and the gang re-pack places only the delta pods — existing
+members keep their nodes, exactly torchrun's membership contract.
+
+Run: python examples/pytorch_elastic.py
+"""
+
+import json
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import training_operator_tpu.api.common as capi
+from training_operator_tpu.api.common import Container, PodTemplateSpec, ReplicaSpec
+from training_operator_tpu.api.jobs import ElasticPolicy, ObjectMeta, PyTorchJob
+from training_operator_tpu.cluster.inventory import GPU_RESOURCE, make_gpu_pool
+from training_operator_tpu.cluster.runtime import (
+    Cluster,
+    DefaultScheduler,
+    SimKubelet,
+    VirtualClock,
+)
+from training_operator_tpu.controllers import OperatorManager, register_all
+from training_operator_tpu.scheduler import GangScheduler, TPUPacker
+from training_operator_tpu.scheduler.elastic import (
+    ANNOTATION_LOAD_PROFILE_PREFIX,
+    HorizontalAutoscaler,
+)
+
+
+def main() -> None:
+    cluster = Cluster(VirtualClock())
+    cluster.add_nodes(make_gpu_pool(8, gpus_per_node=8, nodes_per_nvlink_domain=4))
+    DefaultScheduler(cluster)
+    SimKubelet(cluster)
+    HorizontalAutoscaler(cluster, sync_period=5.0, stabilization_seconds=10.0)
+    GangScheduler(cluster, TPUPacker())
+    mgr = OperatorManager(cluster, gang_enabled=True)
+    register_all(mgr)
+
+    template = PodTemplateSpec(
+        containers=[
+            Container(
+                name="pytorch",
+                image="ghcr.io/example/ddp-trainer:latest",
+                resources={"cpu": 4.0, GPU_RESOURCE: 8.0},
+            )
+        ]
+    )
+    # Pods report 70% GPU utilization for 30s, then 140% — the HPA formula
+    # desired = ceil(current * actual/target) then doubles the fleet.
+    template.annotations[ANNOTATION_LOAD_PROFILE_PREFIX + "gpu_util"] = json.dumps(
+        [[0, 70.0], [30, 140.0]]
+    )
+    job = PyTorchJob(
+        metadata=ObjectMeta(name="elastic-ddp"),
+        replica_specs={"Worker": ReplicaSpec(replicas=2, template=template)},
+        elastic_policy=ElasticPolicy(
+            min_replicas=2,
+            max_replicas=4,
+            metrics=[{"name": "gpu_util", "target": 70.0}],
+        ),
+    )
+    mgr.submit(job)
+
+    def workers_running():
+        return [
+            p
+            for p in cluster.api.list("Pod", "default", {capi.JOB_NAME_LABEL: "elastic-ddp"})
+            if p.status.phase.value == "Running"
+        ]
+
+    assert cluster.run_until(lambda: len(workers_running()) == 2, timeout=60)
+    print(f"t={cluster.clock.now():6.1f}s  2 workers running; load profile ramping...")
+    assert cluster.run_until(lambda: len(workers_running()) == 4, timeout=300)
+    pods = workers_running()
+    print(f"t={cluster.clock.now():6.1f}s  scaled to {len(pods)} workers:")
+    for p in sorted(pods, key=lambda p: p.name):
+        print(f"   {p.name} -> {p.node_name} (PET_NNODES={p.spec.containers[0].env.get('PET_NNODES')})")
+
+
+if __name__ == "__main__":
+    main()

@@ -316,3 +316,60 @@ class TestMPI:
         )
         env = pods_of(cluster, "mpi4", "Launcher")[0].spec.containers[0].env
         assert env["I_MPI_HYDRA_HOST_FILE"] == "/etc/mpi/hostfile"
+
+
+class TestMPIExecChannel:
+    """The substrate exec channel + ConfigMap mounting (replacing the
+    reference's kubectl-delivery + per-job RBAC, mpijob_controller.go:
+    1227-1393): every path the launcher env references must resolve."""
+
+    def job(self, name="mpix", workers=2):
+        return MPIJob(
+            metadata=ObjectMeta(name=name),
+            replica_specs={
+                "Launcher": ReplicaSpec(replicas=1, template=tmpl("mpi")),
+                "Worker": ReplicaSpec(replicas=workers, template=tmpl("mpi")),
+            },
+            slots_per_worker=2,
+        )
+
+    def test_launcher_mounts_resolve(self):
+        from training_operator_tpu.cluster.runtime import resolve_pod_files
+
+        cluster, mgr = make_env()
+        mgr.submit(self.job())
+        assert cluster.run_until(
+            lambda: len(pods_of(cluster, "mpix", "Launcher")) == 1, timeout=60
+        )
+        launcher = pods_of(cluster, "mpix", "Launcher")[0]
+        files = resolve_pod_files(cluster.api, launcher)
+        # Every env-referenced path exists in the pod's mounted view.
+        env = launcher.spec.containers[0].env
+        assert env["OMPI_MCA_orte_default_hostfile"] in files
+        assert env["OMPI_MCA_plm_rsh_agent"] in files
+        assert files["/etc/mpi/hostfile"].startswith("mpix-worker-0 slots=2")
+        assert "cluster-exec" in files["/etc/mpi/exec-agent"]
+        assert "discover_hosts.sh" in "".join(files)  # elastic discovery too
+
+    def test_exec_channel_reaches_running_workers_only(self):
+        cluster, mgr = make_env()
+        mgr.submit(self.job(name="mpiy"))
+        assert cluster.run_until(
+            lambda: len(pods_of(cluster, "mpiy", "Launcher")) == 1, timeout=60
+        )
+        # The launcher's rsh agent execs into a running worker: recorded.
+        rc, _ = cluster.exec.exec_in_pod("default", "mpiy-worker-0", ["orted"])
+        assert rc == 0
+        assert ("default", "mpiy-worker-0", ("orted",)) in cluster.exec.log
+        # A nonexistent member is refused like a failed rsh.
+        rc, msg = cluster.exec.exec_in_pod("default", "mpiy-worker-9", ["orted"])
+        assert rc == 127 and "not found" in msg
+
+    def test_exec_into_pending_pod_fails(self):
+        from training_operator_tpu.cluster.objects import Pod
+        from training_operator_tpu.api.jobs import ObjectMeta as OM
+
+        cluster, _ = make_env()
+        cluster.api.create(Pod(metadata=OM(name="idle", namespace="default")))
+        rc, msg = cluster.exec.exec_in_pod("default", "idle", ["true"])
+        assert rc == 1 and "not Running" in msg

@@ -314,3 +314,37 @@ class TestDistinctSlices:
         assert cluster.api.list("Pod", "default", {capi.JOB_NAME_LABEL: "cpu-gang"}) == []
         pg = cluster.api.get("PodGroup", "default", "cpu-gang")
         assert pg.phase == PodGroupPhase.PENDING
+
+
+class TestSolveTrace:
+    def test_per_cycle_structured_trace(self):
+        """Every solve cycle leaves a structured record: queue shape, solver
+        geometry, admissions, and post-admission pool state."""
+        import json
+
+        cluster, mgr = make_gang_env(TPUPacker(), slices=2)
+        sched = next(
+            t.__self__ for t in cluster._tickers
+            if isinstance(getattr(t, "__self__", None), GangScheduler)
+        )
+        mgr.submit(make_jax_job("t1", 2, "2x4"))
+        mgr.submit(make_jax_job("t2", 2, "2x4"))
+        assert cluster.run_until(
+            lambda: all(
+                pg.phase.value in ("Inqueue", "Running")
+                for pg in cluster.api.list("PodGroup")
+            )
+            and len(cluster.api.list("PodGroup")) == 2,
+            timeout=60,
+        )
+        trace = sched.dump_trace()
+        assert trace, "no solve cycles recorded"
+        json.dumps(trace)  # serializable as-is
+        rec = trace[0]
+        for key in (
+            "t", "solve_wall_s", "pending", "pending_tpu", "pending_generic",
+            "admitted", "free_tpu_hosts", "whole_free_slices",
+        ):
+            assert key in rec, rec
+        assert rec["solver"]["batch_items"] >= 1  # packer geometry present
+        assert sum(r["admitted"] for r in trace) >= 2

@@ -210,9 +210,6 @@ class TestShardedTraining:
             lambda p, t: forward_with_aux(p, t, config, mesh)
         )(state.params, batch["tokens"])
         assert float(aux["router_balance"]) < 1.6
-        from training_operator_tpu.trainer.model import forward
-
-        logits_fn = jax.jit(lambda p, t: forward(p, t, config, mesh))
         tokens = batch["tokens"]
         router = state.params["layers"]["router"][0]  # first layer [D, E]
         embeds = state.params["embed"][tokens.reshape(-1)]  # rough probe

@@ -68,6 +68,9 @@ class Cluster:
         # the top of every step, read by schedulers/kubelet/benchmarks so
         # full-state scans don't clone the store each tick.
         self.informer = SharedInformer(self.api)
+        # Substrate exec primitive (see ExecChannel): the MPI launchers'
+        # rsh/bootstrap channel into worker pods.
+        self.exec = ExecChannel(self)
         self._tickers: List[Callable[[], None]] = []
         self._timers: List[Tuple[float, int, Callable[[], None]]] = []
         self._timer_seq = itertools.count()
@@ -161,6 +164,70 @@ class Cluster:
 
 def request_fits(request: Dict[str, float], avail: Dict[str, float]) -> bool:
     return all(avail.get(k, 0.0) >= v for k, v in request.items())
+
+
+# The file the exec-agent volume materializes inside pods. MPI launchers
+# point their rsh/bootstrap agent at it; in a real deployment the node agent
+# backs `cluster-exec` — in the substrate, ExecChannel does.
+EXEC_AGENT_SCRIPT = (
+    "#!/bin/sh\n"
+    "# substrate exec channel: exec-agent <host> <command...>\n"
+    'exec cluster-exec "$@"\n'
+)
+
+
+class ExecChannel:
+    """Substrate exec primitive: run a command inside a member pod.
+
+    Replaces the reference MPI controller's kubectl-exec machinery — a
+    kubectl binary smuggled in by an init container plus per-job
+    Role/RoleBinding grants (mpijob_controller.go:1227-1393) — with a
+    first-class runtime capability: the target must exist and be Running,
+    and every invocation is recorded (`log`) so tests can assert the
+    launcher actually reached its workers. No RBAC objects, no delivery
+    container.
+    """
+
+    def __init__(self, cluster: "Cluster"):
+        from collections import deque
+
+        self.cluster = cluster
+        # Bounded ring: long simulations with repeated launcher execs must
+        # not grow memory linearly with sim length.
+        self.log: "deque[Tuple[str, str, Tuple[str, ...]]]" = deque(maxlen=4096)
+
+    def exec_in_pod(self, namespace: str, pod_name: str, argv: List[str]) -> Tuple[int, str]:
+        pod = self.cluster.api.try_get("Pod", namespace, pod_name)
+        if pod is None:
+            return 127, f"pod {namespace}/{pod_name} not found"
+        if pod.status.phase != PodPhase.RUNNING:
+            return 1, f"pod {pod_name} is {pod.status.phase.value}, not Running"
+        self.log.append((namespace, pod_name, tuple(argv)))
+        return 0, ""
+
+
+def resolve_pod_files(api: APIServer, pod: Pod) -> Dict[str, str]:
+    """Materialize a pod's mounted-file view from its volumes — the
+    substrate analogue of kubelet volume mounting. Supported volume shapes
+    (k8s-style dicts on PodTemplateSpec.volumes, with a `mountPath` key):
+
+      {"name": ..., "mountPath": "/etc/mpi", "configMap": {"name": ...}}
+          -> one file per ConfigMap data key under mountPath
+      {"name": ..., "mountPath": "/etc/mpi", "execAgent": {}}
+          -> mountPath/exec-agent backed by the cluster ExecChannel
+    """
+    files: Dict[str, str] = {}
+    for vol in pod.spec.volumes:
+        mount = str(vol.get("mountPath") or "/").rstrip("/")
+        cm_ref = vol.get("configMap")
+        if cm_ref:
+            cm = api.try_get("ConfigMap", pod.namespace, cm_ref.get("name", ""))
+            if cm is not None:
+                for key, content in cm.data.items():
+                    files[f"{mount}/{key}"] = content
+        if "execAgent" in vol:
+            files[f"{mount}/exec-agent"] = EXEC_AGENT_SCRIPT
+    return files
 
 
 class DefaultScheduler:

@@ -73,6 +73,24 @@ class MPIController(BaseController):
         assert isinstance(job, MPIJob)
         if rtype != REPLICA_LAUNCHER:
             return  # workers need no bootstrap env; hostfile names them
+        # Mount the hostfile ConfigMap and the substrate exec-agent at
+        # /etc/mpi, so every path the env below references actually resolves
+        # (cluster.runtime.resolve_pod_files materializes the view; the
+        # exec-agent is backed by the cluster ExecChannel — the primitive
+        # replacing the reference's kubectl-delivery + per-job RBAC).
+        have = {v.get("name") for v in template.volumes}
+        if "mpi-config" not in have:
+            template.volumes.append({
+                "name": "mpi-config",
+                "mountPath": HOSTFILE_MOUNT,
+                "configMap": {"name": job.name + CONFIG_SUFFIX},
+            })
+        if "mpi-exec-agent" not in have:
+            template.volumes.append({
+                "name": "mpi-exec-agent",
+                "mountPath": HOSTFILE_MOUNT,
+                "execAgent": {},
+            })
         hostfile = f"{HOSTFILE_MOUNT}/hostfile"
         impl = job.mpi_implementation
         if impl == MPIImplementation.OPENMPI:

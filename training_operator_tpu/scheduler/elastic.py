@@ -71,7 +71,11 @@ class ClusterMetricsSource:
         self.cluster = cluster
         # Parsed load profiles memoized by pod uid (annotations are
         # immutable post-create; re-parsing JSON every HPA sync is waste).
-        self._profiles: Dict[tuple, list] = {}
+        # Bounded FIFO so elastic pod churn can't grow it without limit.
+        from collections import OrderedDict
+
+        self._profiles: "OrderedDict[tuple, Optional[list]]" = OrderedDict()
+        self._profiles_max = 4096
 
     def _profile(self, pod, metric: str) -> Optional[list]:
         import json
@@ -80,6 +84,8 @@ class ClusterMetricsSource:
         if key not in self._profiles:
             raw = pod.spec.annotations.get(ANNOTATION_LOAD_PROFILE_PREFIX + metric)
             self._profiles[key] = json.loads(raw) if raw is not None else None
+            while len(self._profiles) > self._profiles_max:
+                self._profiles.popitem(last=False)
         return self._profiles[key]
 
     def get(self, namespace: str, target: str, metric: str) -> Optional[float]:

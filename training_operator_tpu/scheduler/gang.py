@@ -98,6 +98,12 @@ class GangScheduler:
         # would silently discard) and persisted onto the group only on the
         # Unschedulable transition.
         self._attempts: Dict[str, int] = {}
+        # Structured per-cycle solve trace (SURVEY §5: the solve path is the
+        # subsystem worth observing; the reference has nothing comparable).
+        # Ring buffer of dicts — one per solve cycle; see _record_trace.
+        from collections import deque
+
+        self.trace = deque(maxlen=2048)
         for pod in self.api.list("Pod"):
             self._observe_pod("Added", pod)
         for pg in self.api.list("PodGroup"):
@@ -220,6 +226,45 @@ class GangScheduler:
 
     # ------------------------------------------------------------------
 
+    def _record_trace(self, now, wall, requests, placements, snapshot) -> None:
+        """One structured record per solve cycle: queue shape, solver work,
+        admissions, and free-capacity/fragmentation state (post-admission:
+        place() commits into the snapshot) — enough to replay WHY a gang
+        waited (queue depth? no candidates? fragmented pool?) without
+        re-running the solve. O(requests) bookkeeping per cycle."""
+        admitted = sum(1 for p in placements.values() if p is not None)
+        tpu_reqs = sum(1 for r in requests if r.is_tpu())
+        free_hosts = 0
+        whole_free_slices = 0
+        for sl in snapshot.slices.values():
+            free = sum(
+                1
+                for n in sl.host_nodes
+                if snapshot.host_free(n, sl.chips_per_host)
+            )
+            free_hosts += free
+            if free == sl.num_hosts:
+                whole_free_slices += 1
+        record = {
+            "t": round(now, 3),
+            "solve_wall_s": round(wall, 6),
+            "pending": len(requests),
+            "pending_tpu": tpu_reqs,
+            "pending_generic": len(requests) - tpu_reqs,
+            "admitted": admitted,
+            "free_tpu_hosts": free_hosts,
+            "whole_free_slices": whole_free_slices,
+        }
+        # The packer publishes its batch geometry; other placers don't.
+        stats = getattr(self.placer, "last_solve_stats", None)
+        if stats:
+            record["solver"] = {k: v for k, v in stats.items()}
+        self.trace.append(record)
+
+    def dump_trace(self) -> List[dict]:
+        """The solve trace as a list (oldest first) — feed to json.dumps."""
+        return list(self.trace)
+
     def _wakeup(self) -> None:
         # No-op timer body: existing so the virtual clock has a reason to
         # stop at the deferred-solve instant; the tick that follows solves.
@@ -283,6 +328,7 @@ class GangScheduler:
         self.solve_walltime_total += wall
         self.cycles += 1
         metrics.scheduler_solve_seconds.observe(wall)
+        self._record_trace(now, wall, requests, placements, snapshot)
         if self.charge_solve_time and isinstance(self.cluster.clock, VirtualClock):
             self.cluster.clock.advance(wall)
 
