@@ -125,10 +125,33 @@ def attention(
     if impl != "xla":
         from training_operator_tpu.trainer.flash import flash_attention, flash_available
 
-        s, d = q.shape[1], q.shape[-1]
-        tiles = s % 128 == 0 and d in (64, 128, 256) and k.shape[2] == q.shape[2]
-        if impl == "flash" or (impl == "auto" and flash_available() and tiles):
-            interpret = not flash_available()
+        d = q.shape[-1]
+        heads, kv_heads = q.shape[2], k.shape[2]
+        # The kernel pads odd sequence lengths itself; GQA expands here
+        # (same HBM cost as the XLA path's repeat). Only the head_dim tile
+        # constraint remains a hardware fact.
+        usable = d in (64, 128, 256) and heads % max(1, kv_heads) == 0
+        # Where will this computation actually run? Concrete (eager) inputs
+        # answer precisely — a CPU-resident array under a TPU default
+        # backend must use the interpreter; tracers fall back to the
+        # backend probe.
+        on_tpu = flash_available()
+        if not isinstance(q, jax.core.Tracer):
+            try:
+                on_tpu = next(iter(q.devices())).platform == "tpu"
+            except Exception:
+                pass
+        if impl == "flash" or (impl == "auto" and on_tpu and usable):
+            if kv_heads != heads:
+                if heads % kv_heads:
+                    raise ValueError(
+                        f"flash attention requires q heads ({heads}) divisible "
+                        f"by kv heads ({kv_heads})"
+                    )
+                rep = heads // kv_heads
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            interpret = not on_tpu
             if mesh is None or all(n == 1 for n in mesh.shape.values()):
                 return flash_attention(q, k, v, causal, 512, 1024, interpret)
             # Sharded path: a pallas_call has no SPMD partitioning rule, so

@@ -7,19 +7,25 @@ scratch across k steps and the output block is written exactly once on the
 last step. Causal q/k block pairs that are fully masked are skipped with
 `pl.when` (predicated execution), halving the work for causal LMs.
 
-Training: wrapped in `jax.custom_vjp` — the forward runs the kernel, the
-backward recomputes attention with the XLA reference implementation and
-differentiates that (flash backward = recompute by construction; this keeps
-the memory win where it matters, in the forward residuals).
+Training: `jax.custom_vjp` with PALLAS kernels in both directions. The
+forward additionally emits the per-row log-sum-exp; the backward recomputes
+attention probabilities blockwise from (q, k, lse) — flash backward IS
+recompute, but tiled so no [S, S] matrix ever hits HBM — in two kernels:
+one accumulating dq over k blocks, one accumulating dk/dv over q blocks.
 
-Layout: [B, S, H, D] at the API (matching attention.py); internally folded to
-[B*H, S, D]. Block sizes default to MXU-friendly 128.
+Shapes: [B, S, H, D] at the API (matching attention.py); internally folded
+to [B*H, S, D]. Sequence lengths that don't tile by 128 are zero-padded and
+key-masked (padded keys can't inflate the softmax; padded query rows are
+sliced off and contribute zero gradient). GQA (fewer KV heads) is handled
+at the wrapper by repeating K/V to the query head count — same memory cost
+as the XLA path, no silent fallback. Block sizes default to MXU-friendly
+(512, 1024), the v5e sweep optimum at seq 2048.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -43,8 +49,36 @@ def _fit_block(s: int, cap: int) -> int:
     return b
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-                  *, scale: float, causal: bool, block_q: int, block_k: int):
+def _pad128(x: jax.Array) -> Tuple[jax.Array, int]:
+    """Zero-pad the sequence axis (1) of [BH?, S, D]-style arrays to a
+    multiple of 128; returns (padded, true_len)."""
+    s = x.shape[1]
+    sp = ((s + 127) // 128) * 128
+    if sp == s:
+        return x, s
+    pad = [(0, 0)] * x.ndim
+    pad[1] = (0, sp - s)
+    return jnp.pad(x, pad), s
+
+
+def _mask_scores(s, qi, ki, block_q, block_k, causal, seq_len, padded_len):
+    """Causal + key-padding mask on one (BQ, BK) score tile."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    keep = k_pos < seq_len if padded_len != seq_len else None
+    if causal:
+        causal_keep = q_pos >= k_pos
+        keep = causal_keep if keep is None else (keep & causal_keep)
+    return s if keep is None else jnp.where(keep, s, _MASK)
+
+
+# ----------------------------------------------------------------------
+# Forward
+# ----------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                      *, scale: float, causal: bool, block_q: int, block_k: int,
+                      seq_len: int, padded_len: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -70,10 +104,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (BQ, BK)
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _MASK)
+        s = _mask_scores(s, qi, ki, block_q, block_k, causal, seq_len, padded_len)
         m_prev = m_ref[:]  # (BQ, 1)
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -89,55 +120,210 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
     def _finalize():
         denom = jnp.maximum(l_ref[:], 1e-30)
         o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:] + jnp.log(denom)
 
 
-def _flash_fwd_impl(
-    q: jax.Array, k: jax.Array, v: jax.Array, *,
-    causal: bool, block_q: int, block_k: int, interpret: bool,
-) -> jax.Array:
-    b, s, h, d = q.shape
-    if s % 128:
-        # Out-of-range padded K rows would silently inflate the softmax
-        # denominator — refuse rather than return wrong numbers.
-        raise ValueError(
-            f"flash_attention requires seq len divisible by 128 (s={s}); "
-            "use the XLA path"
-        )
+def _flash_fwd_folded(qf, kf, vf, *, seq_len, causal, block_q, block_k, interpret):
+    """Kernel launch on folded [BH, SP, D] inputs; returns (out, lse)."""
+    bh, sp, d = qf.shape
     scale = d ** -0.5
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    qf, kf, vf = fold(q), fold(k), fold(v)
-    bq = _fit_block(s, block_q)
-    bk = _fit_block(s, block_k)
-    grid = (b * h, pl.cdiv(s, bq), pl.cdiv(s, bk))
-
+    bq = _fit_block(sp, block_q)
+    bk = _fit_block(sp, block_k)
+    grid = (bh, pl.cdiv(sp, bq), pl.cdiv(sp, bk))
     kernel = functools.partial(
-        _flash_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+        _flash_fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        seq_len=seq_len, padded_len=sp,
     )
-    scratch = [
-        pltpu.VMEM((bq, 1), jnp.float32),
-        pltpu.VMEM((bq, 1), jnp.float32),
-        pltpu.VMEM((bq, d), jnp.float32),
-    ]
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sp, d), qf.dtype),
+            jax.ShapeDtypeStruct((bh, sp, 1), jnp.float32),
+        ],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-        scratch_shapes=scratch,
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return out, lse
 
 
-def _reference(q, k, v, causal):
-    from training_operator_tpu.trainer.attention import plain_attention
+# ----------------------------------------------------------------------
+# Backward: dq over k blocks, then dk/dv over q blocks
+# ----------------------------------------------------------------------
 
-    return plain_attention(q, k, v, causal=causal)
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                         acc_ref, *, scale: float, causal: bool,
+                         block_q: int, block_k: int, seq_len: int, padded_len: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = True
+    if causal:
+        run = ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        s = _mask_scores(s, qi, ki, block_q, block_k, causal, seq_len, padded_len)
+        p = jnp.exp(s - lse_ref[0])  # (BQ, BK); masked entries -> 0
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (BQ, BK)
+        ds = p * (dp - delta_ref[0])
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                          causal: bool, block_q: int, block_k: int,
+                          seq_len: int, padded_len: int):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # Causal: a q block entirely above this k block contributes nothing.
+    run = True
+    if causal:
+        run = qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        s = _mask_scores(s, qi, ki, block_q, block_k, causal, seq_len, padded_len)
+        p = jnp.exp(s - lse_ref[0])  # (BQ, BK)
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BK, D)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0])
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BK, D)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_folded(qf, kf, vf, dof, lse, delta, *, seq_len, causal,
+                      block_q, block_k, interpret):
+    bh, sp, d = qf.shape
+    scale = d ** -0.5
+    bq = _fit_block(sp, block_q)
+    bk = _fit_block(sp, block_k)
+
+    q_spec = pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0))
+    k_spec_dq = pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0))
+    row_spec = pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, scale=scale, causal=causal, block_q=bq,
+            block_k=bk, seq_len=seq_len, padded_len=sp,
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, sp, d), qf.dtype),
+        grid=(bh, pl.cdiv(sp, bq), pl.cdiv(sp, bk)),
+        in_specs=[q_spec, k_spec_dq, k_spec_dq, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    # dk/dv: k blocks in the parallel grid axis, q innermost.
+    q_spec2 = pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0))
+    k_spec2 = pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0))
+    row_spec2 = pl.BlockSpec((1, bq, 1), lambda bh, j, i: (bh, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq,
+            block_k=bk, seq_len=seq_len, padded_len=sp,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sp, d), kf.dtype),
+            jax.ShapeDtypeStruct((bh, sp, d), vf.dtype),
+        ],
+        grid=(bh, pl.cdiv(sp, bk), pl.cdiv(sp, bq)),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=[k_spec2, k_spec2],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+    return dq, dk, dv
+
+
+# ----------------------------------------------------------------------
+# custom_vjp wrapper
+# ----------------------------------------------------------------------
+
+def _fold(x):
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _unfold(x, b, h):
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
+    b, s, h, d = q.shape
+    qf, seq_len = _pad128(_fold(q))
+    kf, _ = _pad128(_fold(k))
+    vf, _ = _pad128(_fold(v))
+    out, lse = _flash_fwd_folded(
+        qf, kf, vf, seq_len=seq_len, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return _unfold(out[:, :s], b, h), lse, seq_len
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -146,26 +332,46 @@ def flash_attention(
     causal: bool = True, block_q: int = 512, block_k: int = 1024,
     interpret: bool = False,
 ) -> jax.Array:
-    """Flash attention on [B, S, H, D]; `interpret=True` runs the kernel in
-    the Pallas interpreter (CPU tests)."""
-    return _flash_fwd_impl(
-        q, k, v, causal=causal, block_q=block_q, block_k=block_k, interpret=interpret
-    )
+    """Flash attention on [B, S, H, D]; `interpret=True` runs the kernels in
+    the Pallas interpreter (CPU tests). Sequence lengths are padded to 128
+    internally; K/V must carry the same head count as Q (GQA expansion
+    happens in attention.py's dispatcher)."""
+    return _fwd_impl(q, k, v, causal, block_q, block_k, interpret)[0]
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_fwd_impl(
-        q, k, v, causal=causal, block_q=block_q, block_k=block_k, interpret=interpret
-    )
-    return out, (q, k, v)
+    out, lse, seq_len = _fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    # Residuals save the RETURNED output (its buffer is shared with the
+    # consumer, so this adds no HBM) — not a folded/padded copy, which would
+    # double per-layer output residuals and erode the memory win.
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, block_q, block_k, interpret, res, g):
-    # Recompute-based backward: differentiate the XLA reference (flash
-    # backward IS recompute; XLA fuses this well and it is exact).
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: _reference(a, b, c, causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    b, s, h, d = q.shape
+    qf, seq_len = _pad128(_fold(q))
+    kf, _ = _pad128(_fold(k))
+    vf, _ = _pad128(_fold(v))
+    dof, _ = _pad128(_fold(g))
+    # delta_i = rowsum(dO_i * O_i) — one elementwise pass, computed in the
+    # unfolded layout (XLA fuses it) then folded/padded to kernel rows;
+    # padded rows give zero.
+    delta_unf = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [B, S, H]
+    delta, _ = _pad128(
+        delta_unf.transpose(0, 2, 1).reshape(b * h, s, 1)
+    )
+    dq, dk, dv = _flash_bwd_folded(
+        qf, kf, vf, dof, lse, delta, seq_len=seq_len, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return (
+        _unfold(dq[:, :s], b, h).astype(q.dtype),
+        _unfold(dk[:, :s], b, h).astype(k.dtype),
+        _unfold(dv[:, :s], b, h).astype(v.dtype),
+    )
 
 
 flash_attention.defvjp(_fwd, _bwd)
