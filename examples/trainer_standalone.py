@@ -89,6 +89,19 @@ def main():
         epoch += 1
     ckpt.save(state, force=True)  # final save regardless of interval
     ckpt.close()
+    # Model export (the v2 ModelConfig.Output path): when the operator
+    # injected MODEL_EXPORT_URI, push the final checkpoint through the
+    # scheme-dispatched initializer providers. Process 0 only.
+    export_uri = os.environ.get("MODEL_EXPORT_URI")
+    if export_uri and int(os.environ.get("PROCESS_ID", "0")) == 0:
+        from training_operator_tpu.initializers import core as init_core
+
+        # Export ONLY the final step's directory (retention keeps up to 3
+        # checkpoints locally; consumers want one model, not a history).
+        final_dir = os.path.join(args.checkpoint_dir, str(done))
+        if not os.path.isdir(final_dir):
+            final_dir = args.checkpoint_dir
+        print("exporting to", init_core.upload(final_dir, export_uri))
     print("done at step", done)
 
 

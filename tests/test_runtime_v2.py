@@ -342,3 +342,42 @@ class TestTolerationsAndTaints:
         cluster.run_for(2.0)
         assert cluster.api.get("Pod", "default", "plain").node_name == ""
         assert cluster.api.get("Pod", "default", "tol").node_name != ""
+
+
+class TestModelExport:
+    def test_output_uri_reaches_trainer_env(self):
+        """ModelConfig.output_storage_uri (reference reserved the field,
+        trainjob_types.go:226-228) rides to the trainer container as
+        MODEL_EXPORT_URI."""
+        from training_operator_tpu.runtime.api import ModelConfig
+
+        cluster, v2 = make_env()
+        v2.submit(tpu_runtime())
+        job = TrainJob(
+            metadata=ObjectMeta(name="ft-export"),
+            runtime_ref=RuntimeRef(name="tpu-v5e-16"),
+            model_config=ModelConfig(
+                input_storage_uri="hf://org/base",
+                output_storage_uri="file:///models/out",
+            ),
+        )
+        v2.submit(job)
+        assert cluster.run_until(
+            lambda: len(cluster.api.list("Pod", "default")) >= 1, timeout=60
+        )
+        pod = cluster.api.list("Pod", "default")[0]
+        assert pod.spec.containers[0].env["MODEL_EXPORT_URI"] == "file:///models/out"
+        # The input side still becomes a model-initializer init container.
+        assert any("model" in c.name for c in pod.spec.init_containers)
+
+    def test_file_provider_roundtrip_upload(self, tmp_path):
+        from training_operator_tpu.initializers.core import download, upload
+
+        src = tmp_path / "artifact"
+        src.mkdir()
+        (src / "weights.bin").write_text("w")
+        out_uri = f"file://{tmp_path}/exported"
+        assert upload(str(src), out_uri) == out_uri
+        assert (tmp_path / "exported" / "weights.bin").read_text() == "w"
+        got = download(out_uri, str(tmp_path / "fetched"))
+        assert (tmp_path / "fetched" / "exported" / "weights.bin").read_text() == "w"

@@ -46,13 +46,21 @@ class InitializerConfig:
 
 
 class Provider(abc.ABC):
-    """reference utils/utils.py:10-27 (abstract config+download)."""
+    """reference utils/utils.py:10-27 (abstract config+download), extended
+    with the EXPORT direction the reference only planned
+    (trainjob_types.go:226-228 ModelConfig.Output): the trainer uploads its
+    final artifacts through the same scheme-dispatched providers."""
 
     scheme: str = ""
 
     @abc.abstractmethod
     def download(self, uri: str, target_dir: str, config: InitializerConfig) -> str:
         """Fetch `uri` into `target_dir`; returns the local path."""
+
+    def upload(self, local_dir: str, uri: str, config: InitializerConfig) -> str:
+        """Push `local_dir` to `uri`; returns the remote uri. Optional —
+        providers that cannot export raise."""
+        raise NotImplementedError(f"{self.scheme}:// provider cannot export")
 
 
 _PROVIDERS: Dict[str, Callable[[], Provider]] = {}
@@ -79,6 +87,14 @@ def download(uri: str, target_dir: str, config: Optional[InitializerConfig] = No
     return get_provider(uri).download(uri, target_dir, config)
 
 
+def upload(local_dir: str, uri: str, config: Optional[InitializerConfig] = None) -> str:
+    """Export a trained artifact directory to `uri` (the ModelConfig.Output
+    path): scheme-dispatched like download. Trainers call this after the
+    final checkpoint when the operator injected MODEL_EXPORT_URI."""
+    config = config or InitializerConfig(storage_uri=uri)
+    return get_provider(uri).upload(local_dir, uri, config)
+
+
 # ---------------------------------------------------------------------------
 # Providers
 # ---------------------------------------------------------------------------
@@ -98,6 +114,12 @@ class FileProvider(Provider):
         else:
             shutil.copy2(src, dest)
         return dest
+
+    def upload(self, local_dir: str, uri: str, config: InitializerConfig) -> str:
+        dest = uri.partition("://")[2] or uri
+        os.makedirs(dest, exist_ok=True)
+        shutil.copytree(local_dir, dest, dirs_exist_ok=True)
+        return uri
 
 
 class HuggingFaceProvider(Provider):
@@ -120,12 +142,39 @@ class HuggingFaceProvider(Provider):
             repo_id=repo, local_dir=target_dir, token=config.access_token
         )
 
+    def upload(self, local_dir: str, uri: str, config: InitializerConfig) -> str:
+        try:
+            from huggingface_hub import HfApi
+        except ImportError as e:  # pragma: no cover - env without hub
+            raise RuntimeError(
+                "huggingface_hub is not installed; hf:// export unavailable"
+            ) from e
+        repo = uri.partition("://")[2]
+        HfApi(token=config.access_token).upload_folder(
+            repo_id=repo, folder_path=local_dir
+        )
+        return uri
+
 
 class S3Provider(Provider):
     """`s3://bucket/prefix` via boto3 (reference storage_initializer/s3.py).
     Import-gated."""
 
     scheme = "s3"
+
+    def upload(self, local_dir: str, uri: str, config: InitializerConfig) -> str:
+        try:
+            import boto3  # type: ignore
+        except ImportError as e:  # pragma: no cover - env without boto3
+            raise RuntimeError("boto3 is not installed; s3:// export unavailable") from e
+        bucket, _, prefix = uri.partition("://")[2].partition("/")
+        s3 = boto3.client("s3")
+        for root, _dirs, files in os.walk(local_dir):
+            for f in files:
+                path = os.path.join(root, f)
+                key = os.path.join(prefix, os.path.relpath(path, local_dir))
+                s3.upload_file(path, bucket, key)
+        return uri
 
     def download(self, uri: str, target_dir: str, config: InitializerConfig) -> str:
         try:

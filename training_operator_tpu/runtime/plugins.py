@@ -225,6 +225,16 @@ class WorkloadBuilderPlugin:
             template.init_containers.append(
                 Container(name=name, image=f"tpu-training/{name}", env=env)
             )
+        # Model EXPORT (reference only reserved the field,
+        # trainjob_types.go:226-228): the output uri rides on the trainer
+        # container — the trainer uploads its final artifacts through
+        # initializers.upload after the last checkpoint (exporters-as-
+        # sidecars would outlive the pod's restart policy semantics).
+        if job.model_config is not None and job.model_config.output_storage_uri:
+            for c in template.containers:
+                c.env.setdefault(
+                    "MODEL_EXPORT_URI", job.model_config.output_storage_uri
+                )
 
     def _apply_pod_overrides(self, template, job: TrainJob) -> None:
         """Full PodSpecOverride application (reference trainjob_types.go:

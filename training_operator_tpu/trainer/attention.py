@@ -109,6 +109,36 @@ def ring_attention(
     )(q, k, v)
 
 
+def ulysses_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh, causal: bool = True
+) -> jax.Array:
+    """All-to-all sequence parallelism (the DeepSpeed-Ulysses construction):
+    inputs arrive sequence-sharded [B, S/sp, H, D]; one all-to-all re-shards
+    them head-sharded [B, S, H/sp, D] so every device runs FULL-sequence
+    attention over its head subset; a second all-to-all restores sequence
+    sharding. Exact (no online-softmax recombination). Trade-off vs ring:
+    two all-to-alls instead of NS neighbor ppermutes — lower latency while
+    heads >= sp x tp and the full [S, S] score tile fits per device; ring
+    wins at extreme context lengths (O(S/NS * S/NS) memory).
+
+    Expressed as sharding constraints: XLA lowers the resharding to
+    all-to-alls over the `sequence` axis — no shard_map needed."""
+    if q.shape[2] % (axis_size(mesh, "sequence") * axis_size(mesh, "tensor")):
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[2]}) divisible by "
+            f"sequence x tensor axis sizes"
+        )
+    head_spec = NamedSharding(mesh, P(BATCH_AXES, None, ("tensor", "sequence"), None))
+    seq_spec = NamedSharding(mesh, P(BATCH_AXES, "sequence", "tensor", None))
+    if not isinstance(q, jax.core.Tracer):
+        q, k, v = (jax.device_put(x, seq_spec) for x in (q, k, v))
+    q = jax.lax.with_sharding_constraint(q, head_spec)
+    k = jax.lax.with_sharding_constraint(k, head_spec)
+    v = jax.lax.with_sharding_constraint(v, head_spec)
+    out = plain_attention(q, k, v, causal=causal)
+    return jax.lax.with_sharding_constraint(out, seq_spec)
+
+
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -117,20 +147,34 @@ def attention(
     causal: bool = True,
     impl: str = "auto",
 ) -> jax.Array:
-    """Dispatch: ring attention when the mesh shards the sequence axis;
-    otherwise the pallas flash kernel on TPU (when shapes tile cleanly) or
-    the XLA fused path. `impl`: "auto" | "flash" | "xla"."""
+    """Dispatch: sequence-sharded meshes use ring attention (default) or
+    Ulysses all-to-all (`impl="ulysses"`); otherwise the pallas flash kernel
+    on TPU or the XLA fused path. `impl`: "auto" | "flash" | "xla" |
+    "ulysses" | "ring".
+
+    GQA (fewer KV heads) is expanded HERE, once, for every backend — ring,
+    Ulysses, flash, and plain all require matching head counts."""
+    heads, kv_heads = q.shape[2], k.shape[2]
+    if kv_heads != heads:
+        if heads % kv_heads:
+            raise ValueError(
+                f"attention requires q heads ({heads}) divisible by kv heads "
+                f"({kv_heads})"
+            )
+        rep = heads // kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     if mesh is not None and axis_size(mesh, "sequence") > 1:
+        if impl == "ulysses":
+            return ulysses_attention(q, k, v, mesh, causal=causal)
         return ring_attention(q, k, v, mesh, causal=causal)
     if impl != "xla":
         from training_operator_tpu.trainer.flash import flash_attention, flash_available
 
         d = q.shape[-1]
-        heads, kv_heads = q.shape[2], k.shape[2]
-        # The kernel pads odd sequence lengths itself; GQA expands here
-        # (same HBM cost as the XLA path's repeat). Only the head_dim tile
-        # constraint remains a hardware fact.
-        usable = d in (64, 128, 256) and heads % max(1, kv_heads) == 0
+        # The kernel pads odd sequence lengths itself; only the head_dim
+        # tile constraint remains a hardware fact.
+        usable = d in (64, 128, 256)
         # Where will this computation actually run? Concrete (eager) inputs
         # answer precisely — a CPU-resident array under a TPU default
         # backend must use the interpreter; tracers fall back to the
@@ -142,15 +186,6 @@ def attention(
             except Exception:
                 pass
         if impl == "flash" or (impl == "auto" and on_tpu and usable):
-            if kv_heads != heads:
-                if heads % kv_heads:
-                    raise ValueError(
-                        f"flash attention requires q heads ({heads}) divisible "
-                        f"by kv heads ({kv_heads})"
-                    )
-                rep = heads // kv_heads
-                k = jnp.repeat(k, rep, axis=2)
-                v = jnp.repeat(v, rep, axis=2)
             interpret = not on_tpu
             if mesh is None or all(n == 1 for n in mesh.shape.values()):
                 return flash_attention(q, k, v, causal, 512, 1024, interpret)

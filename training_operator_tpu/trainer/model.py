@@ -53,6 +53,9 @@ class TransformerConfig:
     # Pipeline parallelism: microbatch count for the GPipe schedule when the
     # mesh has a `pipeline` axis (0 = one microbatch per stage).
     pipeline_microbatches: int = 0
+    # Attention implementation: "auto" (flash on TPU / XLA), "flash", "xla";
+    # on sequence-sharded meshes "ring" (default) or "ulysses" (all-to-all).
+    attn_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -247,10 +250,7 @@ def decoder_layer(
     v = (h @ lp["wv"].astype(c.dtype)).reshape(b, s, c.n_kv_heads, c.head_dim)
     q = _rope(q, positions, c.rope_theta)
     k = _rope(k, positions, c.rope_theta)
-    if c.n_kv_heads != c.n_heads:
-        rep = c.n_heads // c.n_kv_heads
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    # GQA expansion happens inside attention() — one place for every backend.
     attn = attention(q, k, v, mesh, causal=True, impl=attn_impl)
     x = x + _constrain(
         attn.reshape(b, s, c.n_heads * c.head_dim) @ lp["wo"].astype(c.dtype),
@@ -294,7 +294,7 @@ def forward_with_aux(
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
 
         def layer(x, lp):
-            return decoder_layer(x, lp, c, positions, mesh)
+            return decoder_layer(x, lp, c, positions, mesh, attn_impl=c.attn_impl)
 
         layer_fn = jax.checkpoint(layer) if c.remat else layer
         x, aux_layers = jax.lax.scan(layer_fn, x, params["layers"])
