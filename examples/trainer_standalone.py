@@ -100,7 +100,10 @@ def main():
         # checkpoints locally; consumers want one model, not a history).
         final_dir = os.path.join(args.checkpoint_dir, str(done))
         if not os.path.isdir(final_dir):
-            final_dir = args.checkpoint_dir
+            raise SystemExit(
+                f"export: final checkpoint dir {final_dir} not found — "
+                "refusing to upload the whole retention history"
+            )
         print("exporting to", init_core.upload(final_dir, export_uri))
     print("done at step", done)
 

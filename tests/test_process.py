@@ -120,3 +120,33 @@ class TestProcess:
         assert rc == 0
         text = out.read_text()
         assert "training_operator_jobs_created_total" in text
+
+
+class TestSecureMetrics:
+    def test_metrics_token_gates_endpoint(self):
+        """The secure-serving analogue: /metrics 401s without the bearer
+        token; probes stay open."""
+        import urllib.request
+        import urllib.error
+
+        from training_operator_tpu.cluster.runtime import Cluster, VirtualClock
+
+        cluster = Cluster(VirtualClock())
+        server = process.serve_probes(cluster, 18099, metrics_token="s3cret")
+        try:
+            assert (
+                urllib.request.urlopen("http://127.0.0.1:18099/healthz").status == 200
+            )
+            try:
+                urllib.request.urlopen("http://127.0.0.1:18099/metrics")
+                raise AssertionError("unauthenticated /metrics must 401")
+            except urllib.error.HTTPError as e:
+                assert e.code == 401
+            req = urllib.request.Request(
+                "http://127.0.0.1:18099/metrics",
+                headers={"Authorization": "Bearer s3cret"},
+            )
+            assert urllib.request.urlopen(req).status == 200
+        finally:
+            server.shutdown()
+            server.server_close()

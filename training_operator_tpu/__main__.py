@@ -244,9 +244,12 @@ def load_workload(path: str, mgr: OperatorManager):
     return submitted
 
 
-def serve_probes(cluster: Cluster, port: int) -> threading.Thread:
+def serve_probes(cluster: Cluster, port: int, metrics_token: str = None) -> threading.Thread:
     """Tiny stdlib probe server: /healthz, /readyz, /metrics (reference
-    health-probe + metrics bind addresses collapsed into one listener)."""
+    health-probe + metrics bind addresses collapsed into one listener).
+    With `metrics_token` set, /metrics requires `Authorization: Bearer
+    <token>` — the secure-serving analogue of the reference's cert-gated
+    metrics endpoint (probes stay open, like kubelet probes do)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
@@ -255,6 +258,15 @@ def serve_probes(cluster: Cluster, port: int) -> threading.Thread:
                 body = b"ok"
                 ctype = "text/plain"
             elif self.path == "/metrics":
+                import hmac
+
+                if metrics_token and not hmac.compare_digest(
+                    self.headers.get("Authorization", ""),
+                    f"Bearer {metrics_token}",
+                ):
+                    self.send_response(401)
+                    self.end_headers()
+                    return
                 body = metrics.registry.render().encode()
                 ctype = "text/plain; version=0.0.4"
             else:
@@ -274,7 +286,7 @@ def serve_probes(cluster: Cluster, port: int) -> threading.Thread:
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
     log.info("probe server on 127.0.0.1:%d (/healthz /readyz /metrics)", port)
-    return t
+    return server  # caller may .shutdown()/.server_close()
 
 
 def main(argv=None) -> int:
@@ -292,7 +304,7 @@ def main(argv=None) -> int:
         cfg.namespace or "<all>", cfg.enable_v2,
     )
     if cfg.health_port:
-        serve_probes(cluster, cfg.health_port)
+        serve_probes(cluster, cfg.health_port, cfg.metrics_token)
 
     jobs = []
     if args.workload:

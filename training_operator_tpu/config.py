@@ -43,6 +43,11 @@ class OperatorConfig:
     # Probe/metrics HTTP port; 0 disables (reference --health-probe-bind-
     # address / --metrics-bind-address, collapsed to one server here).
     health_port: int = 0
+    # Bearer token required for /metrics when set (the secure-serving
+    # analogue of the reference's cert-gated metrics endpoint,
+    # pkg/cert/cert.go:45 + v2 main.go TLS flags — an in-process stack has
+    # no certs to rotate, but the metrics surface still wants an auth gate).
+    metrics_token: Optional[str] = None
     # Default images (reference pkg/config/config.go Config struct).
     pytorch_init_container_image: str = "alpine:3.10"
     init_container_max_tries: int = 100

@@ -12,6 +12,7 @@ from training_operator_tpu.initializers.core import (
     download,
     get_provider,
     register_provider,
+    upload,
 )
 
 __all__ = [
@@ -20,4 +21,5 @@ __all__ = [
     "download",
     "get_provider",
     "register_provider",
+    "upload",
 ]

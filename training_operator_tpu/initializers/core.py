@@ -90,8 +90,12 @@ def download(uri: str, target_dir: str, config: Optional[InitializerConfig] = No
 def upload(local_dir: str, uri: str, config: Optional[InitializerConfig] = None) -> str:
     """Export a trained artifact directory to `uri` (the ModelConfig.Output
     path): scheme-dispatched like download. Trainers call this after the
-    final checkpoint when the operator injected MODEL_EXPORT_URI."""
-    config = config or InitializerConfig(storage_uri=uri)
+    final checkpoint when the operator injected MODEL_EXPORT_URI. Defaults
+    to env-derived config so ACCESS_TOKEN reaches authenticated backends
+    (hf/s3) exactly like the download side."""
+    if config is None:
+        config = InitializerConfig.from_env()
+        config.storage_uri = uri
     return get_provider(uri).upload(local_dir, uri, config)
 
 

@@ -235,6 +235,11 @@ class WorkloadBuilderPlugin:
                 c.env.setdefault(
                     "MODEL_EXPORT_URI", job.model_config.output_storage_uri
                 )
+                # Authenticated export (hf/s3): the same secret contract the
+                # download side uses — the runtime resolves SECRET_REF into
+                # ACCESS_TOKEN inside the container.
+                if job.model_config.secret_ref:
+                    c.env.setdefault("SECRET_REF", job.model_config.secret_ref)
 
     def _apply_pod_overrides(self, template, job: TrainJob) -> None:
         """Full PodSpecOverride application (reference trainjob_types.go:
