@@ -132,18 +132,17 @@ class TestSecureMetrics:
         from training_operator_tpu.cluster.runtime import Cluster, VirtualClock
 
         cluster = Cluster(VirtualClock())
-        server = process.serve_probes(cluster, 18099, metrics_token="s3cret")
+        server = process.serve_probes(cluster, 0, metrics_token="s3cret")
+        base = f"http://127.0.0.1:{server.server_address[1]}"
         try:
-            assert (
-                urllib.request.urlopen("http://127.0.0.1:18099/healthz").status == 200
-            )
+            assert urllib.request.urlopen(f"{base}/healthz").status == 200
             try:
-                urllib.request.urlopen("http://127.0.0.1:18099/metrics")
+                urllib.request.urlopen(f"{base}/metrics")
                 raise AssertionError("unauthenticated /metrics must 401")
             except urllib.error.HTTPError as e:
                 assert e.code == 401
             req = urllib.request.Request(
-                "http://127.0.0.1:18099/metrics",
+                f"{base}/metrics",
                 headers={"Authorization": "Bearer s3cret"},
             )
             assert urllib.request.urlopen(req).status == 200

@@ -133,10 +133,8 @@ class TestTrainingClient:
             num_nodes=2,
         )
         assert tj.runtime_ref.name == "tpu-jax-default"
-        assert cluster.run_until(
-            lambda: cluster.api.get("TrainJob", "default", "finetune").is_finished(),
-            timeout=60,
-        )
+        done = client.wait_for_trainjob("finetune", timeout=60)
+        assert done.is_finished()
         jj = cluster.api.get("JAXJob", "default", "finetune")
         inits = [c.name for c in jj.replica_specs["Worker"].template.init_containers]
         assert inits == ["dataset-initializer", "model-initializer"]
@@ -168,3 +166,21 @@ class TestInitializers:
         assert cfg.storage_uri == "hf://d"
         assert cfg.target_dir == "/tmp/t"
         assert cfg.access_token == "tok"
+
+
+class TestSecretResolution:
+    def test_secret_ref_resolves_to_token(self):
+        from training_operator_tpu.initializers.core import InitializerConfig
+
+        cfg = InitializerConfig.from_env({
+            "SECRET_REF": "hf-creds",
+            "SECRET_HF_CREDS": "tok-abc",
+        })
+        assert cfg.access_token == "tok-abc"
+        # Explicit ACCESS_TOKEN wins over the reference.
+        cfg = InitializerConfig.from_env({
+            "ACCESS_TOKEN": "direct",
+            "SECRET_REF": "hf-creds",
+            "SECRET_HF_CREDS": "tok-abc",
+        })
+        assert cfg.access_token == "direct"

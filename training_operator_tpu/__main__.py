@@ -244,7 +244,7 @@ def load_workload(path: str, mgr: OperatorManager):
     return submitted
 
 
-def serve_probes(cluster: Cluster, port: int, metrics_token: str = None) -> threading.Thread:
+def serve_probes(cluster: Cluster, port: int, metrics_token: "str | None" = None):
     """Tiny stdlib probe server: /healthz, /readyz, /metrics (reference
     health-probe + metrics bind addresses collapsed into one listener).
     With `metrics_token` set, /metrics requires `Authorization: Bearer
@@ -261,8 +261,8 @@ def serve_probes(cluster: Cluster, port: int, metrics_token: str = None) -> thre
                 import hmac
 
                 if metrics_token and not hmac.compare_digest(
-                    self.headers.get("Authorization", ""),
-                    f"Bearer {metrics_token}",
+                    self.headers.get("Authorization", "").encode("latin-1", "replace"),
+                    f"Bearer {metrics_token}".encode("latin-1", "replace"),
                 ):
                     self.send_response(401)
                     self.end_headers()
@@ -285,8 +285,11 @@ def serve_probes(cluster: Cluster, port: int, metrics_token: str = None) -> thre
     server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
-    log.info("probe server on 127.0.0.1:%d (/healthz /readyz /metrics)", port)
-    return server  # caller may .shutdown()/.server_close()
+    log.info(
+        "probe server on 127.0.0.1:%d (/healthz /readyz /metrics)",
+        server.server_address[1],
+    )
+    return server  # ThreadingHTTPServer; caller may .shutdown()/.server_close()
 
 
 def main(argv=None) -> int:

@@ -37,10 +37,18 @@ class InitializerConfig:
     @classmethod
     def from_env(cls, environ: Optional[Dict[str, str]] = None) -> "InitializerConfig":
         e = dict(os.environ if environ is None else environ)
+        # Credential resolution order: an explicit ACCESS_TOKEN wins; else a
+        # SECRET_REF (the operator's pointer into cluster secrets) resolves
+        # through SECRET_<ref> in the environment — the substrate's stand-in
+        # for a mounted Secret volume.
+        token = e.get("ACCESS_TOKEN") or None
+        secret_ref = e.get("SECRET_REF")
+        if token is None and secret_ref:
+            token = e.get(f"SECRET_{secret_ref.upper().replace('-', '_')}") or None
         return cls(
             storage_uri=e.get("STORAGE_URI", ""),
             target_dir=e.get("TARGET_DIR", DEFAULT_TARGET),
-            access_token=e.get("ACCESS_TOKEN") or None,
+            access_token=token,
             env=e,
         )
 

@@ -160,6 +160,34 @@ class TrainingClient:
         c = capi.get_condition(job.status, cond)
         return c is not None and c.status
 
+    def wait_for_trainjob(
+        self,
+        name: str,
+        namespace: Optional[str] = None,
+        timeout: float = 600,
+        raise_on_failed: bool = True,
+    ) -> TrainJob:
+        """Drive the cluster until the v2 TrainJob reaches a terminal
+        condition (Complete/Failed); returns the final object. The reference
+        v2 SDK is an 18-line stub — this provides the v1 wait ergonomics for
+        the v2 kind."""
+        from training_operator_tpu.runtime.api import TrainJobConditionType
+
+        ns = namespace or self.namespace
+
+        def reached() -> bool:
+            tj = self.api.try_get(TrainJob.KIND, ns, name)
+            if tj is None:
+                return False
+            failed = tj.condition(TrainJobConditionType.FAILED)
+            if raise_on_failed and failed is not None and failed.status:
+                raise RuntimeError(f"TrainJob {name} failed: {failed.message}")
+            return tj.is_finished()
+
+        if self.cluster.run_until(reached, timeout=timeout):
+            return self.api.get(TrainJob.KIND, ns, name)
+        raise TimeoutException(f"timeout waiting for TrainJob {name} to finish")
+
     # -- pods / logs -------------------------------------------------------
 
     def get_job_pod_names(self, name: str, namespace: Optional[str] = None,
