@@ -348,3 +348,53 @@ class TestSolveTrace:
             assert key in rec, rec
         assert rec["solver"]["batch_items"] >= 1  # packer geometry present
         assert sum(r["admitted"] for r in trace) >= 2
+
+
+class TestLargeSliceTopologies:
+    """Packing on big slices with 2D host grids (8x8 chips / 4 per host =
+    a 8x2 host grid): sub-mesh candidates must stay contiguous rectangles
+    and the kernel must place multiple gangs without overlap."""
+
+    def test_submeshes_on_8x8_slice(self):
+        from training_operator_tpu.scheduler.candidates import enumerate_candidates
+
+        cset = enumerate_candidates("8x8", 4, "2x4")
+        assert cset is not None and cset.hosts_per_slice == 16
+        # A 2x4-chip ask on a 8x2 host grid occupies a contiguous block.
+        for mask in cset.masks:
+            used = [i for i, u in enumerate(mask) if u]
+            rows = sorted({i // 2 for i in used})
+            cols = sorted({i % 2 for i in used})
+            assert rows == list(range(rows[0], rows[-1] + 1))
+            assert cols == list(range(cols[0], cols[-1] + 1))
+            assert len(used) == len(rows) * len(cols)  # full rectangle
+
+    def test_pack_multiple_gangs_on_8x8_pool(self):
+        cluster, mgr = make_gang_env(
+            TPUPacker(), slices=2, topology="8x8"
+        )
+        # 4 gangs of 4x4 (4 hosts each) + 4 gangs of 2x4 (2 hosts each)
+        # = 24 hosts over 32 available; all must run concurrently.
+        for i in range(4):
+            mgr.submit(make_jax_job(f"big-{i}", 4, "4x4", duration="30"))
+        for i in range(4):
+            mgr.submit(make_jax_job(f"small-{i}", 2, "2x4", duration="30"))
+        assert cluster.run_until(
+            lambda: sum(
+                1 for p in cluster.api.list("Pod")
+                if p.status.phase.value == "Running"
+            ) == 4 * 4 + 4 * 2,
+            timeout=120,
+        )
+        # No host double-booked.
+        hosts = [p.node_name for p in cluster.api.list("Pod") if p.node_name]
+        assert len(hosts) == len(set(hosts))
+        # Each gang is confined to one slice (contiguity prerequisite).
+        from collections import defaultdict
+        by_job = defaultdict(set)
+        for p in cluster.api.list("Pod"):
+            if p.node_name:
+                by_job[p.metadata.labels.get("training.tpu.dev/job-name")].add(
+                    p.node_name.rsplit("-host-", 1)[0]
+                )
+        assert all(len(slices) == 1 for slices in by_job.values()), by_job

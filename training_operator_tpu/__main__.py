@@ -261,8 +261,8 @@ def serve_probes(cluster: Cluster, port: int, metrics_token: "str | None" = None
                 import hmac
 
                 if metrics_token and not hmac.compare_digest(
-                    self.headers.get("Authorization", "").encode("latin-1", "replace"),
-                    f"Bearer {metrics_token}".encode("latin-1", "replace"),
+                    self.headers.get("Authorization", "").encode("utf-8"),
+                    f"Bearer {metrics_token}".encode("utf-8"),
                 ):
                     self.send_response(401)
                     self.end_headers()

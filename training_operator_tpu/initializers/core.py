@@ -44,7 +44,12 @@ class InitializerConfig:
         token = e.get("ACCESS_TOKEN") or None
         secret_ref = e.get("SECRET_REF")
         if token is None and secret_ref:
-            token = e.get(f"SECRET_{secret_ref.upper().replace('-', '_')}") or None
+            # Normalize every non-alphanumeric to '_' — Secret names allow
+            # '-' and '.', neither of which can appear in an env var name.
+            key = "SECRET_" + "".join(
+                ch if ch.isalnum() else "_" for ch in secret_ref.upper()
+            )
+            token = e.get(key) or None
         return cls(
             storage_uri=e.get("STORAGE_URI", ""),
             target_dir=e.get("TARGET_DIR", DEFAULT_TARGET),
