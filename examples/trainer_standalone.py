@@ -42,7 +42,7 @@ def main():
         )
 
     from training_operator_tpu.trainer.checkpoint import Checkpointer, restore_into_mesh
-    from training_operator_tpu.trainer.data import DataLoader, TokenDataset, process_shard
+    from training_operator_tpu.trainer.data import DataLoader, TokenDataset, prefetch, process_shard
     from training_operator_tpu.trainer.mesh import mesh_from_env
     from training_operator_tpu.trainer.model import TransformerConfig
     from training_operator_tpu.trainer.train import (
@@ -77,7 +77,7 @@ def main():
     done = int(state.step)
     epoch = 0
     while done < args.steps:
-        for batch in loader.epoch(epoch):
+        for batch in prefetch(loader.epoch(epoch), size=2):
             state, metrics = step_fn(state, batch)
             done = int(metrics["step"])
             if done % 5 == 0 or done == args.steps:

@@ -149,3 +149,8 @@ class TestSecureMetrics:
         finally:
             server.shutdown()
             server.server_close()
+
+
+def test_non_ascii_metrics_token_rejected():
+    with pytest.raises(ValueError):
+        OperatorConfig(metrics_token="café").validate()

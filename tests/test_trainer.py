@@ -455,3 +455,23 @@ class TestUlyssesAttention:
         q = jnp.zeros((1, 32, 2, 16))  # 2 heads % (2*2) != 0
         with pytest.raises(ValueError):
             ulysses_attention(q, q, q, mesh)
+
+
+class TestPrefetch:
+    def test_order_and_count_preserved(self):
+        from training_operator_tpu.trainer.data import DataLoader, TokenDataset, prefetch
+
+        ds = TokenDataset.synthetic(64, 16, 24)
+        loader = DataLoader(ds, batch_size=4, shuffle=False)
+        plain = [b["tokens"] for b in loader.epoch(0)]
+        fetched = [b["tokens"] for b in prefetch(loader.epoch(0), size=3)]
+        assert len(plain) == len(fetched) == 6
+        for a, b in zip(plain, fetched):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_short_iterator_and_size_one(self):
+        from training_operator_tpu.trainer.data import prefetch
+
+        assert list(prefetch(iter([]), size=4)) == []
+        assert list(prefetch(iter([1, 2]), size=8)) == [1, 2]
+        assert list(prefetch(iter([1, 2, 3]), size=1)) == [1, 2, 3]

@@ -65,6 +65,12 @@ class OperatorConfig:
             )
         if self.controller_threads < 1:
             raise ValueError("controller_threads must be >= 1")
+        if self.metrics_token is not None and not self.metrics_token.isascii():
+            # HTTP header bytes are latin-1-decoded by the stdlib server;
+            # a non-ASCII token can never round-trip through the comparison
+            # consistently across clients — reject at config time instead of
+            # hard-locking /metrics.
+            raise ValueError("metrics_token must be ASCII")
 
     @classmethod
     def from_file(cls, path: str) -> "OperatorConfig":

@@ -109,3 +109,27 @@ class DataLoader:
                 yield {k: jax.device_put(v, sharding) for k, v in batch.items()}
             else:
                 yield {k: jax.numpy.asarray(v) for k, v in batch.items()}
+
+
+def prefetch(batches: Iterator[Dict[str, jax.Array]], size: int = 2) -> Iterator[Dict[str, jax.Array]]:
+    """Lookahead device feeding: keep `size` batches dispatched ahead of the
+    consumer so host-side slicing and the H2D transfer overlap the running
+    step (device_put is asynchronous — holding references is enough to keep
+    the pipeline full; the standard flax prefetch_to_device pattern). Wrap a
+    DataLoader epoch: `for batch in prefetch(loader.epoch(e), 2): ...`."""
+    import collections
+
+    buf = collections.deque()
+    it = iter(batches)
+    try:
+        for _ in range(max(1, size)):
+            buf.append(next(it))
+    except StopIteration:
+        pass
+    while buf:
+        out = buf.popleft()
+        try:
+            buf.append(next(it))
+        except StopIteration:
+            pass
+        yield out
