@@ -59,7 +59,13 @@ def main():
         max_seq_len=args.seq_len,
     )
     optimizer = make_optimizer(total_steps=args.steps)
-    if args.resume:
+    # Auto-resume whenever checkpoints exist: a preempted pod restarts with
+    # the SAME command, so requiring a --resume flag would turn every
+    # preemption into a crash loop. --resume stays for explicitness.
+    have_ckpt = os.path.isdir(args.checkpoint_dir) and any(
+        name.isdigit() for name in os.listdir(args.checkpoint_dir)
+    )
+    if args.resume or have_ckpt:
         state = restore_into_mesh(args.checkpoint_dir, config, optimizer, mesh)
         print("resumed at step", int(state.step))
     else:

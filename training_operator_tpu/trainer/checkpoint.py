@@ -41,8 +41,14 @@ class Checkpointer:
     def save(self, state: TrainState, step: Optional[int] = None,
              wait: bool = True, force: bool = False) -> bool:
         """`force=True` bypasses save_interval_steps — use for the final
-        save, which otherwise gets silently skipped on off-interval steps."""
+        save, which otherwise gets silently skipped on off-interval steps.
+        Saving onto an existing step OVERWRITES it: correct both for the
+        final forced save landing on a step the interval save just wrote
+        (rewrite of identical state) and for re-training past a rollback
+        (the divergent new state must replace the stale checkpoint)."""
         step = int(state.step) if step is None else step
+        if step in (self.manager.all_steps() or []):
+            self.manager.delete(step)
         saved = self.manager.save(step, args=ocp.args.StandardSave(state), force=force)
         if wait:
             self.manager.wait_until_finished()
