@@ -311,7 +311,9 @@ def granular_oracle(
         if pool == "cpu":
             return demand <= cpus + 1e-9
         if pool == "gpu":
-            return shape <= 8.0 and k <= N
+            # Must match place(): multiple workers can share a node, so k
+            # workers of `shape` GPUs fit iff k <= N * floor(8/shape).
+            return 0 < shape <= 8.0 and k <= N * int(8.0 // shape)
         return shape in hosts_needed and k <= S
     pending = [j for j in jobs if placeable_ever(j)]
     events = []

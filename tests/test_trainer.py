@@ -350,6 +350,46 @@ class TestCheckpoint:
             np.asarray(restored.params["embed"]), np.asarray(state.params["embed"]), atol=0
         )
 
+    def test_overwrite_same_step_is_crash_safe(self, tmp_path):
+        """Overwriting a step (the forced final save landing on the interval
+        save's step) must keep the old copy durable until the new one is
+        written — and leave no stale directory behind."""
+        import os
+
+        from training_operator_tpu.trainer.checkpoint import Checkpointer
+
+        config = tiny_config()
+        optimizer = make_optimizer(warmup_steps=1, total_steps=50)
+        mesh = cpu_mesh(fsdp=2)
+        state = init_train_state(config, optimizer, jax.random.PRNGKey(0), mesh)
+        ckpt = Checkpointer(str(tmp_path / "ckpt"), max_to_keep=1)
+        assert ckpt.save(state, force=True)
+        # Leftover stale dir from a hypothetical interrupted overwrite is
+        # swept, and the overwrite itself succeeds.
+        stale = str(tmp_path / "ckpt") + ".stale.0"
+        os.makedirs(stale, exist_ok=True)
+        assert ckpt.save(state, force=True)
+        assert not os.path.isdir(stale)
+        assert ckpt.latest_step() == 0
+        template = init_train_state(config, optimizer, jax.random.PRNGKey(7), mesh)
+        restored = ckpt.restore(template)
+        ckpt.close()
+        np.testing.assert_allclose(
+            np.asarray(restored.params["embed"]), np.asarray(state.params["embed"]), atol=0
+        )
+        # Preemption between move-aside and replacement save: the step dir
+        # is gone and only the stale copy remains. A fresh Checkpointer must
+        # recover it so auto-resume still finds the newest checkpoint.
+        os.rename(str(tmp_path / "ckpt" / "0"), stale)
+        ckpt2 = Checkpointer(str(tmp_path / "ckpt"), max_to_keep=1)
+        assert not os.path.isdir(stale)
+        assert ckpt2.latest_step() == 0
+        restored2 = ckpt2.restore(template)
+        ckpt2.close()
+        np.testing.assert_allclose(
+            np.asarray(restored2.params["embed"]), np.asarray(state.params["embed"]), atol=0
+        )
+
     def test_elastic_remesh_restore(self, tmp_path):
         """Resize story: train on a 4-way mesh, restore onto a 2-way mesh;
         the restored state must continue training bit-compatibly."""

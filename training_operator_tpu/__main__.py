@@ -103,6 +103,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                     help="reconciles drained per manager tick")
     ap.add_argument("--health-probe-port", type=int, default=None,
                     help="serve /healthz /readyz /metrics on this port (0 = off)")
+    ap.add_argument("--health-probe-bind-address", default=None,
+                    help="probe/metrics listener bind address (default 127.0.0.1; "
+                         "use 0.0.0.0 so external probes can reach it)")
     ap.add_argument("--enable-v2", dest="enable_v2", action="store_true", default=None,
                     help="run the v2 TrainJob/TrainingRuntime stack too")
     ap.add_argument("--disable-v2", dest="enable_v2", action="store_false")
@@ -130,6 +133,8 @@ def build_config(args: argparse.Namespace) -> OperatorConfig:
         cfg.controller_threads = args.controller_threads
     if args.health_probe_port is not None:
         cfg.health_port = args.health_probe_port
+    if args.health_probe_bind_address is not None:
+        cfg.health_bind_address = args.health_probe_bind_address
     if args.enable_v2 is not None:
         cfg.enable_v2 = args.enable_v2
     cfg.validate()
@@ -244,7 +249,8 @@ def load_workload(path: str, mgr: OperatorManager):
     return submitted
 
 
-def serve_probes(cluster: Cluster, port: int, metrics_token: "str | None" = None):
+def serve_probes(cluster: Cluster, port: int, metrics_token: "str | None" = None,
+                 bind_address: str = "127.0.0.1"):
     """Tiny stdlib probe server: /healthz, /readyz, /metrics (reference
     health-probe + metrics bind addresses collapsed into one listener).
     With `metrics_token` set, /metrics requires `Authorization: Bearer
@@ -282,12 +288,12 @@ def serve_probes(cluster: Cluster, port: int, metrics_token: "str | None" = None
         def log_message(self, *a):  # quiet
             pass
 
-    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    server = ThreadingHTTPServer((bind_address, port), Handler)
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
     log.info(
-        "probe server on 127.0.0.1:%d (/healthz /readyz /metrics)",
-        server.server_address[1],
+        "probe server on %s:%d (/healthz /readyz /metrics)",
+        bind_address, server.server_address[1],
     )
     return server  # ThreadingHTTPServer; caller may .shutdown()/.server_close()
 
@@ -307,7 +313,8 @@ def main(argv=None) -> int:
         cfg.namespace or "<all>", cfg.enable_v2,
     )
     if cfg.health_port:
-        serve_probes(cluster, cfg.health_port, cfg.metrics_token)
+        serve_probes(cluster, cfg.health_port, cfg.metrics_token,
+                     cfg.health_bind_address)
 
     jobs = []
     if args.workload:

@@ -8,6 +8,7 @@ fields the reconcile engine and placement engine actually consume.
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import enum
 from dataclasses import dataclass, field
@@ -81,6 +82,20 @@ class Pod:
             memo = self.spec.resources()
             self.__dict__["_resources_memo"] = memo
         return memo
+
+    def __deepcopy__(self, memo):
+        # Copies (API-server clones, watch snapshots, templates) must not
+        # inherit the resources() memo: a template-derived pod may mutate
+        # container resources before create, and a stale total would leak
+        # into scheduler capacity accounting.
+        cls = self.__class__
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        for k, v in self.__dict__.items():
+            if k == "_resources_memo":
+                continue
+            new.__dict__[k] = copy.deepcopy(v, memo)
+        return new
 
     def effective_restart_policy(self) -> RestartPolicy:
         return self.spec.restart_policy or RestartPolicy.ON_FAILURE

@@ -43,6 +43,10 @@ class OperatorConfig:
     # Probe/metrics HTTP port; 0 disables (reference --health-probe-bind-
     # address / --metrics-bind-address, collapsed to one server here).
     health_port: int = 0
+    # Probe/metrics listener bind address. 127.0.0.1 keeps the in-process
+    # sim private; real deployments set 0.0.0.0 so kubelet-style external
+    # probes can reach /healthz (reference --health-probe-bind-address).
+    health_bind_address: str = "127.0.0.1"
     # Bearer token required for /metrics when set (the secure-serving
     # analogue of the reference's cert-gated metrics endpoint,
     # pkg/cert/cert.go:45 + v2 main.go TLS flags — an in-process stack has

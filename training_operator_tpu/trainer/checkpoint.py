@@ -17,6 +17,7 @@ it is a real subsystem:
 from __future__ import annotations
 
 import os
+import shutil
 from typing import Any, Optional
 
 import jax
@@ -30,6 +31,7 @@ from training_operator_tpu.trainer.train import TrainState, template_train_state
 class Checkpointer:
     def __init__(self, directory: str, max_to_keep: int = 3, save_interval_steps: int = 1):
         self.directory = os.path.abspath(directory)
+        self._recover_interrupted_overwrites()
         self.manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -38,6 +40,27 @@ class Checkpointer:
             ),
         )
 
+    def _recover_interrupted_overwrites(self) -> None:
+        """If a previous process was preempted between moving a step aside
+        and finishing its replacement save, the only durable copy of that
+        step lives in `<dir>.stale.<step>`. Restore it so auto-resume sees
+        it; if the replacement did land, just drop the stale copy."""
+        parent = os.path.dirname(self.directory)
+        prefix = os.path.basename(self.directory) + ".stale."
+        if not os.path.isdir(parent):
+            return
+        for name in os.listdir(parent):
+            if not name.startswith(prefix):
+                continue
+            stale = os.path.join(parent, name)
+            step = name[len(prefix):]
+            dst = os.path.join(self.directory, step)
+            if os.path.isdir(dst):
+                shutil.rmtree(stale, ignore_errors=True)
+            else:
+                os.makedirs(self.directory, exist_ok=True)
+                os.rename(stale, dst)
+
     def save(self, state: TrainState, step: Optional[int] = None,
              wait: bool = True, force: bool = False) -> bool:
         """`force=True` bypasses save_interval_steps — use for the final
@@ -45,13 +68,30 @@ class Checkpointer:
         Saving onto an existing step OVERWRITES it: correct both for the
         final forced save landing on a step the interval save just wrote
         (rewrite of identical state) and for re-training past a rollback
-        (the divergent new state must replace the stale checkpoint)."""
+        (the divergent new state must replace the stale checkpoint).
+
+        Overwrites are crash-safe: the existing step directory is moved
+        aside (outside the manager's view) and only deleted once the
+        replacement save is durable, so a preemption mid-overwrite can
+        never destroy the newest retained checkpoint."""
         step = int(state.step) if step is None else step
+        stale = None
         if step in (self.manager.all_steps() or []):
-            self.manager.delete(step)
+            src = os.path.join(self.directory, str(step))
+            stale = self.directory + f".stale.{step}"
+            if os.path.isdir(stale):  # leftover from an interrupted overwrite
+                shutil.rmtree(stale)
+            if os.path.isdir(src):
+                os.rename(src, stale)
+            else:
+                stale = None
+            self.manager.reload()
         saved = self.manager.save(step, args=ocp.args.StandardSave(state), force=force)
-        if wait:
+        if wait or stale is not None:
+            # An overwrite must finish before the moved-aside copy goes away.
             self.manager.wait_until_finished()
+        if stale is not None:
+            shutil.rmtree(stale, ignore_errors=True)
         return saved
 
     def latest_step(self) -> Optional[int]:
