@@ -20,16 +20,19 @@ and actual solver speed on this machine's accelerator.
 Prints ONE JSON line:
   metric      p50 schedule-to-running latency of the packer run (seconds)
   vs_baseline baseline_p50 / packer_p50  (>1 = packer faster)
+  seeds       per-seed p50/vs_baseline for --seeds independent workloads
+              plus min/median aggregates — the headline is the PRIMARY
+              seed, the stability claim quotes the MIN.
   extras      p90/p99, makespan, TPU-chip utilization %, fragmentation score
               (share of free TPU hosts stranded in partially-used slices,
-              time-averaged), solver wall time, and two oracle bounds:
-              oracle_fungible (SJF on fungible chips — physics-free floor)
-              and oracle_granular (SJF honoring ICI contiguity + node
-              granularity at zero scheduling cost — the real floor).
-              achievable_speedup_bound = baseline_p50 / granular floor is
-              the most ANY physical scheduler could claim on this workload.
+              time-averaged), solver wall time, and two zero-cost greedy
+              REFERENCE disciplines (not lower bounds — the packer is
+              expected to beat them): oracle_fungible (SJF on fungible
+              chips, no hosts/contiguity) and oracle_granular (SJF honoring
+              ICI contiguity + node granularity). vs_granular_oracle < 1
+              means the packer out-schedules the greedy granular reference.
 
-Usage: python bench.py [--jobs N] [--seed S] [--quick]
+Usage: python bench.py [--jobs N] [--seed S] [--seeds K] [--quick]
 """
 
 from __future__ import annotations
@@ -60,6 +63,7 @@ from training_operator_tpu.cluster.runtime import (
 )
 from training_operator_tpu.controllers import OperatorManager, register_all
 from training_operator_tpu.scheduler import BaselinePlacer, GangScheduler, TPUPacker
+from training_operator_tpu.scheduler.snapshot import ANNOTATION_EXPECTED_DURATION
 
 
 # One shared pool geometry for the measured runs AND the oracle bounds —
@@ -123,6 +127,7 @@ def make_job(spec):
                                   resources={"cpu": 1.0, TPU_RESOURCE: 4.0})]
         )
         t.annotations[ANNOTATION_SIM_DURATION] = dur
+        t.annotations[ANNOTATION_EXPECTED_DURATION] = dur
         return JAXJob(
             metadata=ObjectMeta(name=name),
             replica_specs={"Worker": ReplicaSpec(replicas=workers, template=t)},
@@ -135,6 +140,7 @@ def make_job(spec):
                                   resources={"cpu": 2.0, GPU_RESOURCE: shape})]
         )
         t.annotations[ANNOTATION_SIM_DURATION] = dur
+        t.annotations[ANNOTATION_EXPECTED_DURATION] = dur
         return PyTorchJob(
             metadata=ObjectMeta(name=name),
             replica_specs={"Worker": ReplicaSpec(replicas=workers, template=t)},
@@ -144,6 +150,7 @@ def make_job(spec):
                               resources={"cpu": shape})]
     )
     t.annotations[ANNOTATION_SIM_DURATION] = dur
+    t.annotations[ANNOTATION_EXPECTED_DURATION] = dur
     return TFJob(
         metadata=ObjectMeta(name=name),
         replica_specs={"Worker": ReplicaSpec(replicas=workers, template=t)},
@@ -156,11 +163,11 @@ def oracle_bound(
     gpus=GPU_NODES * float(GPUS_PER_NODE),
     cpus=CPU_NODES * CPU_PER_NODE,
 ):
-    """Fluid-limit oracle: fungible capacity (no hosts, no contiguity, no
-    scheduler latency), smallest-demand-first admission — the packing an
-    ideal topology-free scheduler could achieve. Makes the measured p50
-    interpretable: the gap oracle->packer is scheduling cost; the oracle
-    itself is the capacity-bound floor for a median-optimizing discipline."""
+    """Fluid-limit greedy reference: fungible capacity (no hosts, no
+    contiguity, no scheduler latency), smallest-demand-first admission —
+    what a topology-free greedy-SJF scheduler would achieve. A comparison
+    point for interpreting the measured p50, not a provable bound (greedy
+    SJF admission is not p50-optimal)."""
     import heapq
 
     pools = {"tpu": tpu_chips, "gpu": gpus, "cpu": cpus}
@@ -205,14 +212,15 @@ def granular_oracle(
     gpu_nodes=GPU_NODES,
     cpus=CPU_NODES * CPU_PER_NODE,
 ):
-    """Granularity-constrained oracle: SJF with ZERO scheduling cost, but
-    honoring the physical constraints any real placer must — ICI contiguity
-    (1x4 = 1 host, 2x4 = adjacent host pair, 4x4 = whole slice, multi-slice =
-    distinct whole slices) and node granularity on the GPU pool. This is the
-    p50 floor for a median-optimizing discipline on real hardware; the gap
-    between it and `oracle_bound` (fungible chips) is the price of physics,
-    not of scheduling. If baseline_p50 / this floor < target speedup, the
-    target is capacity-unreachable at this load — report, don't chase."""
+    """Granularity-constrained greedy REFERENCE: demand-sorted SJF with ZERO
+    scheduling cost, honoring the physical constraints any real placer must —
+    ICI contiguity (1x4 = 1 host, 2x4 = adjacent host pair, 4x4 = whole
+    slice, multi-slice = distinct whole slices) and node granularity on the
+    GPU pool. NOT a lower bound: greedy SJF admission is not p50-optimal
+    (the packer's duration-aware discipline beats it), so this is a
+    comparison point that contextualizes the measured p50, nothing more.
+    The gap between it and `oracle_bound` (fungible chips) is the price of
+    physics at this granularity under the same greedy discipline."""
     import heapq
 
     S, H, N = tpu_slices, hosts_per_slice, gpu_nodes
@@ -351,7 +359,7 @@ def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nod
     DefaultScheduler(cluster)
     SimKubelet(cluster)
     sched = GangScheduler(
-        cluster, placer, charge_solve_time=True, prewarm=True, min_solve_interval=1.0
+        cluster, placer, charge_solve_time=True, prewarm=True, min_solve_interval=0.25
     )
     mgr = OperatorManager(cluster, gang_enabled=True, reconciles_per_tick=4096)
     register_all(mgr)
@@ -468,6 +476,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--jobs", type=int, default=1000)
     ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--seeds", type=int, default=3,
+                    help="run this many consecutive seeds (seed, seed+1, ...); "
+                         "headline = primary seed, seeds block carries min/median")
     ap.add_argument("--quick", action="store_true", help="100-job smoke run")
     ap.add_argument("--all-baselines", action="store_true",
                     help="also run the contiguity-aware first-fit straw-man")
@@ -495,28 +506,46 @@ def main():
             }))
             return
 
-    specs = build_workload(n, args.seed)
+    seed_list = [args.seed + i for i in range(1 if args.quick else max(1, args.seeds))]
+    per_seed = []
+    primary = None
+    for s in seed_list:
+        specs = build_workload(n, s)
+        base = run_burst(specs, BaselinePlacer(whole_slice=True))
+        pack = run_burst(specs, TPUPacker())
+        vs = round(base["p50_s"] / pack["p50_s"], 3) if pack["p50_s"] > 0 else None
+        per_seed.append({
+            "seed": s,
+            "p50_s": pack["p50_s"],
+            "baseline_p50_s": base["p50_s"],
+            "vs_baseline": vs,
+        })
+        if s == args.seed:
+            primary = (specs, base, pack, vs)
+    specs, base, pack, vs_primary = primary
     oracle = oracle_bound(specs)
     goracle = granular_oracle(specs)
-    base = run_burst(specs, BaselinePlacer(whole_slice=True))
-    pack = run_burst(specs, TPUPacker())
+    ratios = sorted(e["vs_baseline"] for e in per_seed if e["vs_baseline"] is not None)
+    p50s = sorted(e["p50_s"] for e in per_seed)
     out = {
         "metric": f"burst{n}_p50_schedule_to_running",
         "value": pack["p50_s"],
         "unit": "s",
-        "vs_baseline": round(base["p50_s"] / pack["p50_s"], 3) if pack["p50_s"] > 0 else None,
-        # Packer p50 over the zero-cost granularity-constrained floor
-        # (1.0 = optimal; <1.0 = beating the greedy floor variant) and the
-        # ceiling any scheduler could claim vs this baseline on physical
-        # hardware (baseline / granular floor). null when the pool is so
-        # unloaded the floor is ~0 (ratios are meaningless there).
+        "vs_baseline": vs_primary,
+        # Packer p50 over the zero-cost greedy granular reference discipline
+        # (<1.0 = the packer out-schedules greedy-SJF-at-zero-cost; see
+        # granular_oracle — a comparison point, not a bound). null when the
+        # pool is so unloaded the reference p50 is ~0.
         "vs_granular_oracle": round(pack["p50_s"] / goracle["p50_s"], 3)
         if goracle["p50_s"] > 0
         else None,
-        "achievable_speedup_bound": round(base["p50_s"] / goracle["p50_s"], 3)
-        if goracle["p50_s"] > 0
-        else None,
         "utilization_gain_pp": round(100 * (pack["tpu_utilization"] - base["tpu_utilization"]), 1),
+        "seeds": {
+            "runs": per_seed,
+            "vs_baseline_min": ratios[0] if ratios else None,
+            "vs_baseline_median": ratios[len(ratios) // 2] if ratios else None,
+            "p50_median_s": p50s[len(p50s) // 2] if p50s else None,
+        },
         "packer": pack,
         "baseline": base,
         "oracle_fungible": oracle,

@@ -389,6 +389,15 @@ class TestCheckpoint:
         np.testing.assert_allclose(
             np.asarray(restored2.params["embed"]), np.asarray(state.params["embed"]), atol=0
         )
+        # A DECLINED overwrite (unforced off-interval save onto an existing
+        # step) must put the moved-aside copy back, not delete it.
+        ckpt3 = Checkpointer(str(tmp_path / "c3"), save_interval_steps=5)
+        ckpt3.save(state, step=10, force=True)
+        ckpt3.save(state, step=12, force=True)
+        assert ckpt3.save(state, step=10, force=False) is False
+        assert sorted(ckpt3.manager.all_steps()) == [10, 12]
+        assert not os.path.isdir(str(tmp_path / "c3") + ".stale.10")
+        ckpt3.close()
 
     def test_elastic_remesh_restore(self, tmp_path):
         """Resize story: train on a 4-way mesh, restore onto a 2-way mesh;

@@ -24,6 +24,13 @@ from training_operator_tpu.cluster.objects import (
 )
 from training_operator_tpu.engine.core import gen_general_name
 
+# User-declared expected runtime (seconds) on the pod template. Purely a
+# scheduling hint: the packer's weighted-SJF discipline orders contested
+# admissions by total work (chips x expected seconds), the way Borg-style
+# schedulers consume user runtime estimates. Absent or wrong estimates
+# cost ordering quality, never correctness — and aging still bounds wait.
+ANNOTATION_EXPECTED_DURATION = "scheduling.tpu.dev/expected-duration-seconds"
+
 
 @dataclass
 class SliceInfo:
@@ -70,6 +77,10 @@ class GangRequest:
     # an untolerated member Pending). The generic path gates per pod via
     # PodRequest.tolerations.
     tolerations: List[Dict[str, object]] = field(default_factory=list)
+    # Declared expected runtime in seconds (ANNOTATION_EXPECTED_DURATION),
+    # None when the job declares nothing. Max across replica templates: the
+    # gang holds its hosts until the slowest member finishes.
+    expected_duration: Optional[float] = None
     _sorted_pods: Optional[List[PodRequest]] = None
     _total_chips: Optional[float] = None
 
@@ -336,6 +347,19 @@ def build_gang_request(api: APIServer, pg: PodGroup) -> Optional[GangRequest]:
         tpu_type = _accel_family(job.tpu_policy.accelerator)
         if topology is None:
             topology = job.tpu_policy.topology
+    expected = None
+    for rtype, spec in job.replica_specs.items():
+        if not (spec.replicas or 0):
+            continue
+        raw = spec.template.annotations.get(ANNOTATION_EXPECTED_DURATION)
+        if raw is None:
+            continue
+        try:
+            val = float(raw)
+        except ValueError:
+            continue  # a malformed hint must not break admission
+        if val > 0:
+            expected = val if expected is None else max(expected, val)
     return GangRequest(
         group=pg,
         pods=pods,
@@ -343,6 +367,7 @@ def build_gang_request(api: APIServer, pg: PodGroup) -> Optional[GangRequest]:
         num_slices=max(1, pg.num_slices),
         tpu_type=tpu_type,
         tolerations=gang_tolerations,
+        expected_duration=expected,
     )
 
 

@@ -91,7 +91,13 @@ class Checkpointer:
             # An overwrite must finish before the moved-aside copy goes away.
             self.manager.wait_until_finished()
         if stale is not None:
-            shutil.rmtree(stale, ignore_errors=True)
+            if saved:
+                shutil.rmtree(stale, ignore_errors=True)
+            else:
+                # Save declined (e.g. off-interval unforced write): the moved-
+                # aside copy is still the only one — put it back.
+                os.rename(stale, os.path.join(self.directory, str(step)))
+                self.manager.reload()
         return saved
 
     def latest_step(self) -> Optional[int]:
