@@ -131,6 +131,15 @@ class TestValidation:
         with pytest.raises(ValidationError, match="container named 'jax'"):
             validate_job(job)
 
+    def test_multi_slice_requires_divisible_workers(self):
+        job = default_job(make_jaxjob(workers=3))
+        job.tpu_policy = TPUPolicy(accelerator="v5e-16", topology="4x4", num_slices=2)
+        with pytest.raises(ValidationError, match="divisible"):
+            validate_job(job)
+        job4 = default_job(make_jaxjob(workers=4))
+        job4.tpu_policy = TPUPolicy(accelerator="v5e-16", topology="4x4", num_slices=2)
+        validate_job(job4)
+
     def test_mpi_requires_single_launcher(self):
         job = MPIJob(
             metadata=ObjectMeta(name="m"),

@@ -294,3 +294,123 @@ class TestLiveMetricsAndTPUResize:
             )
             losses.append(float(metrics["loss"]))
         assert losses[-1] < losses[0]  # loss kept improving across the resize
+
+
+class TestVersionedElasticWrites:
+    def test_hpa_write_racing_status_write_loses_neither(self):
+        """An HPA scale write racing a reconciler status write: with
+        version-checked updates the conflict is detected, the HPA re-reads
+        and re-applies, and BOTH the status change and the resize survive."""
+        cluster, mgr, metrics = make_env(gang=False)
+        mgr.submit(elastic_job())
+        assert cluster.run_until(lambda: len(worker_pods(cluster, "el")) == 2, timeout=60)
+
+        from training_operator_tpu.scheduler.elastic import HorizontalAutoscaler
+
+        hpa_loop = HorizontalAutoscaler(
+            cluster, metrics, sync_period=1e9  # driven manually below
+        )
+        hpa = next(iter(cluster.api.list("HorizontalPodAutoscaler")))
+
+        class RacingSource:
+            """Between the HPA's job read and its write, a 'reconciler'
+            lands a status update — exactly the interleaving last-write-wins
+            used to destroy."""
+
+            def __init__(self, api):
+                self.api = api
+                self.fired = False
+
+            def get(self, namespace, target, metric):
+                if not self.fired:
+                    self.fired = True
+                    j = self.api.get("PyTorchJob", namespace, target)
+                    j.status.last_reconcile_time = 12345.0
+                    self.api.update(j, check_version=True)
+                return 140.0  # desired = ceil(2 * 140/70) = 4
+
+        hpa_loop.metrics = RacingSource(cluster.api)
+        hpa_loop._sync_one(hpa, now=cluster.clock.now())
+
+        j = cluster.api.get("PyTorchJob", "default", "el")
+        assert j.replica_specs["Worker"].replicas == 4  # resize landed
+        assert j.status.last_reconcile_time == 12345.0  # status NOT lost
+
+    def test_v2_trainjob_resize_derives_num_slices(self):
+        """ADVICE r2: scaling a TrainJob's num_nodes must propagate a
+        CONSISTENT workload — replicas and tpu_policy.num_slices move
+        together (whole-slice contract), so the v2 controller's full-spec
+        propagation converges instead of reverting the resize."""
+        from training_operator_tpu.api.jobs import TPUPolicy
+        from training_operator_tpu.cluster.inventory import TPU_RESOURCE, make_tpu_pool
+        from training_operator_tpu.runtime.api import (
+            ClusterTrainingRuntime,
+            MLPolicy,
+            ReplicatedJobTemplate,
+            RuntimeRef,
+            TRAINER_NODE,
+            Trainer,
+            TrainingRuntimeSpec,
+            TrainJob,
+        )
+        from training_operator_tpu.runtime.controller import TrainJobManager
+
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_tpu_pool(4, slice_topology="2x4"))
+        DefaultScheduler(cluster)
+        SimKubelet(cluster)
+        GangScheduler(cluster, TPUPacker())
+        mgr = OperatorManager(cluster, gang_enabled=True)
+        register_all(mgr)
+        v2 = TrainJobManager(cluster)
+
+        rt = ClusterTrainingRuntime(
+            metadata=ObjectMeta(name="tpu-rt", namespace=""),
+            spec=TrainingRuntimeSpec(
+                ml_policy=MLPolicy(
+                    num_nodes=2,
+                    tpu=TPUPolicy(accelerator="v5e-8", topology="2x4", num_slices=1),
+                ),
+                template=[
+                    ReplicatedJobTemplate(
+                        name=TRAINER_NODE, replicas=2,
+                        template=PodTemplateSpec(
+                            containers=[Container(name="trainer", image="trainer",
+                                                  resources={TPU_RESOURCE: 4.0})]
+                        ),
+                    )
+                ],
+            ),
+        )
+        v2.submit(rt)
+        tj = TrainJob(
+            metadata=ObjectMeta(name="tj-elastic"),
+            runtime_ref=RuntimeRef(name="tpu-rt"),
+        )
+        v2.submit(tj)
+        assert cluster.run_until(
+            lambda: len(worker_pods(cluster, "tj-elastic")) == 2, timeout=60
+        )
+        wl = cluster.api.get("JAXJob", "default", "tj-elastic")
+        assert wl.tpu_policy.num_slices == 1
+
+        # Elastic resize at the v2 surface: num_nodes 2 -> 4 (one more slice).
+        live = cluster.api.get("TrainJob", "default", "tj-elastic")
+        live.trainer = Trainer(num_nodes=4)
+        cluster.api.update(live)
+
+        def resized():
+            w = cluster.api.try_get("JAXJob", "default", "tj-elastic")
+            return (
+                w is not None
+                and w.replica_specs["Worker"].replicas == 4
+                and w.tpu_policy.num_slices == 2
+                and len(worker_pods(cluster, "tj-elastic")) == 4
+            )
+
+        assert cluster.run_until(resized, timeout=200)
+        # And it CONVERGES: more reconciles don't flap it back.
+        cluster.run_for(30)
+        w = cluster.api.get("JAXJob", "default", "tj-elastic")
+        assert w.replica_specs["Worker"].replicas == 4
+        assert w.tpu_policy.num_slices == 2

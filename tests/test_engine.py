@@ -106,6 +106,52 @@ class TestJobLifecycle:
         assert pods[0].metadata.labels.get(capi.JOB_ROLE_LABEL) == "master"
         assert capi.JOB_ROLE_LABEL not in pods[1].metadata.labels
 
+    def test_multi_slice_env_contract(self):
+        """num_slices>1: complete per-slice bootstrap env (slice identity,
+        per-slice coordinator, inter-slice DCN/megascale coordinator),
+        internally consistent with the contiguous worker->slice mapping."""
+        from training_operator_tpu.api.jobs import TPUPolicy
+
+        cluster, mgr = make_env(workers=4, nodes=8)
+        job = make_job(workers=4)
+        job.tpu_policy = TPUPolicy(accelerator="v5e-16", topology="4x4", num_slices=2)
+        mgr.submit(job)
+        assert cluster.run_until(
+            lambda: len(cluster.api.list("Pod", "default")) == 4, timeout=30
+        )
+        pods = sorted(cluster.api.list("Pod", "default"), key=lambda p: p.name)
+        for i, pod in enumerate(pods):
+            env = pod.spec.containers[0].env
+            slice_id = i // 2
+            assert env["TPU_NUM_SLICES"] == "2"
+            assert env["TPU_SLICE_ID"] == str(slice_id)
+            assert env["TPU_WORKER_ID_IN_SLICE"] == str(i % 2)
+            assert env["TPU_WORKERS_PER_SLICE"] == "2"
+            assert env["TPU_SLICE_COORDINATOR_ADDRESS"] == (
+                f"jax-mnist-worker-{slice_id * 2}"
+            )
+            assert env["TPU_SLICE_COORDINATOR_PORT"] == "6666"
+            # Inter-slice coordinator: worker-0, beside jax.distributed's.
+            assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "jax-mnist-worker-0"
+            assert env["MEGASCALE_PORT"] == "6667"
+            assert env["MEGASCALE_NUM_SLICES"] == "2"
+            assert env["MEGASCALE_SLICE_ID"] == str(slice_id)
+            # The global jax.distributed contract is unchanged.
+            assert env["COORDINATOR_ADDRESS"] == "jax-mnist-worker-0"
+            assert env["NUM_PROCESSES"] == "4"
+            # The DCN port is exposed on the service.
+            assert pod.spec.containers[0].ports["jaxjob-dcn-port"] == 6667
+        # Single-slice jobs carry none of the multi-slice surface.
+        job1 = make_job(name="jax-single", workers=2)
+        job1.tpu_policy = TPUPolicy(accelerator="v5e-16", topology="4x4")
+        mgr.submit(job1)
+        assert cluster.run_until(
+            lambda: len(cluster.api.list("Pod", "default")) == 6, timeout=30
+        )
+        p0 = cluster.api.get("Pod", "default", "jax-single-worker-0")
+        assert "TPU_SLICE_ID" not in p0.spec.containers[0].env
+        assert "MEGASCALE_COORDINATOR_ADDRESS" not in p0.spec.containers[0].env
+
     def test_headless_service_per_replica(self):
         cluster, mgr = make_env()
         mgr.submit(make_job())

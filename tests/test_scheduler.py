@@ -196,6 +196,32 @@ class TestGangEndToEnd:
         slices = {p.node_name.rsplit("-host-", 1)[0] for p in pods}
         assert len(slices) == 2  # distinct whole slices
 
+    def test_multi_slice_env_matches_placement(self):
+        """The per-slice bootstrap env (TPU_SLICE_ID) must agree with the
+        physical placement: all workers sharing a TPU_SLICE_ID land on one
+        slice, distinct TPU_SLICE_IDs land on distinct slices — the
+        contiguous index->slice convention shared by controllers/jax.py and
+        the packer's stitching."""
+        cluster, mgr = make_gang_env(TPUPacker(), slices=3)
+        job = make_jax_job("msenv", workers=8, topology="4x4", num_slices=2, duration=30)
+        mgr.submit(job)
+        assert cluster.run_until(
+            lambda: sum(
+                1 for p in cluster.api.list("Pod", "default", {capi.JOB_NAME_LABEL: "msenv"})
+                if p.node_name
+            ) == 8,
+            timeout=120,
+        )
+        by_env_slice = {}
+        for p in cluster.api.list("Pod", "default", {capi.JOB_NAME_LABEL: "msenv"}):
+            env = p.spec.containers[0].env
+            phys = p.node_name.rsplit("-host-", 1)[0]
+            by_env_slice.setdefault(env["TPU_SLICE_ID"], set()).add(phys)
+            assert env["MEGASCALE_SLICE_ID"] == env["TPU_SLICE_ID"]
+        assert set(by_env_slice) == {"0", "1"}
+        assert all(len(v) == 1 for v in by_env_slice.values()), by_env_slice
+        assert by_env_slice["0"] != by_env_slice["1"]
+
     def test_gang_all_or_nothing(self):
         """A gang that cannot fit stays Pending with zero pods created."""
         cluster, mgr = make_gang_env(TPUPacker(), slices=1)

@@ -108,6 +108,11 @@ def _ensure_default_container(job: Job, rtype: str) -> None:
     if not spec.template.containers:
         spec.template.containers.append(Container(name=cname))
     port = DEFAULT_PORT.get(job.kind, 0)
+    if isinstance(job, JAXJob):
+        # The per-job coordinator_port knob IS the default port for JAXJobs;
+        # injecting the static class default here would shadow it (the
+        # controller's _port prefers the declared container port).
+        port = job.coordinator_port
     pname = DEFAULT_PORT_NAME.get(job.kind)
     if port and pname:
         c = spec.template.main_container(cname)

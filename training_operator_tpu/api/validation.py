@@ -132,6 +132,16 @@ def _validate_tpu_policy(job: Job, errs: List[str]) -> None:
         return
     if tp.num_slices < 1:
         errs.append("tpuPolicy.numSlices: must be >= 1")
+    elif tp.num_slices > 1:
+        # Multi-slice gangs split workers contiguously across slices (the
+        # packer's placement convention and the per-slice bootstrap env both
+        # assume it); an indivisible worker count can never be placed.
+        total = sum(spec.replicas or 0 for spec in job.replica_specs.values())
+        if total and total % tp.num_slices:
+            errs.append(
+                f"tpuPolicy.numSlices: total replicas {total} must be divisible "
+                f"by numSlices {tp.num_slices}"
+            )
     if tp.topology is not None:
         if not re.match(r"^[1-9]\d*(x[1-9]\d*)*$", tp.topology.lower()):
             errs.append(

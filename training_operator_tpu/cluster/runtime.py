@@ -390,6 +390,18 @@ class SimKubelet:
 
         return start
 
+    def complete_pod(self, namespace: str, name: str, exit_code: int = 0) -> bool:
+        """External completion: a real workload process attached to this pod
+        exited — propagate its exit code exactly as an annotated sim finish
+        would (restart policy honored). This is the seam the real-process
+        e2e tier uses: OS processes run the container's work, and their exit
+        codes flow back through the kubelet into pod/job status."""
+        pod = self.cluster.api.try_get("Pod", namespace, name)
+        if pod is None or pod.status.phase != PodPhase.RUNNING:
+            return False
+        self._make_finisher(pod.metadata.uid, namespace, name, exit_code)()
+        return True
+
     def _schedule_finish(self, pod: Pod, uid: str) -> None:
         """Arm the completion timer from the pod's sim annotations (if any)."""
         dur = pod.spec.annotations.get(ANNOTATION_SIM_DURATION)

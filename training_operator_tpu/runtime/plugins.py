@@ -274,9 +274,29 @@ class WorkloadBuilderPlugin:
                 run_launcher_as_node=info.ml_policy.mpi.run_launcher_as_node,
             )
         tpu = info.ml_policy.tpu
+        tpu_policy = copy.deepcopy(tpu) if tpu else None
+        if tpu_policy is not None:
+            # Derive num_slices from the ACTUAL node count (whole-slice
+            # elastic contract: workers-per-slice is fixed by the runtime's
+            # base shape, scaling moves in whole slices). Without this, an
+            # elastic resize of trainer.num_nodes would propagate the new
+            # replica count but the runtime's STATIC num_slices — reverting
+            # the resize on the live workload and leaving pg.num_slices and
+            # job.tpu_policy disagreeing (the trainer's mesh env would be
+            # inconsistent with the placement).
+            base_nodes = info.ml_policy.num_nodes or n or 1
+            per_slice = max(1, base_nodes // max(1, tpu_policy.num_slices))
+            if n:
+                # Non-divisible requests clamp DOWN to a whole number of
+                # slices (never below one): propagating replicas=3 with
+                # num_slices=1 would dead-end at the gang layer's whole-
+                # slice check while the HPA believes the scale succeeded.
+                n_eff = max(per_slice, (n // per_slice) * per_slice)
+                spec.replicas = n_eff
+                tpu_policy.num_slices = max(1, n_eff // per_slice)
         return JAXJob(
             replica_specs={REPLICA_WORKER: spec},
-            tpu_policy=copy.deepcopy(tpu) if tpu else None,
+            tpu_policy=tpu_policy,
         )
 
     # -- terminal condition ------------------------------------------------

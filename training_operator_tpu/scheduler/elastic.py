@@ -24,6 +24,7 @@ import math
 from typing import Callable, Dict, List, Optional, Protocol, Tuple
 
 from training_operator_tpu.api.jobs import REPLICA_WORKER
+from training_operator_tpu.cluster.apiserver import ConflictError, NotFoundError
 from training_operator_tpu.cluster.objects import PodGroupPhase
 from training_operator_tpu.cluster.runtime import Cluster
 from training_operator_tpu.scheduler.snapshot import (
@@ -150,14 +151,36 @@ class HorizontalAutoscaler:
         for hpa in self.api.list("HorizontalPodAutoscaler"):
             self._sync_one(hpa, now)
 
+    @staticmethod
+    def _current_replicas(job) -> Optional[int]:
+        """Worker count of a v1 job, or num_nodes of a v2 TrainJob (the HPA
+        can target either: scaling a TrainJob lets the v2 controller's spec
+        propagation carry the resize to the workload coherently — replicas
+        AND derived num_slices together)."""
+        specs = getattr(job, "replica_specs", None)
+        if specs is not None:
+            spec = specs.get(REPLICA_WORKER)
+            return (spec.replicas or 0) if spec is not None else None
+        trainer = getattr(job, "trainer", None)
+        if trainer is not None and trainer.num_nodes is not None:
+            return trainer.num_nodes
+        return None
+
+    @staticmethod
+    def _apply_replicas(job, desired: int) -> None:
+        if getattr(job, "replica_specs", None) is not None:
+            job.replica_specs[REPLICA_WORKER].replicas = desired
+        else:
+            job.trainer.num_nodes = desired
+
     def _sync_one(self, hpa, now: float) -> None:
         job = self.api.try_get(hpa.target_kind, hpa.namespace, hpa.target_name)
         if job is None:
             return
-        spec = job.replica_specs.get(REPLICA_WORKER)
-        if spec is None:
+        current = self._current_replicas(job)
+        if current is None:
             return
-        current = spec.replicas or 0
+        observed = (hpa.current_replicas, hpa.desired_replicas)
         proposals = []
         for m in hpa.metrics:
             name = m.get("name", "")
@@ -174,14 +197,39 @@ class HorizontalAutoscaler:
         hpa.current_replicas = current
         hpa.desired_replicas = desired
         if desired == current:
+            # Steady state: persist the observed sizes only when they
+            # actually changed — an unconditional write per sync would spam
+            # version bumps and watch events cluster-wide.
+            if (current, desired) != observed:
+                self._update_versioned(hpa)
             return
         key = f"{hpa.namespace}/{hpa.name}"
         if desired < current and now - self._last_scale.get(key, -1e9) < self.stabilization_seconds:
             return  # downscale stabilization window
-        spec.replicas = desired
+        # Version-checked scale write: an HPA resize racing a reconciler's
+        # status write (or a user spec edit) must not silently last-write-
+        # win. On conflict, re-read and re-apply against fresh state.
+        for _ in range(3):
+            self._apply_replicas(job, desired)
+            try:
+                self.api.update(job, check_version=True)
+                break
+            except NotFoundError:
+                return  # target deleted mid-sync
+            except ConflictError:
+                job = self.api.try_get(hpa.target_kind, hpa.namespace, hpa.target_name)
+                if job is None or self._current_replicas(job) is None:
+                    return
+        else:
+            return  # persistent conflicts: next sync retries
         self._last_scale[key] = now
-        self.api.update(job, check_version=False)
-        self.api.update(hpa, check_version=False)
+        self._update_versioned(hpa)
+
+    def _update_versioned(self, hpa) -> None:
+        try:
+            self.api.update(hpa, check_version=True)
+        except (ConflictError, NotFoundError):
+            pass  # stale read or deleted; next sync re-reads
 
 
 def repack_grown_gangs(
@@ -244,7 +292,16 @@ def repack_grown_gangs(
             else:
                 unsatisfied += 1
         pg.min_member = len(pg.placement)
-        api.update(pg, check_version=False)
+        try:
+            # Version-checked: `pg` was listed this pass; a conflict means a
+            # concurrent writer (admission, engine) won — the size check
+            # re-detects the gang next cycle against fresh state.
+            api.update(pg, check_version=True)
+        except NotFoundError:
+            continue  # group deleted mid-pass
+        except ConflictError:
+            unsatisfied += 1
+            continue
         updated += 1
     return updated, unsatisfied
 
@@ -287,7 +344,10 @@ def _resize_tpu_gang(
     if per_slice <= 0 or new_total % per_slice:
         if pg.metadata.annotations.get(_REJECTED_SIZE_ANNOTATION) != str(new_total):
             pg.metadata.annotations[_REJECTED_SIZE_ANNOTATION] = str(new_total)
-            api.update(pg, check_version=False)
+            try:
+                api.update(pg, check_version=True)
+            except (ConflictError, NotFoundError):
+                return 0, 0  # re-detected next cycle; event dedup re-checks
             api.record_event(Event(
                 object_kind="PodGroup", object_name=pg.name, namespace=pg.namespace,
                 event_type="Warning", reason="InvalidResize",
@@ -320,16 +380,24 @@ def _resize_tpu_gang(
     if placement is None:
         return 0, 1  # keep running at the old size; retry when capacity frees
 
-    if job.tpu_policy is not None and job.tpu_policy.num_slices != new_slices:
-        job.tpu_policy.num_slices = new_slices
-        api.update(job, check_version=False)
+    # Commit order: job spec, then group, then pod teardown — all version-
+    # checked, and pods are only deleted once both writes landed (a conflict
+    # must never take a running gang down without its replacement admitted).
+    try:
+        if job.tpu_policy is not None and job.tpu_policy.num_slices != new_slices:
+            job.tpu_policy.num_slices = new_slices
+            api.update(job, check_version=True)
+        pg.metadata.annotations.pop(_REJECTED_SIZE_ANNOTATION, None)
+        pg.placement = dict(placement.assignments)
+        pg.reserved_nodes = list(placement.reserved_nodes)
+        pg.num_slices = new_slices
+        pg.min_member = new_total
+        pg.phase = PodGroupPhase.INQUEUE  # pre-admitted with the trial placement
+        api.update(pg, check_version=True)
+    except NotFoundError:
+        return 0, 0  # job or group deleted mid-resize; nothing to do
+    except ConflictError:
+        return 0, 1  # concurrent writer won; retry against fresh state
     for pod in own_pods:
         api.try_delete("Pod", pod.namespace, pod.name)
-    pg.metadata.annotations.pop(_REJECTED_SIZE_ANNOTATION, None)
-    pg.placement = dict(placement.assignments)
-    pg.reserved_nodes = list(placement.reserved_nodes)
-    pg.num_slices = new_slices
-    pg.min_member = new_total
-    pg.phase = PodGroupPhase.INQUEUE  # pre-admitted with the trial placement
-    api.update(pg, check_version=False)
     return 1, 0

@@ -283,14 +283,22 @@ def forward_with_aux(
     act_spec = P(BATCH_AXES, "sequence", None)
     b, s = tokens.shape
 
-    x = params["embed"].astype(c.dtype)[tokens]
+    # Embedding lookup: gather from an explicitly replicated table. The
+    # stored table is (fsdp x tensor)-sharded on d; a gather whose output
+    # must be resharded from table layout to activation layout makes the
+    # SPMD partitioner fall back to "involuntary full rematerialization"
+    # (replicate + repartition) with a warning. Doing the all-gather
+    # ourselves is the same data movement, scheduled on purpose — the
+    # activations it feeds dwarf one [V, D] table per step.
+    embed = _constrain(params["embed"], mesh, P(None, None)).astype(c.dtype)
+    x = embed[tokens]
+    x = _constrain(x, mesh, act_spec)
 
     if mesh is not None and axis_size(mesh, "pipeline") > 1:
         from training_operator_tpu.trainer.pipeline import pipeline_apply
 
         x, aux = pipeline_apply(params["layers"], x, config, mesh)
     else:
-        x = _constrain(x, mesh, act_spec)
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
 
         def layer(x, lp):
