@@ -197,12 +197,18 @@ def test_bootstrap_env_drives_real_jax_distributed(tmp_path):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert f"worker {i}: ok" in out
 
-    # Exit codes propagate through the kubelet into pod -> job status.
-    for pod, p in zip(pods, procs):
-        assert kubelet.complete_pod(pod.namespace, pod.name, p.returncode)
+    # Exit codes propagate through the kubelet into pod -> job status, and
+    # each process's REAL stdout becomes its pod's log.
+    for pod, p, out in zip(pods, procs, outputs):
+        assert kubelet.complete_pod(pod.namespace, pod.name, p.returncode, log=out)
     assert cluster.run_until(
         lambda: capi.is_succeeded(
             cluster.api.get("JAXJob", "default", "jax-e2e").status
         ),
         timeout=30,
     )
+    from training_operator_tpu.sdk import TrainingClient
+
+    logs = TrainingClient(cluster).get_job_logs("jax-e2e")
+    assert "worker 0: ok" in logs["jax-e2e-worker-0"]
+    assert "worker 1: ok" in logs["jax-e2e-worker-1"]

@@ -82,8 +82,18 @@ class TestTrainingClient:
             client.wait_for_job_conditions("slow", timeout=5)
 
     def test_pod_names_and_logs(self):
-        _, client = make_env()
-        client.create_job(jax_job("p1", replicas=2))
+        import json
+
+        from training_operator_tpu.cluster.runtime import ANNOTATION_SIM_LOG_LINES
+
+        cluster, client = make_env()
+        job = jax_job("p1", replicas=2)
+        # Each "container" prints its own identity — logs must differ per pod.
+        for spec in job.replica_specs.values():
+            spec.template.annotations[ANNOTATION_SIM_LOG_LINES] = json.dumps(
+                ["step 1 loss 5.0", "step 2 loss 4.2"]
+            )
+        client.create_job(job)
         client.wait_for_job_conditions(
             "p1", expected_conditions=[JobConditionType.RUNNING], timeout=60
         )
@@ -92,7 +102,51 @@ class TestTrainingClient:
         masters = client.get_job_pod_names("p1", is_master=True)
         assert masters == ["p1-worker-0"]  # worker-0 = coordinator
         logs = client.get_job_logs("p1")
-        assert "SuccessfulCreatePod" in logs["p1-worker-0"]
+        assert set(logs) == {"p1-worker-0", "p1-worker-1"}
+        # Per-pod content: each pod's log names ITS container start, not a
+        # shared job-event dump.
+        assert "Started container jax" in logs["p1-worker-0"]
+        assert "step 2 loss 4.2" in logs["p1-worker-1"]
+        # Buffers are genuinely per-pod: a line written to worker-0 must
+        # never surface in worker-1's log.
+        cluster.api.append_pod_log("default", "p1-worker-0", "unique-to-w0", 0.0)
+        logs = client.get_job_logs("p1")
+        assert "unique-to-w0" in logs["p1-worker-0"]
+        assert "unique-to-w0" not in logs["p1-worker-1"]
+        # tail limits per pod.
+        tailed = client.get_job_logs("p1", tail=1)
+        assert all(len(v.splitlines()) == 1 for v in tailed.values())
+
+    def test_follow_job_logs_streams_a_running_job(self):
+        """Tail a RUNNING job: lines emitted after the follow starts are
+        streamed, and the generator ends when the job finishes."""
+        cluster, client = make_env()
+        client.create_job(jax_job("stream", replicas=2, duration="5"))
+        client.wait_for_job_conditions(
+            "stream", expected_conditions=[JobConditionType.RUNNING], timeout=60
+        )
+        seen = []
+        late_line_at = {"armed": False}
+
+        def tick():
+            # Inject a mid-flight stdout line once the follow loop is live.
+            if not late_line_at["armed"] and cluster.clock.now() > 1.0:
+                late_line_at["armed"] = True
+                cluster.api.append_pod_log(
+                    "default", "stream-worker-1", "late line from worker 1",
+                    cluster.clock.now(),
+                )
+
+        cluster.add_ticker(tick)
+        for pod_name, line in client.follow_job_logs("stream", timeout=120):
+            seen.append((pod_name, line))
+        assert any(
+            p == "stream-worker-1" and "late line from worker 1" in ln
+            for p, ln in seen
+        )
+        # Terminal lifecycle line observed through the stream too.
+        assert any("exited with code 0" in ln for _, ln in seen)
+        assert client.is_job_succeeded("stream")
 
     def test_list_update_delete(self):
         cluster, client = make_env()

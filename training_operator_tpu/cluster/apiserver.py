@@ -134,6 +134,13 @@ class APIServer:
         self._lock = threading.RLock()
         # Admission hooks: kind -> [callable(obj) raising on rejection]
         self._admission: Dict[str, List[Callable[[Any], None]]] = {}
+        # Per-pod log buffers (the k8s pod-log subresource analogue): the
+        # kubelet appends lifecycle + container stdout lines; readers tail
+        # by cursor so `follow` streaming is O(new lines). Bounded per pod;
+        # `base` keeps cursors stable across trimming. Logs die with the
+        # pod object, like kubelet-held logs do.
+        self._pod_logs: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._pod_log_max = 4096
 
     @staticmethod
     def _clone(obj: Any) -> Any:
@@ -154,7 +161,19 @@ class APIServer:
     def register_admission(self, kind: str, fn: Callable[[Any], None]) -> None:
         self._admission.setdefault(kind, []).append(fn)
 
+    def unregister_admission(self, kind: str, fn: Callable[[Any], None]) -> None:
+        hooks = self._admission.get(kind)
+        if hooks is not None and fn in hooks:
+            hooks.remove(fn)
+
     # -- watch -------------------------------------------------------------
+
+    def unwatch(self, queue: WatchQueue) -> None:
+        """Detach a watcher (component shutdown) — without this a dead
+        component's queue keeps accumulating cloned events forever."""
+        with self._lock:
+            if queue in self._watchers:
+                self._watchers.remove(queue)
 
     def watch(self, kinds: Optional[Iterable[str]] = None) -> WatchQueue:
         wq = WatchQueue(kinds)
@@ -248,6 +267,8 @@ class APIServer:
                 raise NotFoundError(f"{key} not found")
             self._by_kind.get(kind, {}).pop(key[1:], None)
             self._unindex_labels(key, obj)
+            if kind == "Pod":
+                self._pod_logs.pop(key[1:], None)
             self._notify("Deleted", obj)  # orphaned: safe to hand out as-is
             return obj
 
@@ -289,6 +310,43 @@ class APIServer:
                 for (ns, _), obj in by_kind.items()
                 if namespace is None or ns == namespace
             ]
+
+    # -- pod logs ----------------------------------------------------------
+
+    def append_pod_log(self, namespace: str, name: str, line: str, ts: float = 0.0) -> None:
+        """Kubelet-side write of one log line (lifecycle event or a line of
+        container stdout) for pod namespace/name."""
+        with self._lock:
+            buf = self._pod_logs.setdefault(
+                (namespace or "", name), {"lines": [], "base": 0}
+            )
+            for ln in str(line).splitlines() or [""]:
+                buf["lines"].append((ts, ln))
+            overflow = len(buf["lines"]) - self._pod_log_max
+            if overflow > 0:
+                del buf["lines"][:overflow]
+                buf["base"] += overflow
+
+    def read_pod_log(
+        self,
+        namespace: str,
+        name: str,
+        since: int = 0,
+        tail: Optional[int] = None,
+    ) -> Tuple[List[str], int]:
+        """(formatted lines, next cursor). `since` is a cursor from a prior
+        call (0 = start of retained log); pass it back to tail a running
+        pod. `tail` limits to the last N retained lines."""
+        with self._lock:
+            buf = self._pod_logs.get((namespace or "", name))
+            if buf is None:
+                return [], since
+            base, lines = buf["base"], buf["lines"]
+            idx = max(0, since - base)
+            out = lines[idx:]
+            if tail is not None and len(out) > tail:
+                out = out[-tail:]
+            return [f"{ts:.3f} {ln}" for ts, ln in out], base + len(lines)
 
     # -- events ------------------------------------------------------------
 

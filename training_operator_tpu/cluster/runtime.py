@@ -27,6 +27,10 @@ from training_operator_tpu.cluster.objects import (
 
 ANNOTATION_SIM_DURATION = "sim.tpu.dev/run-seconds"
 ANNOTATION_SIM_EXIT_CODE = "sim.tpu.dev/exit-code"
+# JSON array of stdout lines the simulated container "prints" on start —
+# the per-pod log model's stand-in for trainer output (real workloads
+# attach theirs via SimKubelet.complete_pod(log=...)).
+ANNOTATION_SIM_LOG_LINES = "sim.tpu.dev/log-lines"
 
 
 class Clock:
@@ -95,6 +99,13 @@ class Cluster:
 
     def add_ticker(self, fn: Callable[[], None]) -> None:
         self._tickers.append(fn)
+
+    def remove_ticker(self, fn: Callable[[], None]) -> None:
+        """Detach a component (operator shutdown / restart simulation)."""
+        try:
+            self._tickers.remove(fn)
+        except ValueError:
+            pass
 
     def schedule_at(self, t: float, fn: Callable[[], None]) -> None:
         heapq.heappush(self._timers, (t, next(self._timer_seq), fn))
@@ -385,20 +396,43 @@ class SimKubelet:
                 ContainerStatus(name=c.name, running=True) for c in pod.spec.containers
             ]
             self.cluster.api.update(pod, check_version=False)
+            now = self.cluster.clock.now()
+            for c in pod.spec.containers:
+                self.cluster.api.append_pod_log(
+                    namespace, name,
+                    f"Started container {c.name} on {pod.node_name}", now,
+                )
+            raw = pod.spec.annotations.get(ANNOTATION_SIM_LOG_LINES)
+            if raw is not None:
+                import json
+
+                try:
+                    for ln in json.loads(raw):
+                        self.cluster.api.append_pod_log(namespace, name, str(ln), now)
+                except (ValueError, TypeError):
+                    pass  # a malformed sim annotation must not kill the kubelet
             self._starting.discard(uid)
             self._schedule_finish(pod, uid)
 
         return start
 
-    def complete_pod(self, namespace: str, name: str, exit_code: int = 0) -> bool:
+    def complete_pod(
+        self, namespace: str, name: str, exit_code: int = 0,
+        log: Optional[str] = None,
+    ) -> bool:
         """External completion: a real workload process attached to this pod
         exited — propagate its exit code exactly as an annotated sim finish
         would (restart policy honored). This is the seam the real-process
-        e2e tier uses: OS processes run the container's work, and their exit
-        codes flow back through the kubelet into pod/job status."""
+        e2e tier uses: OS processes run the container's work, their captured
+        stdout lands in the pod's log (`log`), and their exit codes flow
+        back through the kubelet into pod/job status."""
         pod = self.cluster.api.try_get("Pod", namespace, name)
         if pod is None or pod.status.phase != PodPhase.RUNNING:
             return False
+        if log:
+            self.cluster.api.append_pod_log(
+                namespace, name, log, self.cluster.clock.now()
+            )
         self._make_finisher(pod.metadata.uid, namespace, name, exit_code)()
         return True
 
@@ -427,6 +461,12 @@ class SimKubelet:
             policy = pod.effective_restart_policy()
             should_restart = policy == RestartPolicy.ALWAYS or (
                 policy == RestartPolicy.ON_FAILURE and exit_code != 0
+            )
+            self.cluster.api.append_pod_log(
+                namespace, name,
+                f"Container exited with code {exit_code}"
+                + ("; restarting" if should_restart else ""),
+                self.cluster.clock.now(),
             )
             if should_restart:
                 for cs in pod.status.container_statuses:

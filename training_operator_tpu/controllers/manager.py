@@ -53,6 +53,22 @@ class OperatorManager:
 
     # ------------------------------------------------------------------
 
+    def stop(self) -> None:
+        """Detach this manager from the cluster — the process-death half of
+        the restart story (reference: losing leader election / SIGTERM). A
+        replacement manager built on the same APIServer re-lists state,
+        rebuilds expectations from scratch, and adopts existing pods via
+        the claim path; convergence is asserted by the restart test.
+
+        Everything this manager registered is torn down: its ticker, its
+        watch queue (or every later event accumulates in a dead deque), and
+        its admission hooks (or each dead generation re-validates every
+        submit)."""
+        self.cluster.remove_ticker(self.tick)
+        self.api.unwatch(self._watch)
+        for kind in self.controllers:
+            self.api.unregister_admission(kind, validate_job)
+
     def register(self, controller) -> None:
         kind = controller.kind
         jc = JobController(

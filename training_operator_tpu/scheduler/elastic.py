@@ -151,12 +151,13 @@ class HorizontalAutoscaler:
         for hpa in self.api.list("HorizontalPodAutoscaler"):
             self._sync_one(hpa, now)
 
-    @staticmethod
-    def _current_replicas(job) -> Optional[int]:
+    def _current_replicas(self, namespace: str, job) -> Optional[int]:
         """Worker count of a v1 job, or num_nodes of a v2 TrainJob (the HPA
         can target either: scaling a TrainJob lets the v2 controller's spec
         propagation carry the resize to the workload coherently — replicas
-        AND derived num_slices together)."""
+        AND derived num_slices together). A TrainJob with no trainer
+        override (num_nodes comes from the runtime) reads the LIVE workload
+        it owns — the observed size the HPA formula needs."""
         specs = getattr(job, "replica_specs", None)
         if specs is not None:
             spec = specs.get(REPLICA_WORKER)
@@ -164,20 +165,31 @@ class HorizontalAutoscaler:
         trainer = getattr(job, "trainer", None)
         if trainer is not None and trainer.num_nodes is not None:
             return trainer.num_nodes
+        if hasattr(job, "runtime_ref"):
+            for kind in ("JAXJob", "PyTorchJob", "TFJob", "MPIJob"):
+                wl = self.api.try_get(kind, namespace, job.name)
+                if wl is not None:
+                    spec = wl.replica_specs.get(REPLICA_WORKER)
+                    if spec is not None:
+                        return spec.replicas or 0
         return None
 
     @staticmethod
     def _apply_replicas(job, desired: int) -> None:
         if getattr(job, "replica_specs", None) is not None:
             job.replica_specs[REPLICA_WORKER].replicas = desired
-        else:
-            job.trainer.num_nodes = desired
+            return
+        if job.trainer is None:
+            from training_operator_tpu.runtime.api import Trainer
+
+            job.trainer = Trainer()
+        job.trainer.num_nodes = desired
 
     def _sync_one(self, hpa, now: float) -> None:
         job = self.api.try_get(hpa.target_kind, hpa.namespace, hpa.target_name)
         if job is None:
             return
-        current = self._current_replicas(job)
+        current = self._current_replicas(hpa.namespace, job)
         if current is None:
             return
         observed = (hpa.current_replicas, hpa.desired_replicas)
@@ -218,7 +230,7 @@ class HorizontalAutoscaler:
                 return  # target deleted mid-sync
             except ConflictError:
                 job = self.api.try_get(hpa.target_kind, hpa.namespace, hpa.target_name)
-                if job is None or self._current_replicas(job) is None:
+                if job is None or self._current_replicas(hpa.namespace, job) is None:
                     return
         else:
             return  # persistent conflicts: next sync retries

@@ -198,18 +198,67 @@ class TrainingClient:
             sel[capi.JOB_ROLE_LABEL] = "master"
         return sorted(p.name for p in self.api.list("Pod", ns, sel))
 
-    def get_job_logs(self, name: str, namespace: Optional[str] = None) -> Dict[str, str]:
-        """Pod name -> log text. The virtual substrate has no container
-        stdout; the per-object event stream is the observable log."""
+    def get_job_logs(
+        self,
+        name: str,
+        namespace: Optional[str] = None,
+        tail: Optional[int] = None,
+    ) -> Dict[str, str]:
+        """Pod name -> that pod's OWN log (kubelet lifecycle lines +
+        container stdout; reference training_client.py:1130 read_namespaced_
+        pod_log). `tail` limits each pod to its last N lines."""
         ns = namespace or self.namespace
         logs: Dict[str, str] = {}
         for pod in self.api.list("Pod", ns, {capi.JOB_NAME_LABEL: name}):
-            events = self.api.events(object_name=name)
-            lines = [f"{e.timestamp:.3f} {e.event_type} {e.reason}: {e.message}"
-                     for e in events]
-            lines.append(f"phase={pod.status.phase.value} node={pod.node_name}")
+            lines, _ = self.api.read_pod_log(ns, pod.name, tail=tail)
             logs[pod.name] = "\n".join(lines)
         return logs
+
+    def follow_job_logs(
+        self,
+        name: str,
+        namespace: Optional[str] = None,
+        timeout: float = 600.0,
+        poll: float = 1.0,
+    ):
+        """Generator streaming (pod_name, line) as pods emit them — the
+        reference's get_job_logs(follow=True). Advances the cluster between
+        polls (the in-process analogue of a blocking HTTP log stream) and
+        ends when the job is finished and all retained lines are drained."""
+        ns = namespace or self.namespace
+        # Cursors keyed by pod UID: a pod deleted and recreated under the
+        # same deterministic name (elastic TPU resize) gets a fresh log
+        # buffer — a name-keyed cursor would skip its first lines.
+        cursors: Dict[str, int] = {}
+        waited = 0.0
+        while True:
+            job_done = True
+            for kind in ("JAXJob", "PyTorchJob", "TFJob", "XGBoostJob",
+                         "PaddleJob", "MPIJob", "TrainJob"):
+                obj = self.api.try_get(kind, ns, name)
+                if obj is not None:
+                    status = getattr(obj, "status", None)
+                    job_done = (
+                        obj.is_finished()
+                        if hasattr(obj, "is_finished")
+                        else capi.is_finished(status)
+                    )
+                    break
+            for pod in sorted(
+                self.api.list("Pod", ns, {capi.JOB_NAME_LABEL: name}),
+                key=lambda p: p.name,
+            ):
+                lines, cursors[pod.metadata.uid] = self.api.read_pod_log(
+                    ns, pod.name, since=cursors.get(pod.metadata.uid, 0)
+                )
+                for line in lines:
+                    yield pod.name, line
+            if job_done:
+                return
+            if waited >= timeout:
+                raise TimeoutException(f"timeout following logs of {name}")
+            self.cluster.run_for(poll)
+            waited += poll
 
     # -- high-level fine-tune ---------------------------------------------
 
