@@ -166,7 +166,9 @@ class HorizontalAutoscaler:
         if trainer is not None and trainer.num_nodes is not None:
             return trainer.num_nodes
         if hasattr(job, "runtime_ref"):
-            for kind in ("JAXJob", "PyTorchJob", "TFJob", "MPIJob"):
+            from training_operator_tpu.runtime.controller import WORKLOAD_KINDS
+
+            for kind in WORKLOAD_KINDS:
                 wl = self.api.try_get(kind, namespace, job.name)
                 if wl is not None:
                     spec = wl.replica_specs.get(REPLICA_WORKER)

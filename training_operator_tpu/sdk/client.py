@@ -232,9 +232,8 @@ class TrainingClient:
         cursors: Dict[str, int] = {}
         waited = 0.0
         while True:
-            job_done = True
-            for kind in ("JAXJob", "PyTorchJob", "TFJob", "XGBoostJob",
-                         "PaddleJob", "MPIJob", "TrainJob"):
+            job_done = None
+            for kind in JOB_KIND_NAMES:
                 obj = self.api.try_get(kind, ns, name)
                 if obj is not None:
                     status = getattr(obj, "status", None)
@@ -244,6 +243,10 @@ class TrainingClient:
                         else capi.is_finished(status)
                     )
                     break
+            if job_done is None:
+                # A typo'd or deleted job must not read as "finished with no
+                # logs" — the other SDK calls raise for the same mistake.
+                raise NotFoundError(f"no job named {ns}/{name}")
             for pod in sorted(
                 self.api.list("Pod", ns, {capi.JOB_NAME_LABEL: name}),
                 key=lambda p: p.name,

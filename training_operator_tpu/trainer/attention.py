@@ -169,7 +169,12 @@ def attention(
             return ulysses_attention(q, k, v, mesh, causal=causal)
         return ring_attention(q, k, v, mesh, causal=causal)
     if impl != "xla":
-        from training_operator_tpu.trainer.flash import flash_attention, flash_available
+        from training_operator_tpu.trainer.flash import (
+            FLASH_BWD_BLOCKS,
+            FLASH_FWD_BLOCKS,
+            flash_attention,
+            flash_available,
+        )
 
         d = q.shape[-1]
         # The kernel pads odd sequence lengths itself; only the head_dim
@@ -188,7 +193,7 @@ def attention(
         if impl == "flash" or (impl == "auto" and on_tpu and usable):
             interpret = not on_tpu
             if mesh is None or all(n == 1 for n in mesh.shape.values()):
-                return flash_attention(q, k, v, causal, 512, 1024, interpret)
+                return flash_attention(q, k, v, causal, *FLASH_FWD_BLOCKS, interpret, *FLASH_BWD_BLOCKS)
             # Sharded path: a pallas_call has no SPMD partitioning rule, so
             # it must run per-device under shard_map (batch over data/fsdp,
             # heads over tensor; sequence is unsharded on this branch).
@@ -198,7 +203,9 @@ def attention(
             )
             if h_local >= 1 and b_local >= 1:
                 spec = P(BATCH_AXES, None, "tensor", None)
-                fn = lambda a, b_, c: flash_attention(a, b_, c, causal, 512, 1024, interpret)
+                fn = lambda a, b_, c: flash_attention(
+                    a, b_, c, causal, *FLASH_FWD_BLOCKS, interpret, *FLASH_BWD_BLOCKS
+                )
                 return jax.shard_map(
                     fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
                     check_vma=False,

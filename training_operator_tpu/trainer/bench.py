@@ -100,6 +100,7 @@ def bench_train_step(
     seq: int,
     steps: int = 10,
     warmup: int = 2,
+    breakdown: bool = True,
 ) -> Dict[str, Any]:
     from training_operator_tpu.trainer.train import (
         init_train_state,
@@ -142,7 +143,7 @@ def bench_train_step(
     fps = flops_per_step(config, n_matmul, batch, seq)
     peak = PEAK_BF16_FLOPS.get(device.device_kind)
     achieved = fps / step_mean
-    return {
+    out = {
         "platform": device.platform,
         "device_kind": device.device_kind,
         "params_m": round(total / 1e6, 1),
@@ -152,9 +153,66 @@ def bench_train_step(
         "tokens_per_s": round(batch * seq / step_mean, 1),
         "model_tflops_per_s": round(achieved / 1e12, 2),
         "mfu": round(achieved / peak, 4) if peak else None,
+        # Hardware utilization: with remat the chip EXECUTES ~8N matmul
+        # FLOPs per token (2N fwd + 4N bwd + 2N recompute) while model-FLOP
+        # MFU credits only 6N — this approximate rescale shows how close
+        # the executed work runs to peak (the remat-bound MFU ceiling is
+        # ~0.75 x this number's efficiency).
+        "mfu_executed_est": round(achieved * (8.0 / 6.0) / peak, 4) if peak else None,
         "compile_s": round(compile_s, 1),
         "final_loss": round(float(metrics["loss"]), 4),
     }
+    if breakdown:
+        out["breakdown"] = _phase_breakdown(
+            config, state.params, data, step_mean, steps
+        )
+    return out
+
+
+def _phase_breakdown(config, params, data, step_mean, steps) -> Dict[str, Any]:
+    """Ablation-derived per-phase accounting of one train step:
+
+      fwd_ms        jitted loss (forward) alone
+      bwd_ms        value_and_grad minus forward — includes the remat
+                    recompute of the whole forward (so bwd ~ 2x fwd plus
+                    the gradient matmuls is EXPECTED with remat on)
+      optimizer_ms  full step minus value_and_grad — global-norm clip +
+                    AdamW + param/moment updates
+      remat_recompute_ms_est   one forward's worth of the backward (the
+                    cost remat pays to keep activations out of HBM)
+
+    Each phase is timed with the same dispatch-pipelined methodology as the
+    full step; phases are derived by subtraction, so dispatch overlap can
+    make small phases read near zero — treat as attribution, not as
+    isolated kernel truth."""
+    from training_operator_tpu.trainer.model import loss_fn
+
+    def timed(fn, *args) -> float:
+        r = fn(*args)  # compile + warmup
+        _fence(r)
+        t = time.perf_counter()
+        for _ in range(steps):
+            r = fn(*args)
+        _fence(r)
+        return (time.perf_counter() - t) / steps
+
+    fwd = jax.jit(lambda p, b: loss_fn(p, b, config, None))
+    t_fwd = timed(fwd, params, data)
+    fwdbwd = jax.jit(jax.value_and_grad(lambda p, b: loss_fn(p, b, config, None)))
+    t_fwdbwd = timed(fwdbwd, params, data)
+    return {
+        "fwd_ms": round(t_fwd * 1e3, 2),
+        "bwd_ms": round((t_fwdbwd - t_fwd) * 1e3, 2),
+        "optimizer_ms": round((step_mean - t_fwdbwd) * 1e3, 2),
+        "remat_recompute_ms_est": round(t_fwd * 1e3, 2),
+        "fwdbwd_ms": round(t_fwdbwd * 1e3, 2),
+    }
+
+
+def _fence(r) -> None:
+    """Device->host sync on any pytree result (see bench_train_step note)."""
+    leaf = jax.tree.leaves(r)[0]
+    float(jnp.asarray(leaf).reshape(-1)[0])
 
 
 def bench_attention(
@@ -165,9 +223,17 @@ def bench_attention(
     iters: int = 20,
 ) -> Dict[str, Any]:
     """Flash (pallas) vs XLA fused attention, forward and forward+backward,
-    identical [B, S, H, D] bf16 shapes."""
+    identical [B, S, H, D] bf16 shapes. Long sequences: the XLA path
+    materializes the [S, S] score matrix, so entries where it cannot fit
+    HBM report null — flash running where the baseline cannot IS the
+    result there."""
     from training_operator_tpu.trainer.attention import plain_attention
-    from training_operator_tpu.trainer.flash import flash_attention, flash_available
+    from training_operator_tpu.trainer.flash import (
+        FLASH_BWD_BLOCKS,
+        FLASH_FWD_BLOCKS,
+        flash_attention,
+        flash_available,
+    )
 
     interpret = not flash_available()
     if interpret:
@@ -182,10 +248,12 @@ def bench_attention(
     k = jax.random.normal(kk, shape, jnp.bfloat16)
     v = jax.random.normal(kv, shape, jnp.bfloat16)
 
-    flash_f = lambda a, b, c: flash_attention(a, b, c, True, 512, 1024, interpret)
+    fbq, fbk = FLASH_FWD_BLOCKS
+    bbq, bbk = FLASH_BWD_BLOCKS
+    flash_f = lambda a, b, c: flash_attention(a, b, c, True, fbq, fbk, interpret)
     xla_f = lambda a, b, c: plain_attention(a, b, c, causal=True)
     flash_g = jax.grad(
-        lambda a, b, c: flash_attention(a, b, c, True, 512, 1024, interpret)
+        lambda a, b, c: flash_attention(a, b, c, True, fbq, fbk, interpret, bbq, bbk)
         .astype(jnp.float32)
         .sum()
     )
@@ -193,12 +261,17 @@ def bench_attention(
         lambda a, b, c: plain_attention(a, b, c, causal=True).astype(jnp.float32).sum()
     )
 
-    def timed(fn) -> float:
+    errors: Dict[str, str] = {}
+
+    def timed(label: str, fn) -> Optional[float]:
         """Device time per iteration: the iterations are chained through the
         q operand inside ONE compiled program (out feeds the next call), so
         per-dispatch host/tunnel latency is amortized away and XLA cannot
         overlap or elide any step. The sync fence is a scalar device->host
-        transfer (block_until_ready is a no-op on remote-attached devices)."""
+        transfer (block_until_ready is a no-op on remote-attached devices).
+        None = this impl failed at this shape; the reason is recorded in the
+        `errors` output so an OOM (expected at long seq for the XLA path)
+        stays distinguishable from a kernel regression."""
 
         @jax.jit
         def chained(a, b, c):
@@ -208,25 +281,39 @@ def bench_attention(
             out = jax.lax.fori_loop(0, iters, body, a)
             return out.astype(jnp.float32).mean()
 
-        float(chained(q, k, v))  # compile + sync
-        t = time.perf_counter()
-        float(chained(q, k, v))
-        return (time.perf_counter() - t) / iters
+        try:
+            float(chained(q, k, v))  # compile + sync
+            t = time.perf_counter()
+            float(chained(q, k, v))
+            return (time.perf_counter() - t) / iters
+        except Exception as e:
+            errors[label] = f"{type(e).__name__}: {str(e)[:200]}"
+            return None
 
-    fwd_flash = timed(flash_f)
-    fwd_xla = timed(xla_f)
-    bwd_flash = timed(flash_g)
-    bwd_xla = timed(xla_g)
-    return {
+    fwd_flash = timed("fwd_flash", flash_f)
+    fwd_xla = timed("fwd_xla", xla_f)
+    bwd_flash = timed("fwdbwd_flash", flash_g)
+    bwd_xla = timed("fwdbwd_xla", xla_g)
+
+    def ms(x):
+        return round(x * 1e3, 3) if x is not None else None
+
+    def ratio(a, b):
+        return round(a / b, 3) if a is not None and b is not None else None
+
+    out = {
         "shape": list(shape),
         "interpret": interpret,
-        "fwd_flash_ms": round(fwd_flash * 1e3, 3),
-        "fwd_xla_ms": round(fwd_xla * 1e3, 3),
-        "fwd_speedup": round(fwd_xla / fwd_flash, 3),
-        "fwdbwd_flash_ms": round(bwd_flash * 1e3, 3),
-        "fwdbwd_xla_ms": round(bwd_xla * 1e3, 3),
-        "fwdbwd_speedup": round(bwd_xla / bwd_flash, 3),
+        "fwd_flash_ms": ms(fwd_flash),
+        "fwd_xla_ms": ms(fwd_xla),
+        "fwd_speedup": ratio(fwd_xla, fwd_flash),
+        "fwdbwd_flash_ms": ms(bwd_flash),
+        "fwdbwd_xla_ms": ms(bwd_xla),
+        "fwdbwd_speedup": ratio(bwd_xla, bwd_flash),
     }
+    if errors:
+        out["errors"] = errors
+    return out
 
 
 def run_trainer_bench(steps: int = 10) -> Dict[str, Any]:
@@ -239,6 +326,10 @@ def run_trainer_bench(steps: int = 10) -> Dict[str, Any]:
         config, batch, seq = flagship_config(platform)
         out["train_step"] = bench_train_step(config, batch, seq, steps=steps)
         out["attention"] = bench_attention()
+        if platform == "tpu":
+            # Long-context point: seq 8192 is where flash's O(S) memory is
+            # decisive — the XLA path's [S, S] scores may not fit at all.
+            out["attention_8k"] = bench_attention(batch=2, seq=8192, iters=10)
     except Exception as e:  # pragma: no cover - hardware-dependent
         out["error"] = f"{type(e).__name__}: {e}"
     return out

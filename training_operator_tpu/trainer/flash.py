@@ -35,6 +35,15 @@ from jax.experimental.pallas import tpu as pltpu
 
 _MASK = -1e30
 
+# Sweep optima on v5e at [8, 2048, 12, 128]: the forward prefers
+# (block_q=512, block_k=1024); the backward kernels' per-step working set
+# is ~3x the forward's (q, do, and the ds tile all resident), and their
+# optimum is square (1024, 1024) — fwd+bwd 6.3ms vs 9.1ms when reusing the
+# forward's blocks. Production (attention.py) and the bench both import
+# these so measured and trained configurations can never diverge.
+FLASH_FWD_BLOCKS = (512, 1024)
+FLASH_BWD_BLOCKS = (1024, 1024)
+
 
 def _fit_block(s: int, cap: int) -> int:
     """Largest 128-aligned block <= cap that divides s (s must be a multiple
@@ -326,20 +335,24 @@ def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     return _unfold(out[:, :s], b, h), lse, seq_len
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     causal: bool = True, block_q: int = 512, block_k: int = 1024,
     interpret: bool = False,
+    bwd_block_q: Optional[int] = None, bwd_block_k: Optional[int] = None,
 ) -> jax.Array:
     """Flash attention on [B, S, H, D]; `interpret=True` runs the kernels in
     the Pallas interpreter (CPU tests). Sequence lengths are padded to 128
     internally; K/V must carry the same head count as Q (GQA expansion
-    happens in attention.py's dispatcher)."""
+    happens in attention.py's dispatcher). The backward kernels take their
+    own block sizes (default: the forward's) — their working set per grid
+    step is ~3x the forward's (q, do, and the ds tile), so the sweep
+    optimum differs."""
     return _fwd_impl(q, k, v, causal, block_q, block_k, interpret)[0]
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, causal, block_q, block_k, interpret, bwd_block_q, bwd_block_k):
     out, lse, seq_len = _fwd_impl(q, k, v, causal, block_q, block_k, interpret)
     # Residuals save the RETURNED output (its buffer is shared with the
     # consumer, so this adds no HBM) — not a folded/padded copy, which would
@@ -347,7 +360,7 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _bwd(causal, block_q, block_k, interpret, res, g):
+def _bwd(causal, block_q, block_k, interpret, bwd_block_q, bwd_block_k, res, g):
     q, k, v, out, lse = res
     b, s, h, d = q.shape
     qf, seq_len = _pad128(_fold(q))
@@ -365,7 +378,8 @@ def _bwd(causal, block_q, block_k, interpret, res, g):
     )
     dq, dk, dv = _flash_bwd_folded(
         qf, kf, vf, dof, lse, delta, seq_len=seq_len, causal=causal,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        block_q=bwd_block_q or block_q, block_k=bwd_block_k or block_k,
+        interpret=interpret,
     )
     return (
         _unfold(dq[:, :s], b, h).astype(q.dtype),
