@@ -231,6 +231,7 @@ class TrainingClient:
         # buffer — a name-keyed cursor would skip its first lines.
         cursors: Dict[str, int] = {}
         waited = 0.0
+        seen_job = False
         while True:
             job_done = None
             for kind in JOB_KIND_NAMES:
@@ -243,10 +244,11 @@ class TrainingClient:
                         else capi.is_finished(status)
                     )
                     break
-            if job_done is None:
-                # A typo'd or deleted job must not read as "finished with no
-                # logs" — the other SDK calls raise for the same mistake.
+            if job_done is None and not seen_job:
+                # A typo'd name must not read as "finished with no logs" —
+                # the other SDK calls raise for the same mistake.
                 raise NotFoundError(f"no job named {ns}/{name}")
+            seen_job = seen_job or job_done is not None
             for pod in sorted(
                 self.api.list("Pod", ns, {capi.JOB_NAME_LABEL: name}),
                 key=lambda p: p.name,
@@ -256,7 +258,10 @@ class TrainingClient:
                 )
                 for line in lines:
                     yield pod.name, line
-            if job_done:
+            if job_done or job_done is None:
+                # Finished — or deleted mid-follow (TTL/cascade GC): either
+                # way the retained tail above has been drained; end cleanly
+                # like the blocking HTTP stream the reference wraps.
                 return
             if waited >= timeout:
                 raise TimeoutException(f"timeout following logs of {name}")

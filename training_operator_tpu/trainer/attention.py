@@ -182,18 +182,27 @@ def attention(
         usable = d in (64, 128, 256)
         # Where will this computation actually run? Concrete (eager) inputs
         # answer precisely — a CPU-resident array under a TPU default
-        # backend must use the interpreter; tracers fall back to the
-        # backend probe.
+        # backend must use the interpreter. Tracers consult the
+        # jax.default_device pin first (the axon TPU plugin keeps the TPU
+        # as default backend even under JAX_PLATFORMS=cpu, so tests that
+        # pin CPU would otherwise get an uninterpreted kernel), then fall
+        # back to the backend probe.
         on_tpu = flash_available()
         if not isinstance(q, jax.core.Tracer):
             try:
                 on_tpu = next(iter(q.devices())).platform == "tpu"
             except Exception:
                 pass
+        else:
+            pinned = getattr(jax.config, "jax_default_device", None)
+            if pinned is not None:
+                on_tpu = getattr(pinned, "platform", str(pinned)) == "tpu"
         if impl == "flash" or (impl == "auto" and on_tpu and usable):
             interpret = not on_tpu
             if mesh is None or all(n == 1 for n in mesh.shape.values()):
-                return flash_attention(q, k, v, causal, *FLASH_FWD_BLOCKS, interpret, *FLASH_BWD_BLOCKS)
+                return flash_attention(
+                    q, k, v, causal, *FLASH_FWD_BLOCKS, interpret, *FLASH_BWD_BLOCKS
+                )
             # Sharded path: a pallas_call has no SPMD partitioning rule, so
             # it must run per-device under shard_map (batch over data/fsdp,
             # heads over tensor; sequence is unsharded on this branch).

@@ -17,6 +17,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from training_operator_tpu import native
 from training_operator_tpu.trainer.mesh import batch_sharding
 
 
@@ -56,6 +57,18 @@ class TokenDataset:
         pid, n = process_shard()
         return cls(rows, pid, n)
 
+    @classmethod
+    def from_token_file(
+        cls, path: str, seq_len: int, process_id: int = 0, num_processes: int = 1
+    ) -> "TokenDataset":
+        """Memory-map a flat int32 token file and view it as packed LM rows —
+        zero-copy: the kernel pages rows in as the (native) gather touches
+        them, so arenas larger than host RAM work."""
+        flat = np.memmap(path, dtype=np.int32, mode="r")
+        row = seq_len + 1
+        n = len(flat) // row
+        return cls(flat[: n * row].reshape(n, row), process_id, num_processes)
+
     def __len__(self) -> int:
         return len(self.rows)
 
@@ -72,6 +85,7 @@ class DataLoader:
         shuffle: bool = True,
         seed: int = 0,
         drop_last: bool = True,
+        use_native: Optional[bool] = None,
     ):
         if batch_size > len(dataset):
             raise ValueError(
@@ -87,6 +101,18 @@ class DataLoader:
         self.shuffle = shuffle
         self.seed = seed
         self.drop_last = drop_last
+        # Native C++ gather path (training_operator_tpu/native): real OS
+        # threads copy the shuffled rows out of the (possibly mmap'd) arena
+        # with the NEXT batch staged while the device runs the current step.
+        # Auto-detect by default; falls back to numpy wherever the toolchain
+        # is absent, with identical output either way.
+        if use_native is None:
+            use_native = (
+                native.available()
+                and dataset.rows.dtype == np.int32
+                and dataset.rows.flags.c_contiguous
+            )
+        self.use_native = use_native
 
     def __iter__(self) -> Iterator[Dict[str, jax.Array]]:
         return self.epoch(0)
@@ -97,18 +123,30 @@ class DataLoader:
         if self.shuffle:
             np.random.RandomState(self.seed + epoch).shuffle(order)
         end = (len(rows) // self.batch_size) * self.batch_size if self.drop_last else len(rows)
-        for start in range(0, end, self.batch_size):
-            chunk = rows[order[start : start + self.batch_size]]
-            batch = {
-                "tokens": chunk[:, :-1],
-                "targets": chunk[:, 1:],
-                "mask": np.ones_like(chunk[:, 1:], dtype=np.float32),
-            }
-            if self.mesh is not None:
-                sharding = batch_sharding(self.mesh)
-                yield {k: jax.device_put(v, sharding) for k, v in batch.items()}
-            else:
-                yield {k: jax.numpy.asarray(v) for k, v in batch.items()}
+        starts = list(range(0, end, self.batch_size))
+        if self.use_native and starts:
+            with native.Prefetcher(rows) as pf:
+                pf.submit(order[starts[0] : starts[0] + self.batch_size])
+                for i, start in enumerate(starts):
+                    chunk = pf.wait()
+                    if i + 1 < len(starts):
+                        nxt = starts[i + 1]
+                        pf.submit(order[nxt : nxt + self.batch_size])
+                    yield self._emit(chunk)
+            return
+        for start in starts:
+            yield self._emit(rows[order[start : start + self.batch_size]])
+
+    def _emit(self, chunk: np.ndarray) -> Dict[str, jax.Array]:
+        batch = {
+            "tokens": chunk[:, :-1],
+            "targets": chunk[:, 1:],
+            "mask": np.ones_like(chunk[:, 1:], dtype=np.float32),
+        }
+        if self.mesh is not None:
+            sharding = batch_sharding(self.mesh)
+            return {k: jax.device_put(v, sharding) for k, v in batch.items()}
+        return {k: jax.numpy.asarray(v) for k, v in batch.items()}
 
 
 def prefetch(batches: Iterator[Dict[str, jax.Array]], size: int = 2) -> Iterator[Dict[str, jax.Array]]:

@@ -29,6 +29,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 
 from jax.experimental.pallas import tpu as pltpu
@@ -336,6 +337,22 @@ def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def flash_attention_with_lse(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    causal: bool = True, block_q: int = 512, block_k: int = 1024,
+    interpret: bool = False,
+    bwd_block_q: Optional[int] = None, bwd_block_k: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Flash attention returning (out [B, S, H, D], lse [B*H, SP, 1]).
+    The lse is a PRIMAL OUTPUT (not just a vjp residual) on purpose: the
+    custom_vjp's backward needs exactly (q, k, v, out, lse), all of which
+    are then visible tensors a `jax.checkpoint` naming policy can save —
+    which lets selective remat skip re-running this kernel in the backward
+    pass (an opaque residual could never be offered to the policy)."""
+    out, lse, _ = _fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return out, lse
+
+
 def flash_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     causal: bool = True, block_q: int = 512, block_k: int = 1024,
@@ -349,19 +366,29 @@ def flash_attention(
     own block sizes (default: the forward's) — their working set per grid
     step is ~3x the forward's (q, do, and the ds tile), so the sweep
     optimum differs."""
-    return _fwd_impl(q, k, v, causal, block_q, block_k, interpret)[0]
+    return flash_attention_with_lse(
+        q, k, v, causal, block_q, block_k, interpret, bwd_block_q, bwd_block_k
+    )[0]
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret, bwd_block_q, bwd_block_k):
     out, lse, seq_len = _fwd_impl(q, k, v, causal, block_q, block_k, interpret)
-    # Residuals save the RETURNED output (its buffer is shared with the
-    # consumer, so this adds no HBM) — not a folded/padded copy, which would
-    # double per-layer output residuals and erode the memory win.
-    return out, (q, k, v, out, lse)
+    # Residuals save the RETURNED outputs (buffers shared with the consumer,
+    # so this adds no HBM) — not folded/padded copies, which would double
+    # per-layer output residuals and erode the memory win. The names are
+    # applied HERE, on the very values the residual tuple carries, so a
+    # `save_only_these_names("attn_out", "attn_lse", ...)` remat policy
+    # marks the residuals known and the partial evaluator elides the kernel
+    # re-run in the backward pass (naming a downstream alias would create a
+    # fresh variable the residuals never reference).
+    out = checkpoint_name(out, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
+    return (out, lse), (q, k, v, out, lse)
 
 
 def _bwd(causal, block_q, block_k, interpret, bwd_block_q, bwd_block_k, res, g):
     q, k, v, out, lse = res
+    g, _ = g  # cotangent for the lse output is unused (it feeds no loss)
     b, s, h, d = q.shape
     qf, seq_len = _pad128(_fold(q))
     kf, _ = _pad128(_fold(k))
@@ -388,7 +415,7 @@ def _bwd(causal, block_q, block_k, interpret, bwd_block_q, bwd_block_k, res, g):
     )
 
 
-flash_attention.defvjp(_fwd, _bwd)
+flash_attention_with_lse.defvjp(_fwd, _bwd)
 
 
 def flash_available() -> bool:

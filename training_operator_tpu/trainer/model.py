@@ -26,6 +26,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from training_operator_tpu.trainer.attention import attention
@@ -56,14 +57,47 @@ class TransformerConfig:
     # Attention implementation: "auto" (flash on TPU / XLA), "flash", "xla";
     # on sequence-sharded meshes "ring" (default) or "ulysses" (all-to-all).
     attn_impl: str = "auto"
+    # Selective rematerialization (only meaningful with remat=True). The
+    # flash custom_vjp names its (out, lse) residuals inside its own fwd
+    # rule (flash.py:_fwd), so "save_attn*" policies genuinely elide the
+    # kernel re-run in backward (verified by jaxpr: 4 -> 3 pallas_calls).
+    # v5e measurements at the flagship [8, 2048] shape, full remat = 525 ms:
+    #   "full"          save nothing — recompute the whole layer in backward
+    #   "save_attn"     save attention out+lse only. The elision is real but
+    #                   worth just ~4 ms here; without freeing HBM elsewhere
+    #                   the extra residents make it a wash (533 ms with
+    #                   remat_head, 521 without). Kept for ablation.
+    #   "save_attn_qkv" also save the rope'd q/k/v, skipping the qkv
+    #                   matmuls + rope in recompute — the tuned choice at
+    #                   ~503 ms combined with remat_head=True below.
+    #   "mlp_only"      move the remat BOUNDARY: only the MLP/MoE half is
+    #                   checkpointed, attention residuals all stored. OOMs
+    #                   at the flagship shape on 16 GB (measured); viable
+    #                   for smaller models or bigger-HBM chips.
+    #   "save_dots"     XLA policy: save every matmul output. Also OOMs at
+    #                   the flagship shape (measured).
+    remat_policy: str = "full"
+    # Rematerialize the lm-head + cross-entropy region in loss_fn: the
+    # [B, S, V] float32 logits (and their cotangent) dominate peak HBM at
+    # LM vocab sizes — recomputing them in backward costs one extra head
+    # matmul but frees ~2 * B*S*V*4 bytes, which is what pays for the
+    # "save_attn*" residuals above.
+    remat_head: bool = False
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
+    REMAT_POLICIES = ("full", "mlp_only", "save_attn", "save_attn_qkv", "save_dots")
+
     def validate(self) -> None:
         assert self.d_model % self.n_heads == 0
         assert self.n_heads % self.n_kv_heads == 0
+        if self.remat_policy not in self.REMAT_POLICIES:
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r}; "
+                f"one of {self.REMAT_POLICIES}"
+            )
 
 
 def param_specs(config: TransformerConfig) -> Dict[str, Any]:
@@ -158,6 +192,58 @@ def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
     }
 
 
+def make_layer_body(
+    config: TransformerConfig,
+    positions: jax.Array,
+    mesh: Optional[Mesh],
+    attn_impl: str,
+):
+    """The scan/pipeline-stage body `(x, lp) -> (x, aux)` with the config's
+    remat strategy applied. "mlp_only" moves the remat BOUNDARY (attention
+    fully outside the checkpointed region); the other modes wrap the whole
+    layer in jax.checkpoint with a naming policy — see the remat_policy
+    field comment for what each is measured to do."""
+    c = config
+    act_spec = P(BATCH_AXES, "sequence", None)
+
+    def full_layer(x, lp):
+        return decoder_layer(x, lp, c, positions, mesh, attn_impl=attn_impl)
+
+    if not c.remat:
+        return full_layer
+    if c.remat_policy == "mlp_only":
+        mlp = jax.checkpoint(lambda x, lp: _mlp_block(x, lp, c, mesh))
+
+        def body(x, lp):
+            x = x + _constrain(
+                _attn_block(x, lp, c, positions, mesh, attn_impl),
+                mesh, act_spec,
+            )
+            out, aux = mlp(x, lp)
+            x = x + _constrain(out, mesh, act_spec)
+            return x, aux
+
+        return body
+    cp = jax.checkpoint_policies
+    try:
+        policy = {
+            "full": None,
+            "save_attn": cp.save_only_these_names("attn_out", "attn_lse"),
+            "save_attn_qkv": cp.save_only_these_names(
+                "attn_out", "attn_lse", "attn_q", "attn_k", "attn_v"
+            ),
+            "save_dots": cp.dots_with_no_batch_dims_saveable,
+        }[c.remat_policy]
+    except KeyError:
+        raise ValueError(
+            f"unknown remat_policy {c.remat_policy!r}; "
+            f"one of {TransformerConfig.REMAT_POLICIES}"
+        ) from None
+    if policy is None:
+        return jax.checkpoint(full_layer)
+    return jax.checkpoint(full_layer, policy=policy)
+
+
 def _rms_norm(x: jax.Array, scale: jax.Array) -> jax.Array:
     x32 = x.astype(jnp.float32)
     var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
@@ -229,6 +315,48 @@ def _moe_mlp(
     return out, aux
 
 
+def _attn_block(
+    x: jax.Array,
+    lp: Dict[str, jax.Array],
+    config: TransformerConfig,
+    positions: jax.Array,
+    mesh: Optional[Mesh],
+    attn_impl: str,
+) -> jax.Array:
+    """norm -> qkv -> rope -> attention -> output projection; returns the
+    residual-branch contribution [b, s, d]."""
+    c = config
+    b, s, _ = x.shape
+    h = _rms_norm(x, lp["ln1"])
+    q = (h @ lp["wq"].astype(c.dtype)).reshape(b, s, c.n_heads, c.head_dim)
+    k = (h @ lp["wk"].astype(c.dtype)).reshape(b, s, c.n_kv_heads, c.head_dim)
+    v = (h @ lp["wv"].astype(c.dtype)).reshape(b, s, c.n_kv_heads, c.head_dim)
+    q = checkpoint_name(_rope(q, positions, c.rope_theta), "attn_q")
+    k = checkpoint_name(_rope(k, positions, c.rope_theta), "attn_k")
+    v = checkpoint_name(v, "attn_v")
+    # GQA expansion happens inside attention() — one place for every backend.
+    # The "attn_out"/"attn_lse" names live inside the flash custom_vjp's fwd
+    # rule (flash.py:_fwd) so they bind the actual residual tensors.
+    attn = attention(q, k, v, mesh, causal=True, impl=attn_impl)
+    return attn.reshape(b, s, c.n_heads * c.head_dim) @ lp["wo"].astype(c.dtype)
+
+
+def _mlp_block(
+    x: jax.Array,
+    lp: Dict[str, jax.Array],
+    config: TransformerConfig,
+    mesh: Optional[Mesh],
+):
+    """norm -> SwiGLU (or switch MoE); returns (contribution, aux loss)."""
+    c = config
+    h = _rms_norm(x, lp["ln2"])
+    if c.n_experts > 0:
+        return _moe_mlp(h, lp, c, mesh)
+    gate = jax.nn.silu(h @ lp["w1"].astype(c.dtype))
+    up = h @ lp["w3"].astype(c.dtype)
+    return (gate * up) @ lp["w2"].astype(c.dtype), jnp.zeros((), jnp.float32)
+
+
 def decoder_layer(
     x: jax.Array,
     lp: Dict[str, jax.Array],
@@ -243,40 +371,24 @@ def decoder_layer(
     level by the schedule, see pipeline.py)."""
     c = config
     act_spec = P(BATCH_AXES, "sequence", None)
-    b, s, _ = x.shape
-    h = _rms_norm(x, lp["ln1"])
-    q = (h @ lp["wq"].astype(c.dtype)).reshape(b, s, c.n_heads, c.head_dim)
-    k = (h @ lp["wk"].astype(c.dtype)).reshape(b, s, c.n_kv_heads, c.head_dim)
-    v = (h @ lp["wv"].astype(c.dtype)).reshape(b, s, c.n_kv_heads, c.head_dim)
-    q = _rope(q, positions, c.rope_theta)
-    k = _rope(k, positions, c.rope_theta)
-    # GQA expansion happens inside attention() — one place for every backend.
-    attn = attention(q, k, v, mesh, causal=True, impl=attn_impl)
     x = x + _constrain(
-        attn.reshape(b, s, c.n_heads * c.head_dim) @ lp["wo"].astype(c.dtype),
-        mesh, act_spec,
+        _attn_block(x, lp, c, positions, mesh, attn_impl), mesh, act_spec
     )
-    h = _rms_norm(x, lp["ln2"])
-    if c.n_experts > 0:
-        moe_out, aux = _moe_mlp(h, lp, c, mesh)
-        x = x + _constrain(moe_out, mesh, act_spec)
-    else:
-        gate = jax.nn.silu(h @ lp["w1"].astype(c.dtype))
-        up = h @ lp["w3"].astype(c.dtype)
-        x = x + _constrain((gate * up) @ lp["w2"].astype(c.dtype), mesh, act_spec)
-        aux = jnp.zeros((), jnp.float32)
+    out, aux = _mlp_block(x, lp, c, mesh)
+    x = x + _constrain(out, mesh, act_spec)
     return x, aux
 
 
-def forward_with_aux(
+def backbone(
     params: Dict[str, Any],
     tokens: jax.Array,
     config: TransformerConfig,
     mesh: Optional[Mesh] = None,
 ):
-    """tokens [B, S] (S sequence-sharded) -> (logits [B, S, V] float32
-    (V tensor-sharded), aux losses dict). Dispatches to the GPipe schedule
-    when the mesh has a pipeline axis."""
+    """tokens [B, S] -> (final-norm hidden states [B, S, D], aux dict):
+    everything up to but excluding the lm head, so loss_fn can put the
+    head+loss region under its own remat boundary. Dispatches to the GPipe
+    schedule when the mesh has a pipeline axis."""
     from training_operator_tpu.trainer.mesh import axis_size
 
     c = config
@@ -300,18 +412,31 @@ def forward_with_aux(
         x, aux = pipeline_apply(params["layers"], x, config, mesh)
     else:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
-
-        def layer(x, lp):
-            return decoder_layer(x, lp, c, positions, mesh, attn_impl=c.attn_impl)
-
-        layer_fn = jax.checkpoint(layer) if c.remat else layer
+        layer_fn = make_layer_body(c, positions, mesh, c.attn_impl)
         x, aux_layers = jax.lax.scan(layer_fn, x, params["layers"])
         aux = aux_layers.mean()
 
     x = _rms_norm(x, params["ln_f"])
-    logits = x.astype(jnp.float32) @ params["lm_head"]
-    logits = _constrain(logits, mesh, P(BATCH_AXES, "sequence", "tensor"))
-    return logits, {"router_balance": aux}
+    return x, {"router_balance": aux}
+
+
+def _head_logits(
+    x: jax.Array, lm_head: jax.Array, mesh: Optional[Mesh]
+) -> jax.Array:
+    logits = x.astype(jnp.float32) @ lm_head
+    return _constrain(logits, mesh, P(BATCH_AXES, "sequence", "tensor"))
+
+
+def forward_with_aux(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    config: TransformerConfig,
+    mesh: Optional[Mesh] = None,
+):
+    """tokens [B, S] (S sequence-sharded) -> (logits [B, S, V] float32
+    (V tensor-sharded), aux losses dict)."""
+    x, aux = backbone(params, tokens, config, mesh)
+    return _head_logits(x, params["lm_head"], mesh), aux
 
 
 def forward(
@@ -334,12 +459,19 @@ def loss_fn(
     `batch` = {tokens, targets, mask}. Stable log-softmax in float32 over
     the (possibly tensor-sharded) vocab axis — XLA turns the reductions into
     reduce-scatters on `tensor`."""
-    logits, aux = forward_with_aux(params, batch["tokens"], config, mesh)
-    logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    target_logit = jnp.take_along_axis(
-        logits, batch["targets"][..., None].astype(jnp.int32), axis=-1
-    )[..., 0]
-    nll = logz - target_logit
+    x, aux = backbone(params, batch["tokens"], config, mesh)
+
+    def head_nll(x, lm_head, targets):
+        logits = _head_logits(x, lm_head, mesh)
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        target_logit = jnp.take_along_axis(
+            logits, targets[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+        return logz - target_logit
+
+    if config.remat_head:
+        head_nll = jax.checkpoint(head_nll)
+    nll = head_nll(x, params["lm_head"], batch["targets"])
     mask = batch.get("mask")
     if mask is None:
         ce = nll.mean()

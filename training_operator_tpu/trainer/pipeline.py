@@ -49,7 +49,7 @@ def pipeline_apply(
 ) -> Tuple[jax.Array, jax.Array]:
     """Run the decoder stack as a GPipe pipeline. `x` is the embedded input
     [B, S, D]; returns (hidden states [B, S, D], mean router aux loss)."""
-    from training_operator_tpu.trainer.model import decoder_layer, param_specs
+    from training_operator_tpu.trainer.model import make_layer_body, param_specs
 
     c = config
     n_stages = axis_size(mesh, "pipeline")
@@ -85,11 +85,7 @@ def pipeline_apply(
 
     def stage_fn(stage_layers, x):
         """One stage: scan its local layers over one microbatch."""
-
-        def one(x, lp):
-            return decoder_layer(x, lp, c, positions, mesh=None, attn_impl="xla")
-
-        layer_fn = jax.checkpoint(one) if c.remat else one
+        layer_fn = make_layer_body(c, positions, mesh=None, attn_impl="xla")
         x, aux = jax.lax.scan(layer_fn, x, stage_layers)
         return x, aux.sum()
 
