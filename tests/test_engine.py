@@ -565,3 +565,91 @@ class TestOperatorRestart:
             assert len(pods) == 2
             st = get_job(cluster, j.name).status
             assert st.replica_statuses["Worker"].succeeded == 2
+
+
+class TestLeaderElection:
+    """Lease-based leader election (reference --enable-leader-election via
+    controller-runtime; here controllers/leader.py + the Lease object)."""
+
+    def _env(self):
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_cpu_pool(8))
+        DefaultScheduler(cluster)
+        SimKubelet(cluster)
+        return cluster
+
+    def _manager(self, cluster, identity):
+        mgr = OperatorManager(cluster, leader_elect=True, identity=identity)
+        mgr.register(JAXController(cluster.api))
+        return mgr
+
+    def test_single_winner_and_only_leader_reconciles(self):
+        cluster = self._env()
+        a = self._manager(cluster, "op-a")
+        b = self._manager(cluster, "op-b")
+        a.submit(make_job(name="le-job", workers=2, **{ANNOTATION_SIM_DURATION: "1"}))
+        assert cluster.run_until(
+            lambda: job_has(cluster, capi.JobConditionType.SUCCEEDED, "le-job"),
+            timeout=60,
+        )
+        # Exactly one manager ever led; the standby queue stayed untouched.
+        assert a.elector.is_leader != b.elector.is_leader
+        lease = cluster.api.get("Lease", "operator-system",
+                                "training-operator-tpu")
+        assert lease.holder in ("op-a", "op-b")
+        standby = b if a.elector.is_leader else a
+        assert len(standby.queue) == 0
+
+    def test_failover_on_leader_death(self):
+        cluster = self._env()
+        a = self._manager(cluster, "op-a")
+        b = self._manager(cluster, "op-b")
+        cluster.run_for(1)
+        leader, standby = (a, b) if a.elector.is_leader else (b, a)
+        assert leader.elector.is_leader and not standby.elector.is_leader
+
+        # Job in flight when the leader dies WITHOUT releasing (hard crash:
+        # detach the ticker only, so the lease must expire on its own).
+        leader.submit(make_job(name="fo-job", workers=2,
+                               **{ANNOTATION_SIM_DURATION: "30"}))
+        assert cluster.run_until(
+            lambda: job_has(cluster, capi.JobConditionType.RUNNING, "fo-job"),
+            timeout=30,
+        )
+        cluster.remove_ticker(leader.tick)
+        cluster.api.unwatch(leader._watch)
+
+        # Standby takes over once the lease expires, resyncs, and drives the
+        # job to completion; transitions recorded on the lease.
+        assert cluster.run_until(lambda: standby.elector.is_leader, timeout=60)
+        lease = cluster.api.get("Lease", "operator-system",
+                                "training-operator-tpu")
+        assert lease.holder == standby.elector.identity
+        assert lease.transitions == 1
+        assert cluster.run_until(
+            lambda: job_has(cluster, capi.JobConditionType.SUCCEEDED, "fo-job"),
+            timeout=120,
+        )
+        # Adoption, not duplication: still exactly 2 pods.
+        pods = cluster.api.list("Pod", "default", {capi.JOB_NAME_LABEL: "fo-job"})
+        assert len(pods) == 2
+
+    def test_graceful_stop_hands_over_immediately(self):
+        cluster = self._env()
+        a = self._manager(cluster, "op-a")
+        b = self._manager(cluster, "op-b")
+        cluster.run_for(1)
+        leader, standby = (a, b) if a.elector.is_leader else (b, a)
+        leader.stop()  # releases the lease
+        # Well before the 15s lease duration could expire:
+        assert cluster.run_until(lambda: standby.elector.is_leader, timeout=5)
+
+    def test_renewal_keeps_leadership(self):
+        cluster = self._env()
+        a = self._manager(cluster, "op-a")
+        b = self._manager(cluster, "op-b")
+        cluster.run_for(120)  # many lease durations
+        assert a.elector.is_leader != b.elector.is_leader
+        lease = cluster.api.get("Lease", "operator-system",
+                                "training-operator-tpu")
+        assert lease.transitions == 0

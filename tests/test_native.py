@@ -73,6 +73,29 @@ class TestPrefetcher:
                 pf.submit(np.array([1], dtype=np.int64))  # already in flight
             pf.wait()
 
+    def test_wait_during_inflight_gather_blocks(self):
+        """Regression: a wait() that lands while the worker has dequeued the
+        request but not yet posted the result must BLOCK (in-flight state),
+        not read as 'nothing submitted' — that misread made Python drop the
+        staging buffer mid-memcpy (use-after-free). A large gather plus an
+        immediate wait reliably lands in that window."""
+        rng = np.random.RandomState(0)
+        rows = rng.randint(0, 1 << 20, size=(20_000, 512)).astype(np.int32)
+        idx = rng.randint(0, 20_000, size=50_000).astype(np.int64)
+        with native.Prefetcher(rows, threads=2) as pf:
+            for _ in range(3):
+                pf.submit(idx)
+                got = pf.wait()  # immediately — worker is mid-gather
+            np.testing.assert_array_equal(got, rows[idx])
+
+    def test_wrong_shape_out_rejected(self):
+        rows = np.zeros((8, 4), dtype=np.int32)
+        idx = np.arange(8, dtype=np.int64)
+        with pytest.raises(ValueError, match="out must be"):
+            native.gather_rows(rows, idx, out=np.empty((2, 4), np.int32))
+        with pytest.raises(ValueError, match="out must be"):
+            native.gather_rows(rows, idx, out=np.empty((8, 4), np.int64))
+
 
 class TestLoaderParity:
     def test_native_matches_numpy_path(self):

@@ -109,6 +109,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--enable-v2", dest="enable_v2", action="store_true", default=None,
                     help="run the v2 TrainJob/TrainingRuntime stack too")
     ap.add_argument("--disable-v2", dest="enable_v2", action="store_false")
+    ap.add_argument("--enable-leader-election", dest="leader_elect",
+                    action="store_true", default=None,
+                    help="lease-based leader election (standby until the "
+                         "active operator's lease expires or is released)")
+    ap.add_argument("--leader-identity", default=None,
+                    help="identity written into the lease (default: unique)")
     ap.add_argument("--cluster", help="cluster inventory JSON file")
     ap.add_argument("--workload", help="workload JSON file to submit at start")
     ap.add_argument("--virtual-clock", action="store_true",
@@ -137,6 +143,10 @@ def build_config(args: argparse.Namespace) -> OperatorConfig:
         cfg.health_bind_address = args.health_probe_bind_address
     if args.enable_v2 is not None:
         cfg.enable_v2 = args.enable_v2
+    if args.leader_elect is not None:
+        cfg.leader_elect = args.leader_elect
+    if args.leader_identity is not None:
+        cfg.leader_identity = args.leader_identity
     cfg.validate()
     return cfg
 
@@ -194,6 +204,8 @@ def build_stack(cluster: Cluster, cfg: OperatorConfig):
         gang_enabled=gang_enabled,
         reconciles_per_tick=cfg.controller_threads,
         namespace=cfg.namespace,
+        leader_elect=cfg.leader_elect,
+        identity=cfg.leader_identity,
     )
     for scheme in cfg.enabled_schemes:
         mgr.register(SCHEME_CONTROLLERS[scheme](cluster.api))

@@ -308,3 +308,33 @@ class Event:
     timestamp: float = 0.0
 
     KIND = "Event"
+
+
+@dataclass
+class Lease:
+    """Coordination lease for operator leader election (the analogue of the
+    coordination.k8s.io/v1 Lease that controller-runtime's leader election
+    writes; reference enables it in cmd/training-operator.v1/main.go via
+    LeaderElection/LeaderElectionID). Acquire/renew go through the API
+    server's version-checked update, so two candidates racing for an
+    expired lease resolve to exactly one winner."""
+
+    KIND = "Lease"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder: str = ""
+    lease_duration: float = 15.0
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    transitions: int = 0
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def expired(self, now: float) -> bool:
+        return not self.holder or now >= self.renew_time + self.lease_duration

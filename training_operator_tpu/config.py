@@ -57,6 +57,11 @@ class OperatorConfig:
     init_container_max_tries: int = 100
     # Enable the v2 TrainJob/TrainingRuntime stack alongside v1.
     enable_v2: bool = True
+    # Lease-based leader election (reference --enable-leader-election): a
+    # standby operator stays quiet until the active one's lease expires or
+    # is released. Identity defaults to a per-manager unique string.
+    leader_elect: bool = False
+    leader_identity: Optional[str] = None
 
     def validate(self) -> None:
         unknown = [s for s in self.enabled_schemes if s not in ALL_SCHEMES]

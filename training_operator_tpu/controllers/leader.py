@@ -1,0 +1,154 @@
+"""Leader election for the operator manager.
+
+Parity target: the reference manager runs controller-runtime leader election
+(`cmd/training-operator.v1/main.go` LeaderElection + LeaderElectionID
+"1ca428e5.training-operator.kubeflow.org") so exactly one of N operator
+replicas reconciles while the others stand hot. The TPU-native analogue uses
+a `Lease` object in the in-process API server: acquire and renew are
+version-checked updates, so a race for an expired lease has exactly one
+winner; everyone else observes the conflict and stays (or becomes) standby.
+
+The elector is a pure tick function driven by the cluster clock — no
+threads — which makes failover deterministic under the virtual clock: stop
+renewing (process death) and any standby acquires the moment the lease
+expires.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional
+
+from training_operator_tpu.cluster.apiserver import ConflictError, NotFoundError
+from training_operator_tpu.cluster.objects import Lease
+from training_operator_tpu.api.jobs import ObjectMeta
+
+log = logging.getLogger(__name__)
+
+DEFAULT_LEASE_NAME = "training-operator-tpu"
+
+
+class LeaderElector:
+    """Lease-based leader election against one API server.
+
+    `tick()` acquires / renews / steps down; `is_leader` gates the caller's
+    work loop. Renewal happens every `renew_interval` (default duration/3,
+    the controller-runtime RetryPeriod:RenewDeadline shape); a holder that
+    cannot write within `lease_duration` is considered dead and its lease
+    is taken over with `transitions` incremented.
+    """
+
+    def __init__(
+        self,
+        api,
+        now_fn: Callable[[], float],
+        identity: str,
+        lease_name: str = DEFAULT_LEASE_NAME,
+        namespace: str = "operator-system",
+        lease_duration: float = 15.0,
+        renew_interval: Optional[float] = None,
+    ):
+        self.api = api
+        self.now = now_fn
+        self.identity = identity
+        self.lease_name = lease_name
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.renew_interval = (
+            renew_interval if renew_interval is not None else lease_duration / 3.0
+        )
+        self.is_leader = False
+        self.on_started_leading: List[Callable[[], None]] = []
+        self.on_stopped_leading: List[Callable[[], None]] = []
+
+    # ------------------------------------------------------------------
+
+    def tick(self) -> bool:
+        """Advance the election state machine; returns is_leader."""
+        now = self.now()
+        lease = self.api.try_get(Lease.KIND, self.namespace, self.lease_name)
+        if lease is None:
+            self._try_create(now)
+        elif lease.holder == self.identity:
+            self._renew(lease, now)
+        elif lease.expired(now):
+            self._try_takeover(lease, now)
+        else:
+            self._set_leader(False)
+        return self.is_leader
+
+    def release(self) -> None:
+        """Graceful shutdown: drop the lease so a standby takes over
+        immediately instead of waiting out the duration (the reference's
+        ReleaseOnCancel)."""
+        if not self.is_leader:
+            return
+        try:
+            lease = self.api.get(Lease.KIND, self.namespace, self.lease_name)
+            if lease.holder == self.identity:
+                lease.holder = ""
+                lease.renew_time = -self.lease_duration
+                self.api.update(lease)
+        except (NotFoundError, ConflictError):
+            pass
+        self._set_leader(False)
+
+    # ------------------------------------------------------------------
+
+    def _try_create(self, now: float) -> None:
+        lease = Lease(
+            metadata=ObjectMeta(name=self.lease_name, namespace=self.namespace),
+            holder=self.identity,
+            lease_duration=self.lease_duration,
+            acquire_time=now,
+            renew_time=now,
+            transitions=0,
+        )
+        try:
+            self.api.create(lease)
+        except Exception:  # lost the creation race
+            self._set_leader(False)
+            return
+        log.info("leader election: %s acquired new lease", self.identity)
+        self._set_leader(True)
+
+    def _renew(self, lease: Lease, now: float) -> None:
+        # Still the holder. A holder that somehow observes its own lease
+        # expired (e.g. long GC pause under a real clock) must re-acquire
+        # like anyone else — but with version-checked writes the renewal
+        # below either succeeds (nobody took it) or conflicts (step down).
+        if now - lease.renew_time < self.renew_interval:
+            self._set_leader(True)
+            return
+        lease.renew_time = now
+        try:
+            self.api.update(lease)
+            self._set_leader(True)
+        except (ConflictError, NotFoundError):
+            self._set_leader(False)
+
+    def _try_takeover(self, lease: Lease, now: float) -> None:
+        lease.holder = self.identity
+        lease.acquire_time = now
+        lease.renew_time = now
+        lease.transitions += 1
+        try:
+            self.api.update(lease)
+        except (ConflictError, NotFoundError):  # someone else won the race
+            self._set_leader(False)
+            return
+        log.info(
+            "leader election: %s took over expired lease (transition %d)",
+            self.identity, lease.transitions,
+        )
+        self._set_leader(True)
+
+    def _set_leader(self, leader: bool) -> None:
+        if leader == self.is_leader:
+            return
+        self.is_leader = leader
+        for cb in self.on_started_leading if leader else self.on_stopped_leading:
+            try:
+                cb()
+            except Exception:
+                log.exception("leader election callback failed")

@@ -38,6 +38,8 @@ class OperatorManager:
         gang_enabled: bool = False,
         reconciles_per_tick: int = 256,
         namespace: Optional[str] = None,
+        leader_elect: bool = False,
+        identity: Optional[str] = None,
     ):
         self.cluster = cluster
         self.api = cluster.api
@@ -49,6 +51,20 @@ class OperatorManager:
         self.queue = RateLimitingQueue()
         self.controllers: Dict[str, Tuple[object, JobController]] = {}
         self._watch = self.api.watch()
+        # Leader election (reference --enable-leader-election): a standby
+        # manager keeps its watch/queue quiet until it wins the lease, then
+        # resyncs every job — expectations start empty and existing pods are
+        # re-owned through the claim path, exactly the restart story.
+        self.elector = None
+        if leader_elect:
+            from training_operator_tpu.controllers.leader import LeaderElector
+
+            self.elector = LeaderElector(
+                self.api,
+                cluster.clock.now,
+                identity or f"operator-{id(self):x}",
+            )
+            self.elector.on_started_leading.append(self._resync_all)
         cluster.add_ticker(self.tick)
 
     # ------------------------------------------------------------------
@@ -68,6 +84,8 @@ class OperatorManager:
         self.api.unwatch(self._watch)
         for kind in self.controllers:
             self.api.unregister_admission(kind, validate_job)
+        if self.elector is not None:
+            self.elector.release()
 
     def register(self, controller) -> None:
         kind = controller.kind
@@ -116,7 +134,19 @@ class OperatorManager:
 
     # ------------------------------------------------------------------
 
+    def _resync_all(self) -> None:
+        """Enqueue every in-scope job of every registered kind (the informer
+        initial-list a newly elected leader needs)."""
+        for kind in self.controllers:
+            for job in self.api.list(kind, self.namespace):
+                self.queue.add(self._key(kind, job.namespace, job.name))
+
     def tick(self) -> None:
+        if self.elector is not None and not self.elector.tick():
+            # Standby: discard events — the resync on winning re-lists
+            # everything, so nothing observed here is load-bearing.
+            self._watch.drain()
+            return
         for ev in self._watch.drain():
             self._handle_event(ev)
         for key in self.queue.drain(limit=self.reconciles_per_tick):

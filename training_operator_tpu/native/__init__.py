@@ -120,6 +120,13 @@ def gather_rows(
     idx = np.ascontiguousarray(idx, dtype=np.int64)
     if out is None:
         out = np.empty((len(idx), rows.shape[1]), dtype=np.int32)
+    elif out.shape != (len(idx), rows.shape[1]) or out.dtype != np.int32:
+        # The C ABI takes no output capacity — a short buffer would be
+        # silent out-of-bounds heap writes, so shape is checked here.
+        raise ValueError(
+            f"out must be int32 {(len(idx), rows.shape[1])}, "
+            f"got {out.dtype} {out.shape}"
+        )
     rc = lib.tod_gather_rows(
         rows, rows.shape[0], rows.shape[1], idx, len(idx), out,
         threads or default_threads(),

@@ -93,6 +93,12 @@ struct TodPrefetcher {
   std::vector<int64_t> req_idx;
   int32_t* req_out = nullptr;
   bool has_req = false;
+  // True from submit until the result is consumed by wait — this is what
+  // distinguishes "worker is mid-gather" (has_req already false, result
+  // not yet posted) from "nothing submitted". Without it, a wait() landing
+  // in that window reads as a protocol error and the caller may free the
+  // staging buffer while the worker is still writing into it.
+  bool in_flight = false;
   // Result slot (guarded by mu).
   bool has_result = false;
   int result_rc = 0;
@@ -137,10 +143,11 @@ int tod_prefetcher_submit(void* handle, const int64_t* idx, int64_t n_idx,
   auto* p = static_cast<TodPrefetcher*>(handle);
   if (p == nullptr || idx == nullptr || out == nullptr || n_idx < 0) return -1;
   std::lock_guard<std::mutex> lk(p->mu);
-  if (p->has_req || p->has_result) return -2;
+  if (p->in_flight) return -2;
   p->req_idx.assign(idx, idx + n_idx);
   p->req_out = out;
   p->has_req = true;
+  p->in_flight = true;
   p->cv.notify_all();
   return 0;
 }
@@ -151,9 +158,10 @@ int tod_prefetcher_wait(void* handle) {
   auto* p = static_cast<TodPrefetcher*>(handle);
   if (p == nullptr) return -1;
   std::unique_lock<std::mutex> lk(p->mu);
-  if (!p->has_req && !p->has_result) return -2;
+  if (!p->in_flight) return -2;
   p->cv.wait(lk, [&] { return p->has_result; });
   p->has_result = false;
+  p->in_flight = false;
   return p->result_rc;
 }
 

@@ -337,6 +337,16 @@ def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_with_lse(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    causal: bool = True, block_q: int = 512, block_k: int = 1024,
+    interpret: bool = False,
+    bwd_block_q: Optional[int] = None, bwd_block_k: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    out, lse, _ = _fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return out, lse
+
+
 def flash_attention_with_lse(
     q: jax.Array, k: jax.Array, v: jax.Array,
     causal: bool = True, block_q: int = 512, block_k: int = 1024,
@@ -348,9 +358,16 @@ def flash_attention_with_lse(
     custom_vjp's backward needs exactly (q, k, v, out, lse), all of which
     are then visible tensors a `jax.checkpoint` naming policy can save —
     which lets selective remat skip re-running this kernel in the backward
-    pass (an opaque residual could never be offered to the policy)."""
-    out, lse, _ = _fwd_impl(q, k, v, causal, block_q, block_k, interpret)
-    return out, lse
+    pass (an opaque residual could never be offered to the policy).
+
+    The returned lse is a read-only STATISTIC: the backward drops its
+    cotangent, so it is stop_gradient'ed here — differentiating a loss
+    term built from it fails visibly (zero gradient by construction)
+    rather than silently."""
+    out, lse = _flash_with_lse(
+        q, k, v, causal, block_q, block_k, interpret, bwd_block_q, bwd_block_k
+    )
+    return out, jax.lax.stop_gradient(lse)
 
 
 def flash_attention(
@@ -366,7 +383,7 @@ def flash_attention(
     own block sizes (default: the forward's) — their working set per grid
     step is ~3x the forward's (q, do, and the ds tile), so the sweep
     optimum differs."""
-    return flash_attention_with_lse(
+    return _flash_with_lse(
         q, k, v, causal, block_q, block_k, interpret, bwd_block_q, bwd_block_k
     )[0]
 
@@ -415,7 +432,7 @@ def _bwd(causal, block_q, block_k, interpret, bwd_block_q, bwd_block_k, res, g):
     )
 
 
-flash_attention_with_lse.defvjp(_fwd, _bwd)
+_flash_with_lse.defvjp(_fwd, _bwd)
 
 
 def flash_available() -> bool:
