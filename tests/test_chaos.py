@@ -1,0 +1,113 @@
+"""Fault injection: jobs must converge under sustained random pod kills.
+
+This is the substrate-level chaos tier the reference lacks — its recovery
+machinery (ExitCode triage at common/pod.go:350-374, backoff sums at
+core/job.go:95, restart policies) is exercised here under a seeded random
+failure schedule instead of one hand-set phase per test.
+"""
+
+import training_operator_tpu.api.common as capi
+from training_operator_tpu.api.common import (
+    Container,
+    JobConditionType,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RestartPolicy,
+)
+from training_operator_tpu.api.jobs import JAXJob, ObjectMeta
+from training_operator_tpu.cluster.chaos import ChaosMonkey
+from training_operator_tpu.cluster.inventory import make_cpu_pool
+from training_operator_tpu.cluster.runtime import (
+    ANNOTATION_SIM_DURATION,
+    Cluster,
+    DefaultScheduler,
+    SimKubelet,
+    VirtualClock,
+)
+from training_operator_tpu.controllers.jax import JAXController
+from training_operator_tpu.controllers.manager import OperatorManager
+
+
+def make_env(nodes=8):
+    cluster = Cluster(VirtualClock())
+    cluster.add_nodes(make_cpu_pool(nodes))
+    DefaultScheduler(cluster)
+    kubelet = SimKubelet(cluster)
+    mgr = OperatorManager(cluster)
+    mgr.register(JAXController(cluster.api))
+    return cluster, kubelet, mgr
+
+
+def make_job(name, workers=2, duration="20"):
+    tmpl = PodTemplateSpec(
+        containers=[Container(name="jax", image="img", resources={"cpu": 1.0})]
+    )
+    tmpl.annotations[ANNOTATION_SIM_DURATION] = duration
+    return JAXJob(
+        metadata=ObjectMeta(name=name),
+        replica_specs={
+            "Worker": ReplicaSpec(
+                replicas=workers,
+                template=tmpl,
+                restart_policy=RestartPolicy.EXIT_CODE,
+            )
+        },
+    )
+
+
+def succeeded(cluster, name):
+    job = cluster.api.get("JAXJob", "default", name)
+    return capi.has_condition(job.status, JobConditionType.SUCCEEDED)
+
+
+class TestChaos:
+    def test_jobs_converge_under_random_kills(self):
+        """Six SIGKILLs (exit 137 — retryable under the >= 128 rule) across
+        three 2-worker jobs: every kill must be triaged as a restart (pod
+        deleted + recreated by the engine), and every job must still reach
+        Succeeded."""
+        cluster, kubelet, mgr = make_env()
+        chaos = ChaosMonkey(cluster, kubelet, seed=7, interval=4.0, budget=6)
+        for i in range(3):
+            mgr.submit(make_job(f"chaos-{i}"))
+
+        assert cluster.run_until(
+            lambda: all(succeeded(cluster, f"chaos-{i}") for i in range(3)),
+            timeout=600,
+        ), [
+            (j, cluster.api.get("JAXJob", "default", j).status.conditions[-1])
+            for j in (f"chaos-{i}" for i in range(3))
+        ]
+        # The budget was actually spent on running pods.
+        assert len(chaos.kills) == 6, chaos.kills
+        # Terminal state: every worker finished despite the kills.
+        for i in range(3):
+            st = cluster.api.get("JAXJob", "default", f"chaos-{i}").status
+            assert st.replica_statuses["Worker"].succeeded == 2
+
+    def test_same_seed_same_kill_sequence(self):
+        """Chaos is deterministic: identical seeds replay identical kill
+        schedules (name AND time), so a failing chaos run is reproducible."""
+        seqs = []
+        for _ in range(2):
+            cluster, kubelet, mgr = make_env()
+            chaos = ChaosMonkey(cluster, kubelet, seed=3, interval=3.0, budget=4)
+            mgr.submit(make_job("det", workers=3, duration="60"))
+            cluster.run_until(lambda: len(chaos.kills) >= 4, timeout=300)
+            seqs.append(list(chaos.kills))
+        assert seqs[0] == seqs[1]
+        assert len(seqs[0]) == 4
+
+    def test_permanent_exit_code_fails_job(self):
+        """A non-retryable exit (1-127) under ExitCode policy must FAIL the
+        job — chaos with exit_code=1 proves the triage branch."""
+        cluster, kubelet, mgr = make_env()
+        ChaosMonkey(cluster, kubelet, seed=1, interval=3.0, budget=1, exit_code=1)
+        mgr.submit(make_job("perm", duration="30"))
+        assert cluster.run_until(
+            lambda: capi.has_condition(
+                cluster.api.get("JAXJob", "default", "perm").status,
+                JobConditionType.FAILED,
+            ),
+            timeout=120,
+        )

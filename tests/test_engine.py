@@ -653,3 +653,29 @@ class TestLeaderElection:
         lease = cluster.api.get("Lease", "operator-system",
                                 "training-operator-tpu")
         assert lease.transitions == 0
+
+    def test_rewin_clears_stale_expectations(self):
+        """A manager that loses leadership discards watch events; any
+        expectation raised in its previous term references echoes that will
+        never arrive. Re-winning must clear them or resync'd jobs gate on
+        satisfied_expectations forever."""
+        cluster = self._env()
+        a = self._manager(cluster, "op-a")
+        cluster.run_for(1)
+        assert a.elector.is_leader
+        _, jc = a.controllers["JAXJob"]
+        jc.expectations.expect_creations("stale-key", 2)
+
+        # An intruder steals the lease (valid) -> a steps down.
+        lease = cluster.api.get("Lease", "operator-system",
+                                "training-operator-tpu")
+        lease.holder = "intruder"
+        lease.renew_time = cluster.clock.now()
+        cluster.api.update(lease)
+        assert cluster.run_until(lambda: not a.elector.is_leader, timeout=10)
+        assert not jc.expectations.satisfied_expectations("stale-key")
+
+        # The intruder dies (stops renewing) -> a re-wins -> expectations
+        # from the old term are gone.
+        assert cluster.run_until(lambda: a.elector.is_leader, timeout=60)
+        assert jc.expectations.satisfied_expectations("stale-key")

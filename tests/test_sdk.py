@@ -101,6 +101,14 @@ class TestTrainingClient:
         assert names == ["p1-worker-0", "p1-worker-1"]
         masters = client.get_job_pod_names("p1", is_master=True)
         assert masters == ["p1-worker-0"]  # worker-0 = coordinator
+        # Pod OBJECTS with replica-type/index filters (reference
+        # get_job_pods, training_client.py:982).
+        pods = client.get_job_pods("p1", replica_type="Worker")
+        assert [p.name for p in pods] == names
+        assert all(p.status.phase.value == "Running" for p in pods)
+        one = client.get_job_pods("p1", replica_index=1)
+        assert [p.name for p in one] == ["p1-worker-1"]
+        assert client.get_job_pods("p1", replica_type="Master") == []
         logs = client.get_job_logs("p1")
         assert set(logs) == {"p1-worker-0", "p1-worker-1"}
         # Per-pod content: each pod's log names ITS container start, not a

@@ -598,3 +598,50 @@ class TestPrefetch:
         assert list(prefetch(iter([]), size=4)) == []
         assert list(prefetch(iter([1, 2]), size=8)) == [1, 2]
         assert list(prefetch(iter([1, 2, 3]), size=1)) == [1, 2, 3]
+
+
+class TestVisionFamily:
+    """The conv/vision model family (reference's MNIST-class examples as a
+    first-class trainer payload, trainer/vision.py)."""
+
+    def _setup(self, mesh=None):
+        import optax
+
+        from training_operator_tpu.trainer.vision import (
+            VisionConfig,
+            init_vision_params,
+            make_vision_train_step,
+            synthetic_mnist,
+            vision_param_shardings,
+        )
+
+        config = VisionConfig(image_size=16, channels=(8, 16), dense=32)
+        params = init_vision_params(config, jax.random.PRNGKey(0))
+        opt = optax.sgd(0.1, momentum=0.9)
+        if mesh is not None:
+            params = jax.device_put(params, vision_param_shardings(config, mesh))
+        opt_state = opt.init(params)
+        step = make_vision_train_step(config, opt, mesh)
+        batch = synthetic_mnist(jax.random.PRNGKey(1), 64, config)
+        return config, params, opt_state, step, batch
+
+    def test_learns_synthetic_digits(self):
+        _, params, opt_state, step, batch = self._setup()
+        acc = None
+        for _ in range(40):
+            params, opt_state, m = step(params, opt_state, batch)
+            acc = float(m["accuracy"])
+        assert acc > 0.9, acc
+        assert np.isfinite(float(m["loss"]))
+
+    def test_data_parallel_matches_single_device(self):
+        from training_operator_tpu.trainer.vision import vision_loss_fn
+
+        config, params, opt_state, step, batch = self._setup()
+        want = float(vision_loss_fn(params, batch, config, None))
+        mesh = cpu_mesh(data=2, fsdp=2)
+        config2, params2, opt_state2, step2, _ = self._setup(mesh)
+        got = float(vision_loss_fn(params2, batch, config2, mesh))
+        assert abs(got - want) < 1e-2, (got, want)
+        params2, opt_state2, m = step2(params2, opt_state2, batch)
+        assert np.isfinite(float(m["loss"]))

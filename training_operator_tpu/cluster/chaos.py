@@ -1,0 +1,89 @@
+"""Fault injection for the zero-hardware substrate.
+
+The reference has no fault-injection tooling (SURVEY §5: failures are
+simulated in tests by hand-setting pod phases); its recovery machinery —
+exit-code triage, backoff limits, restart policies, gang re-admission —
+is therefore only ever exercised one hand-written failure at a time. This
+ChaosMonkey drives the same machinery under sustained random failure:
+deterministic (seeded), budgeted, and virtual-clock friendly, so a test
+can assert "every job converges despite N random kills" and replay the
+exact kill sequence on failure.
+
+Kills go through SimKubelet.complete_pod with a configurable exit code —
+the same path a real container death takes — so pod restart policy,
+engine triage (retryable >= 128 vs permanent), backoff counting, and
+expectations all see an ordinary failure, not a test backdoor.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Tuple
+
+from training_operator_tpu.cluster.objects import PodPhase
+from training_operator_tpu.cluster.runtime import Cluster, SimKubelet
+
+
+class ChaosMonkey:
+    """Kills a random running pod every `interval` until `budget` is spent.
+
+    `selector` (label dict) and `namespace` scope the blast radius;
+    `exit_code` defaults to 137 (SIGKILL — retryable under the reference's
+    >= 128 rule, train_util.go:14). `kills` records (time, pod name) for
+    assertions and replay."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        kubelet: SimKubelet,
+        seed: int = 0,
+        interval: float = 5.0,
+        budget: int = 10,
+        exit_code: int = 137,
+        selector: Optional[Dict[str, str]] = None,
+        namespace: Optional[str] = None,
+    ):
+        self.cluster = cluster
+        self.kubelet = kubelet
+        self.rng = random.Random(seed)
+        self.interval = interval
+        self.budget = budget
+        self.exit_code = exit_code
+        self.selector = selector
+        self.namespace = namespace
+        self.kills: List[Tuple[float, str]] = []
+        self._armed = True
+        self._schedule_next()
+
+    def stop(self) -> None:
+        """Spend the remaining budget; in-flight timers become no-ops."""
+        self._armed = False
+
+    # ------------------------------------------------------------------
+
+    def _schedule_next(self) -> None:
+        if self._armed and len(self.kills) < self.budget:
+            self.cluster.schedule_after(self.interval, self._strike)
+
+    def _strike(self) -> None:
+        if not self._armed or len(self.kills) >= self.budget:
+            return
+        victims = sorted(
+            (
+                p
+                for p in self.cluster.api.list(
+                    "Pod", self.namespace, self.selector
+                )
+                if p.status.phase == PodPhase.RUNNING
+            ),
+            key=lambda p: (p.namespace, p.name),
+        )
+        if victims:
+            pod = self.rng.choice(victims)
+            now = self.cluster.clock.now()
+            if self.kubelet.complete_pod(
+                pod.namespace, pod.name, exit_code=self.exit_code,
+                log=f"chaos: killed at t={now:.1f}",
+            ):
+                self.kills.append((now, pod.name))
+        self._schedule_next()

@@ -19,7 +19,11 @@ from __future__ import annotations
 import logging
 from typing import Callable, List, Optional
 
-from training_operator_tpu.cluster.apiserver import ConflictError, NotFoundError
+from training_operator_tpu.cluster.apiserver import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+)
 from training_operator_tpu.cluster.objects import Lease
 from training_operator_tpu.api.jobs import ObjectMeta
 
@@ -106,9 +110,11 @@ class LeaderElector:
         )
         try:
             self.api.create(lease)
-        except Exception:  # lost the creation race
+        except AlreadyExistsError:  # lost the creation race
             self._set_leader(False)
             return
+        # Anything else propagates: swallowing an unexpected create failure
+        # here would turn the whole candidate fleet into silent standbys.
         log.info("leader election: %s acquired new lease", self.identity)
         self._set_leader(True)
 

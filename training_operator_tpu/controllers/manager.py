@@ -57,13 +57,22 @@ class OperatorManager:
         # re-owned through the claim path, exactly the restart story.
         self.elector = None
         if leader_elect:
+            import os
+            import uuid
+
             from training_operator_tpu.controllers.leader import LeaderElector
 
             self.elector = LeaderElector(
                 self.api,
                 cluster.clock.now,
-                identity or f"operator-{id(self):x}",
+                # Unique ACROSS processes (id() is only per-process unique,
+                # and a collision means silent split-brain).
+                identity or f"operator-{os.getpid()}-{uuid.uuid4().hex[:8]}",
             )
+            # Order matters: expectations from a previous term reference
+            # events the standby discarded — clear them before the resync
+            # enqueues everything.
+            self.elector.on_started_leading.append(self._clear_expectations)
             self.elector.on_started_leading.append(self._resync_all)
         cluster.add_ticker(self.tick)
 
@@ -133,6 +142,10 @@ class OperatorManager:
                     self.api.try_delete(kind, obj.namespace, obj.name)
 
     # ------------------------------------------------------------------
+
+    def _clear_expectations(self) -> None:
+        for _, jc in self.controllers.values():
+            jc.expectations.clear()
 
     def _resync_all(self) -> None:
         """Enqueue every in-scope job of every registered kind (the informer

@@ -78,5 +78,12 @@ class ControllerExpectations:
             return True
         return False
 
+    def clear(self) -> None:
+        """Drop every expectation — for a controller whose watch stream had
+        a gap (e.g. a standby period between two leadership terms): stale
+        expectations would otherwise gate reconciles on events that were
+        discarded and will never arrive."""
+        self._store.clear()
+
     def delete_expectations(self, key: str) -> None:
         self._store.pop(key, None)

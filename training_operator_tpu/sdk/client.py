@@ -190,13 +190,33 @@ class TrainingClient:
 
     # -- pods / logs -------------------------------------------------------
 
-    def get_job_pod_names(self, name: str, namespace: Optional[str] = None,
-                          is_master: bool = False) -> List[str]:
+    def get_job_pods(
+        self,
+        name: str,
+        namespace: Optional[str] = None,
+        is_master: bool = False,
+        replica_type: Optional[str] = None,
+        replica_index: Optional[int] = None,
+    ) -> List[Any]:
+        """Pod objects for a job, optionally filtered by role / replica type
+        / replica index (reference training_client.py:982 get_job_pods with
+        its label-selector composition)."""
         ns = namespace or self.namespace
         sel = {capi.JOB_NAME_LABEL: name}
         if is_master:
             sel[capi.JOB_ROLE_LABEL] = "master"
-        return sorted(p.name for p in self.api.list("Pod", ns, sel))
+        if replica_type:
+            # Labels carry the replica type verbatim ("Worker", "Master" —
+            # see engine/core.py replica_labels), unlike the reference's
+            # lowercased form.
+            sel[capi.REPLICA_TYPE_LABEL] = str(replica_type)
+        if replica_index is not None:
+            sel[capi.REPLICA_INDEX_LABEL] = str(replica_index)
+        return sorted(self.api.list("Pod", ns, sel), key=lambda p: p.name)
+
+    def get_job_pod_names(self, name: str, namespace: Optional[str] = None,
+                          is_master: bool = False) -> List[str]:
+        return [p.name for p in self.get_job_pods(name, namespace, is_master)]
 
     def get_job_logs(
         self,

@@ -330,6 +330,47 @@ def bench_attention(
     return out
 
 
+def bench_dataloader(
+    rows: int = 65536, row_len: int = 2049, batch: int = 512, iters: int = 20
+) -> Dict[str, Any]:
+    """Host data-path throughput: shuffled row gather out of an in-memory
+    token arena, native C++ threaded path vs the numpy fancy-index path
+    (identical output — tested in tests/test_native.py). GB/s is what
+    matters: the gather must outrun the device step to stay hidden."""
+    import numpy as np
+
+    from training_operator_tpu import native
+
+    rng = np.random.RandomState(0)
+    # dtype= on randint avoids a transient int64 arena (2x peak memory).
+    arena = rng.randint(0, 32768, size=(rows, row_len), dtype=np.int32)
+    idx = rng.randint(0, rows, size=(iters, batch), dtype=np.int64)
+    bytes_per_iter = batch * row_len * 4
+
+    t = time.perf_counter()
+    for i in range(iters):
+        _ = arena[idx[i]]
+    numpy_s = (time.perf_counter() - t) / iters
+
+    out: Dict[str, Any] = {
+        "batch_mb": round(bytes_per_iter / 1e6, 1),
+        "numpy_gather_gbps": round(bytes_per_iter / numpy_s / 1e9, 2),
+        "native_available": native.available(),
+    }
+    if native.available():
+        buf = np.empty((batch, row_len), dtype=np.int32)
+        native.gather_rows(arena, idx[0], out=buf)  # warm the .so
+        t = time.perf_counter()
+        for i in range(iters):
+            native.gather_rows(arena, idx[i], out=buf)
+        native_s = (time.perf_counter() - t) / iters
+        out["native_gather_gbps"] = round(bytes_per_iter / native_s / 1e9, 2)
+        out["native_speedup"] = round(numpy_s / native_s, 2)
+    else:  # pragma: no cover - toolchain-dependent
+        out["native_error"] = native.build_error()
+    return out
+
+
 def run_trainer_bench(steps: int = 10) -> Dict[str, Any]:
     """Full trainer benchmark on the default backend; never raises — a
     broken accelerator degrades to an error report so the scheduler metric
@@ -340,6 +381,7 @@ def run_trainer_bench(steps: int = 10) -> Dict[str, Any]:
         config, batch, seq = flagship_config(platform)
         out["train_step"] = bench_train_step(config, batch, seq, steps=steps)
         out["attention"] = bench_attention()
+        out["dataloader"] = bench_dataloader()
         if platform == "tpu":
             # Long-context point: seq 8192 is where flash's O(S) memory is
             # decisive — the XLA path's [S, S] scores may not fit at all.

@@ -1,0 +1,170 @@
+"""Vision model family: a small conv classifier, TPU-first.
+
+The reference's canonical example workload across every framework is an
+MNIST-class CNN (examples/pytorch/mnist, examples/tensorflow/mnist, the
+paddle and xgboost equivalents) launched as user containers. This module is
+that family as a first-class trainer payload: pure pytree params, bf16
+compute with float32 loss, `lax.conv_general_dilated` on NHWC (the TPU-
+preferred layout), data-parallel batch sharding over the mesh's
+(data, fsdp) axes, and a jitted SGD/momentum step — small enough for the
+CPU test mesh, real enough to bench on a chip.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from training_operator_tpu.trainer.mesh import BATCH_AXES
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 28
+    in_channels: int = 1
+    n_classes: int = 10
+    # Two conv stages then a dense head (the classic MNIST shape).
+    channels: Tuple[int, int] = (32, 64)
+    dense: int = 128
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def flat_dim(self) -> int:
+        # Two stride-2 pools halve the spatial dims twice.
+        side = self.image_size // 4
+        return side * side * self.channels[1]
+
+
+def init_vision_params(config: VisionConfig, key: jax.Array) -> Dict[str, Any]:
+    c = config
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+
+    def he(key, shape):
+        fan_in = 1
+        for d in shape[:-1]:
+            fan_in *= d
+        return jax.random.normal(key, shape, jnp.float32) * (2.0 / fan_in) ** 0.5
+
+    return {
+        # HWIO conv kernels (matches conv_general_dilated's rhs spec below).
+        "conv1": he(k1, (3, 3, c.in_channels, c.channels[0])),
+        "b1": jnp.zeros((c.channels[0],), jnp.float32),
+        "conv2": he(k2, (3, 3, c.channels[0], c.channels[1])),
+        "b2": jnp.zeros((c.channels[1],), jnp.float32),
+        "w_dense": he(k3, (c.flat_dim, c.dense)),
+        "b_dense": jnp.zeros((c.dense,), jnp.float32),
+        "w_out": he(k4, (c.dense, c.n_classes)),
+        "b_out": jnp.zeros((c.n_classes,), jnp.float32),
+    }
+
+
+def vision_param_shardings(config: VisionConfig, mesh: Mesh):
+    """Conv/dense weights are tiny relative to activations — replicate them
+    (the standard data-parallel layout); the batch carries the sharding.
+    eval_shape: only the tree STRUCTURE is needed, no RNG/allocation."""
+    shapes = jax.eval_shape(
+        lambda k: init_vision_params(config, k), jax.random.PRNGKey(0)
+    )
+    return jax.tree.map(lambda _: NamedSharding(mesh, P()), shapes)
+
+
+def _conv(x: jax.Array, w: jax.Array, b: jax.Array) -> jax.Array:
+    y = jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype),
+        window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return y + b.astype(y.dtype)
+
+
+def _pool2(x: jax.Array) -> jax.Array:
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+
+
+def vision_forward(
+    params: Dict[str, Any],
+    images: jax.Array,
+    config: VisionConfig,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """images [B, H, W, C] -> logits [B, n_classes] float32."""
+    c = config
+    x = images.astype(c.dtype)
+    if mesh is not None:
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(BATCH_AXES, None, None, None))
+        )
+    x = _pool2(jax.nn.relu(_conv(x, params["conv1"], params["b1"])))
+    x = _pool2(jax.nn.relu(_conv(x, params["conv2"], params["b2"])))
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["w_dense"].astype(c.dtype) + params["b_dense"].astype(c.dtype))
+    return (x @ params["w_out"].astype(jnp.float32)
+            + params["b_out"]).astype(jnp.float32)
+
+
+def vision_loss_fn(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    config: VisionConfig,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Mean softmax cross-entropy; `batch` = {images, labels}."""
+    logits = vision_forward(params, batch["images"], config, mesh)
+    onehot = jax.nn.one_hot(batch["labels"], config.n_classes, dtype=jnp.float32)
+    return optax.softmax_cross_entropy(logits, onehot).mean()
+
+
+def make_vision_train_step(
+    config: VisionConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+):
+    """Jitted (params, opt_state, batch) -> (params, opt_state, metrics)."""
+
+    def step(params, opt_state, batch):
+        def loss_and_acc(p):
+            logits = vision_forward(p, batch["images"], config, mesh)
+            onehot = jax.nn.one_hot(
+                batch["labels"], config.n_classes, dtype=jnp.float32
+            )
+            loss = optax.softmax_cross_entropy(logits, onehot).mean()
+            acc = (logits.argmax(-1) == batch["labels"]).mean()
+            return loss, acc
+
+        (loss, acc), grads = jax.value_and_grad(loss_and_acc, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss, "accuracy": acc}
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def synthetic_mnist(
+    key: jax.Array, n: int, config: VisionConfig
+) -> Dict[str, jax.Array]:
+    """Separable synthetic digits: class k gets a bright kxk-positioned
+    patch, so a working model must reach high accuracy quickly — the test
+    signal the reference's real-MNIST examples provide, without a dataset
+    download (zero-egress environments)."""
+    c = config
+    k_lbl, k_noise = jax.random.split(key)
+    labels = jax.random.randint(k_lbl, (n,), 0, c.n_classes)
+    noise = 0.1 * jax.random.normal(
+        k_noise, (n, c.image_size, c.image_size, c.in_channels), jnp.float32
+    )
+    side = max(1, (c.image_size - 8) // max(1, c.n_classes - 1))
+    pos = labels * side
+    rows = jnp.arange(c.image_size)[None, :, None, None]
+    cols = jnp.arange(c.image_size)[None, None, :, None]
+    patch = (
+        (rows >= pos[:, None, None, None]) & (rows < pos[:, None, None, None] + 6)
+        & (cols >= pos[:, None, None, None]) & (cols < pos[:, None, None, None] + 6)
+    )
+    return {"images": noise + patch.astype(jnp.float32), "labels": labels}
