@@ -1,0 +1,38 @@
+# Developer entry points (the reference drives everything through its
+# Makefile: test/envtest/codegen; this framework is pure Python + on-demand
+# C++, so the surface is smaller but the verbs match).
+
+PY ?= python
+CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: test test-fast bench bench-quick dryrun operator-demo native clean
+
+test:            ## full suite (no hardware needed; ~10 min)
+	$(PY) -m pytest tests/ -q
+
+test-fast:       ## everything but the slow trainer-numerics tier
+	$(PY) -m pytest tests/ -q --ignore=tests/test_trainer.py
+
+bench:           ## headline benchmark (runs the trainer block on TPU if present)
+	$(PY) bench.py
+
+bench-quick:     ## 100-job smoke benchmark
+	$(PY) bench.py --quick
+
+dryrun:          ## multi-chip sharding gates on 8 virtual CPU devices
+	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; fn, a = g.entry(); \
+	import jax; print('entry loss:', float(jax.jit(fn)(*a))); \
+	g.dryrun_multichip(8); print('DRYRUN OK')"
+
+operator-demo:   ## the operator process end-to-end on the example workload
+	$(PY) -m training_operator_tpu \
+	  --cluster examples/process/cluster.json \
+	  --workload examples/process/workload.json \
+	  --virtual-clock
+
+native:          ## force-(re)build the C++ data-path core
+	$(PY) -c "from training_operator_tpu import native; \
+	print(native.available() or native.build_error())"
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

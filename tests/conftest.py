@@ -13,13 +13,11 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # The axon TPU plugin (injected via a PYTHONPATH site dir) imports jax at
 # INTERPRETER STARTUP with the ambient JAX_PLATFORMS=axon already captured,
 # and backend init then BLOCKS whenever its tunnel is unreachable. The env
-# write above is too late for this process — force the already-imported
-# config to CPU programmatically (safe: no backend has initialized yet at
-# conftest time), and scrub the site dir from the path/env so pytest-spawned
-# subprocesses (the real-process e2e tier) start clean.
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+# write above is too late for this process — __graft_entry__'s import-time
+# _honor_cpu_platform_request() forces the already-imported config back to
+# CPU (no backend has initialized yet at conftest time). Scrub the site dir
+# from the path/env so pytest-spawned subprocesses (the real-process e2e
+# tier) start clean.
 sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
 os.environ["PYTHONPATH"] = os.pathsep.join(
     p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)

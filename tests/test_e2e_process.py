@@ -212,3 +212,143 @@ def test_bootstrap_env_drives_real_jax_distributed(tmp_path):
     logs = TrainingClient(cluster).get_job_logs("jax-e2e")
     assert "worker 0: ok" in logs["jax-e2e-worker-0"]
     assert "worker 1: ok" in logs["jax-e2e-worker-1"]
+
+
+# The torch analogue: only the operator-injected MASTER_ADDR/MASTER_PORT/
+# RANK/WORLD_SIZE drive a REAL torch.distributed gloo group (the bootstrap
+# contract of the reference's primary e2e, test_e2e_pytorchjob.py:50).
+TORCH_WORKER_PROGRAM = r"""
+import os
+import torch
+import torch.distributed as dist
+
+rank = int(os.environ["RANK"])
+world = int(os.environ["WORLD_SIZE"])
+dist.init_process_group("gloo")  # env:// rendezvous from the injected env
+assert dist.get_rank() == rank and dist.get_world_size() == world
+
+# Collective proof: all-reduce of one-hot rank vectors = all-ones.
+t = torch.zeros(world)
+t[rank] = 1.0
+dist.all_reduce(t)
+assert torch.allclose(t, torch.ones(world)), t
+
+# A few data-parallel SGD steps on rank-disjoint data shards with manual
+# gradient all-reduce (what DDP does under the hood).
+torch.manual_seed(rank)
+x = torch.randn(8, 4)
+y = x @ torch.arange(4.0).reshape(4, 1)
+w = torch.zeros(4, 1, requires_grad=True)
+first = last = None
+for _ in range(20):
+    loss = ((x @ w - y) ** 2).mean()
+    loss.backward()
+    with torch.no_grad():
+        dist.all_reduce(w.grad)
+        w.grad /= world
+        w -= 0.05 * w.grad
+        w.grad.zero_()
+    first = first if first is not None else float(loss)
+    last = float(loss)
+assert last < first, (first, last)
+
+# Weights are identical everywhere (the averaged-gradient invariant).
+ws = [torch.empty_like(w) for _ in range(world)]
+dist.all_gather(ws, w)
+for other in ws:
+    assert torch.allclose(other, w)
+dist.barrier()
+print(f"torch rank {rank}: ok, loss {first:.3f} -> {last:.3f}")
+"""
+
+
+def test_bootstrap_env_drives_real_torch_distributed(tmp_path):
+    import pytest
+
+    pytest.importorskip("torch")
+    from training_operator_tpu.api.jobs import PyTorchJob
+
+    cluster = Cluster(Clock())
+    cluster.add_nodes(make_cpu_pool(2, cpu_per_node=8.0))
+    DefaultScheduler(cluster)
+    kubelet = SimKubelet(cluster)
+    mgr = OperatorManager(cluster, gang_enabled=False)
+    register_all(mgr)
+
+    port = _free_port()
+
+    def tmpl():
+        return PodTemplateSpec(
+            containers=[
+                Container(
+                    name="pytorch", image="trainer", resources={"cpu": 1.0},
+                    ports={"pytorchjob-port": port},
+                )
+            ]
+        )
+
+    mgr.submit(
+        PyTorchJob(
+            metadata=ObjectMeta(name="torch-e2e"),
+            replica_specs={
+                "Master": ReplicaSpec(replicas=1, template=tmpl()),
+                "Worker": ReplicaSpec(replicas=1, template=tmpl()),
+            },
+        )
+    )
+
+    assert cluster.run_until(
+        lambda: sum(
+            p.status.phase == PodPhase.RUNNING for p in cluster.api.list("Pod")
+        ) == 2,
+        timeout=30,
+    )
+    pods = sorted(cluster.api.list("Pod"), key=lambda p: p.name)
+    assert [p.name for p in pods] == ["torch-e2e-master-0", "torch-e2e-worker-0"]
+
+    script = tmp_path / "torch_worker.py"
+    script.write_text(TORCH_WORKER_PROGRAM)
+    procs = []
+    for pod in pods:
+        env = {}
+        for c in pod.spec.containers:
+            env.update(c.env)
+        assert env["MASTER_ADDR"] == "torch-e2e-master-0"
+        assert env["MASTER_PORT"] == str(port)
+        assert env["WORLD_SIZE"] == "2"
+        assert env["RANK"] == ("0" if "master" in pod.name else "1")
+        penv = {
+            "PATH": os.environ.get("PATH", ""),
+            "HOME": os.environ.get("HOME", "/tmp"),
+            **env,
+            # Substrate has no DNS; the master service resolves to loopback
+            # exactly as in the JAX tier above.
+            "MASTER_ADDR": "127.0.0.1",
+            "GLOO_SOCKET_IFNAME": "lo",
+        }
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script)],
+                env=penv,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+
+    outputs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outputs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"torch rank {rank}: ok" in out
+
+    for pod, p, out in zip(pods, procs, outputs):
+        assert kubelet.complete_pod(pod.namespace, pod.name, p.returncode, log=out)
+    assert cluster.run_until(
+        lambda: capi.is_succeeded(
+            cluster.api.get("PyTorchJob", "default", "torch-e2e").status
+        ),
+        timeout=30,
+    )
