@@ -30,7 +30,8 @@ operator-demo:   ## the operator process end-to-end on the example workload
 	  --workload examples/process/workload.json \
 	  --virtual-clock
 
-native:          ## force-(re)build the C++ data-path core
+native:          ## force-rebuild the C++ data-path core (drops the hash cache)
+	rm -f $(HOME)/.cache/training_operator_tpu/dataio-*.so
 	$(PY) -c "from training_operator_tpu import native; \
 	print(native.available() or native.build_error())"
 

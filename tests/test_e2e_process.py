@@ -190,9 +190,17 @@ def test_bootstrap_env_drives_real_jax_distributed(tmp_path):
         )
 
     outputs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=240)
-        outputs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outputs.append(out)
+    finally:
+        # A hung rank must not leak its peers (they'd hold the rendezvous
+        # port for the rest of the run).
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     for i, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert f"worker {i}: ok" in out
@@ -337,9 +345,15 @@ def test_bootstrap_env_drives_real_torch_distributed(tmp_path):
         )
 
     outputs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=240)
-        outputs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outputs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     for rank, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert f"torch rank {rank}: ok" in out
