@@ -31,8 +31,8 @@ operator-demo:   ## the operator process end-to-end on the example workload
 	  --virtual-clock
 
 native:          ## force-rebuild the C++ data-path core (drops the hash cache)
-	rm -f $(HOME)/.cache/training_operator_tpu/dataio-*.so
-	$(PY) -c "from training_operator_tpu import native; \
+	$(PY) -c "from training_operator_tpu import native; import glob, os; \
+	[os.remove(p) for p in glob.glob(str(native._cache_dir() / 'dataio-*.so'))]; \
 	print(native.available() or native.build_error())"
 
 clean:

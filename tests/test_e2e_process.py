@@ -116,6 +116,23 @@ def _free_port() -> int:
     return port
 
 
+def _drain(procs):
+    """communicate() every worker in order; on any timeout/failure kill the
+    stragglers so a hung rank cannot leak peers holding the rendezvous
+    port. Returns each process's combined output."""
+    outputs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outputs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    return outputs
+
+
 def test_bootstrap_env_drives_real_jax_distributed(tmp_path):
     cluster = Cluster(Clock())
     cluster.add_nodes(make_cpu_pool(2, cpu_per_node=8.0))
@@ -189,18 +206,7 @@ def test_bootstrap_env_drives_real_jax_distributed(tmp_path):
             )
         )
 
-    outputs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=240)
-            outputs.append(out)
-    finally:
-        # A hung rank must not leak its peers (they'd hold the rendezvous
-        # port for the rest of the run).
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.communicate()
+    outputs = _drain(procs)
     for i, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert f"worker {i}: ok" in out
@@ -344,16 +350,7 @@ def test_bootstrap_env_drives_real_torch_distributed(tmp_path):
             )
         )
 
-    outputs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=240)
-            outputs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.communicate()
+    outputs = _drain(procs)
     for rank, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert f"torch rank {rank}: ok" in out
@@ -366,3 +363,111 @@ def test_bootstrap_env_drives_real_torch_distributed(tmp_path):
         ),
         timeout=30,
     )
+
+
+def test_v2_trainjob_drives_real_jax_distributed(tmp_path):
+    """The v2 path end-to-end with REAL compute: TrainJob -> runtime plugins
+    -> JAXJob workload -> rendered pods -> real jax.distributed processes ->
+    exit codes -> TrainJob Complete. (The reference's e2e tier covers v1
+    kinds only; its v2 stack stops at envtest integration.)"""
+    from training_operator_tpu.runtime.api import (
+        ClusterTrainingRuntime,
+        MLPolicy,
+        ReplicatedJobTemplate,
+        RuntimeRef,
+        TrainingRuntimeSpec,
+        TrainJob,
+        TrainJobConditionType,
+        TRAINER_NODE,
+    )
+    from training_operator_tpu.runtime.controller import TrainJobManager
+
+    cluster = Cluster(Clock())
+    cluster.add_nodes(make_cpu_pool(2, cpu_per_node=8.0))
+    DefaultScheduler(cluster)
+    kubelet = SimKubelet(cluster)
+    mgr = OperatorManager(cluster, gang_enabled=False)
+    register_all(mgr)
+    v2 = TrainJobManager(cluster)
+
+    v2.submit(
+        ClusterTrainingRuntime(
+            metadata=ObjectMeta(name="cpu-demo", namespace=""),
+            spec=TrainingRuntimeSpec(
+                ml_policy=MLPolicy(num_nodes=2),
+                template=[
+                    ReplicatedJobTemplate(
+                        name=TRAINER_NODE,
+                        replicas=2,
+                        template=PodTemplateSpec(
+                            containers=[
+                                Container(
+                                    name="trainer", image="trainer",
+                                    resources={"cpu": 1.0},
+                                )
+                            ]
+                        ),
+                    )
+                ],
+            ),
+        )
+    )
+    v2.submit(
+        TrainJob(
+            metadata=ObjectMeta(name="v2-e2e"),
+            runtime_ref=RuntimeRef(name="cpu-demo", kind="ClusterTrainingRuntime"),
+        )
+    )
+
+    assert cluster.run_until(
+        lambda: sum(
+            p.status.phase == PodPhase.RUNNING for p in cluster.api.list("Pod")
+        ) == 2,
+        timeout=30,
+    )
+    pods = sorted(cluster.api.list("Pod"), key=lambda p: p.name)
+
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER_PROGRAM)
+    port = _free_port()
+    procs = []
+    for pod in pods:
+        env = {}
+        for c in pod.spec.containers:
+            env.update(c.env)
+        # The v2-built workload carries the complete v1 bootstrap contract.
+        assert env["NUM_PROCESSES"] == "2"
+        assert env["PROCESS_ID"] in ("0", "1")
+        assert "COORDINATOR_ADDRESS" in env and "COORDINATOR_PORT" in env
+        penv = {
+            "PATH": os.environ.get("PATH", ""),
+            "HOME": os.environ.get("HOME", "/tmp"),
+            "PYTHONPATH": REPO_ROOT,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            **env,
+            # No DNS / shared netns in the substrate: service name -> lo,
+            # and the well-known default port -> a free one for this host.
+            "COORDINATOR_ADDRESS": "127.0.0.1",
+            "COORDINATOR_PORT": str(port),
+        }
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script)], env=penv,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+        )
+
+    outputs = _drain(procs)
+    for i, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+
+    for pod, p, out in zip(pods, procs, outputs):
+        assert kubelet.complete_pod(pod.namespace, pod.name, p.returncode, log=out)
+    assert cluster.run_until(
+        lambda: cluster.api.get("TrainJob", "default", "v2-e2e").is_finished(),
+        timeout=30,
+    )
+    tj = cluster.api.get("TrainJob", "default", "v2-e2e")
+    done = tj.condition(TrainJobConditionType.COMPLETE)
+    assert done is not None and done.status
