@@ -90,6 +90,22 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     ap.add_argument("--config", help="OperatorConfig JSON file (see config.py)")
     ap.add_argument(
+        "--role", default="standalone", choices=("standalone", "host", "operator"),
+        help="standalone: full in-process stack (default). "
+             "host: substrate only — API server over HTTP (--serve-port), "
+             "default scheduler, kubelet, gang scheduler; no job controllers. "
+             "operator: job controllers only, against a remote --api-server "
+             "(the reference's real deployment shape: operator pods talking "
+             "to a kube-apiserver; cmd/training-operator.v1/main.go:134-166)",
+    )
+    ap.add_argument("--serve-port", type=int, default=0,
+                    help="host role: HTTP API port (0 = ephemeral; the chosen "
+                         "endpoint is printed as WIRE_API=... on stdout)")
+    ap.add_argument("--serve-bind", default="127.0.0.1",
+                    help="host role: HTTP API bind address")
+    ap.add_argument("--api-server", default=None, metavar="URL",
+                    help="operator role: base URL of the serving host")
+    ap.add_argument(
         "--enable-scheme", action="append", default=None, metavar="SCHEME",
         help=f"enable a job scheme (repeatable); default: all of {ALL_SCHEMES}",
     )
@@ -115,6 +131,8 @@ def parse_args(argv=None) -> argparse.Namespace:
                          "active operator's lease expires or is released)")
     ap.add_argument("--leader-identity", default=None,
                     help="identity written into the lease (default: unique)")
+    ap.add_argument("--leader-lease-seconds", type=float, default=None,
+                    help="lease duration before a dead leader is taken over")
     ap.add_argument("--cluster", help="cluster inventory JSON file")
     ap.add_argument("--workload", help="workload JSON file to submit at start")
     ap.add_argument("--virtual-clock", action="store_true",
@@ -147,6 +165,8 @@ def build_config(args: argparse.Namespace) -> OperatorConfig:
         cfg.leader_elect = args.leader_elect
     if args.leader_identity is not None:
         cfg.leader_identity = args.leader_identity
+    if args.leader_lease_seconds is not None:
+        cfg.leader_lease_duration = args.leader_lease_seconds
     cfg.validate()
     return cfg
 
@@ -206,6 +226,7 @@ def build_stack(cluster: Cluster, cfg: OperatorConfig):
         namespace=cfg.namespace,
         leader_elect=cfg.leader_elect,
         identity=cfg.leader_identity,
+        lease_duration=cfg.leader_lease_duration,
     )
     for scheme in cfg.enabled_schemes:
         mgr.register(SCHEME_CONTROLLERS[scheme](cluster.api))
@@ -310,6 +331,152 @@ def serve_probes(cluster: Cluster, port: int, metrics_token: "str | None" = None
     return server  # ThreadingHTTPServer; caller may .shutdown()/.server_close()
 
 
+def _install_stop() -> threading.Event:
+    """SIGINT/SIGTERM -> stop event (shared by all three roles)."""
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        log.info("signal %s: shutting down", signum)
+        stop.set()
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, on_signal)
+        except ValueError:
+            pass  # non-main thread (tests)
+    return stop
+
+
+def run_host(args, cfg) -> int:
+    """Host role: the substrate process — API server over HTTP, default
+    scheduler, sim kubelet, gang scheduler; admission (defaulting +
+    validation) enforced here so every remote client goes through it, the
+    way kube-apiserver admission does."""
+    from training_operator_tpu.api.defaults import default_job
+    from training_operator_tpu.api.validation import validate_job
+    from training_operator_tpu.cluster.httpapi import ApiHTTPServer
+
+    if args.virtual_clock:
+        raise SystemExit("--role host requires a real clock (remote processes share no virtual time)")
+    if args.workload:
+        raise SystemExit("--workload runs controllers; submit via an operator/SDK instead")
+    cluster = build_cluster(args)
+
+    def admit(job) -> None:
+        default_job(job, now=cluster.clock.now())
+        validate_job(job)
+
+    for kind_cls, _ in JOB_KINDS.values():
+        cluster.api.register_admission(kind_cls.KIND, admit)
+    # v2 admission lives with the API server too (reference webhook.v2 is
+    # apiserver-invoked regardless of which operator replicas exist).
+    from training_operator_tpu.runtime.api import (
+        ClusterTrainingRuntime,
+        TrainingRuntime,
+        TrainJob,
+    )
+    from training_operator_tpu.runtime.webhooks import (
+        validate_training_runtime,
+        validate_trainjob,
+    )
+
+    cluster.api.register_admission(TrainJob.KIND, validate_trainjob)
+    cluster.api.register_admission(TrainingRuntime.KIND, validate_training_runtime)
+    cluster.api.register_admission(ClusterTrainingRuntime.KIND, validate_training_runtime)
+    from training_operator_tpu.runtime.presets import install_presets
+
+    install_presets(cluster.api)
+
+    DefaultScheduler(cluster)
+    SimKubelet(cluster)
+    if cfg.gang_scheduler_name != "none":
+        placer = {
+            "tpu-packer": lambda: TPUPacker(),
+            "baseline": lambda: BaselinePlacer(whole_slice=True),
+            "baseline-firstfit": lambda: BaselinePlacer(whole_slice=False),
+        }[cfg.gang_scheduler_name]()
+        GangScheduler(
+            cluster, placer, prewarm=cfg.gang_scheduler_name == "tpu-packer",
+            resolve_period=cfg.resolve_period,
+            min_solve_interval=cfg.min_solve_interval,
+        )
+    server = ApiHTTPServer(cluster.api, port=args.serve_port, bind=args.serve_bind)
+    # Machine-parsable endpoint announcement (the e2e harness reads this).
+    print(f"WIRE_API={server.url}", flush=True)
+    log.info("host up: api=%s gang=%s", server.url, cfg.gang_scheduler_name)
+    if cfg.health_port:
+        serve_probes(cluster, cfg.health_port, cfg.metrics_token, cfg.health_bind_address)
+
+    stop = _install_stop()
+    deadline = (
+        cluster.clock.now() + args.run_seconds if args.run_seconds is not None else None
+    )
+    try:
+        while not stop.is_set():
+            cluster.step()
+            if deadline is not None and cluster.clock.now() >= deadline:
+                break
+            time.sleep(0.01)
+    finally:
+        server.close()
+    return 0
+
+
+def run_operator(args, cfg) -> int:
+    """Operator role: job controllers + leader election against a remote
+    API server — the reference's operator-pod deployment shape. Two of
+    these processes racing one lease is real HA: kill -9 the leader and
+    the standby converges the same jobs."""
+    from training_operator_tpu.cluster.httpapi import RemoteAPIServer, RemoteRuntime
+
+    if not args.api_server:
+        raise SystemExit("--role operator requires --api-server URL")
+    if args.workload:
+        raise SystemExit("--workload is a standalone-role option; use the SDK remotely")
+    runtime = RemoteRuntime(RemoteAPIServer(args.api_server))
+    mgr = OperatorManager(
+        runtime,
+        gang_enabled=cfg.gang_scheduler_name != "none",
+        reconciles_per_tick=cfg.controller_threads,
+        namespace=cfg.namespace,
+        leader_elect=cfg.leader_elect,
+        identity=cfg.leader_identity,
+        lease_duration=cfg.leader_lease_duration,
+    )
+    for scheme in cfg.enabled_schemes:
+        mgr.register(SCHEME_CONTROLLERS[scheme](runtime.api))
+    if cfg.enable_v2:
+        from training_operator_tpu.runtime.controller import TrainJobManager
+
+        # The v2 loop rides the same lease: only the elected v1 leader
+        # reconciles TrainJobs (reference: one manager process owns both
+        # controller generations under one leader election).
+        TrainJobManager(
+            runtime,
+            leader_gate=(
+                (lambda: mgr.elector.is_leader) if mgr.elector is not None else None
+            ),
+        )
+    print(f"OPERATOR_UP={cfg.leader_identity or 'anon'}", flush=True)
+    log.info(
+        "operator up (remote): api=%s schemes=%s leader_elect=%s",
+        args.api_server, ",".join(cfg.enabled_schemes), cfg.leader_elect,
+    )
+    if cfg.health_port:
+        serve_probes(None, cfg.health_port, cfg.metrics_token, cfg.health_bind_address)
+    stop = _install_stop()
+    if args.run_seconds is not None:
+        runtime.schedule_after(args.run_seconds, stop.set)
+    try:
+        runtime.run_forever(stop)
+    finally:
+        try:
+            mgr.stop()  # releases the lease; best-effort over the wire
+        except Exception:
+            log.exception("shutdown cleanup failed (host already gone?)")
+    return 0
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     logging.basicConfig(
@@ -317,6 +484,10 @@ def main(argv=None) -> int:
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
     cfg = set_current(build_config(args))
+    if args.role == "host":
+        return run_host(args, cfg)
+    if args.role == "operator":
+        return run_operator(args, cfg)
     cluster = build_cluster(args)
     mgr, _v2 = build_stack(cluster, cfg)
     log.info(
@@ -333,17 +504,7 @@ def main(argv=None) -> int:
         jobs = load_workload(args.workload, mgr)
         log.info("submitted %d job(s) from %s", len(jobs), args.workload)
 
-    stop = threading.Event()
-
-    def on_signal(signum, frame):
-        log.info("signal %s: shutting down", signum)
-        stop.set()
-
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        try:
-            signal.signal(sig, on_signal)
-        except ValueError:
-            pass  # non-main thread (tests)
+    stop = _install_stop()
 
     from training_operator_tpu.api import common as capi
 

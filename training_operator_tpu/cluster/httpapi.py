@@ -1,0 +1,583 @@
+"""HTTP/JSON wire boundary for the substrate API server.
+
+Gives the in-process `APIServer` the same kind of process boundary the
+reference control plane has everywhere: the SDK talks REST to a kube-apiserver
+(reference training_client.py:41), the operator consumes watch streams across
+a socket, and leader election is an apiserver-mediated lease race between real
+processes (cmd/training-operator.v1/main.go:134-166). Three pieces:
+
+  ApiHTTPServer    — serves an existing APIServer over localhost HTTP
+                     (CRUD + watch subscriptions + pod logs + events).
+  RemoteAPIServer  — client with the same duck-typed surface the engine and
+                     SDK consume (create/get/try_get/list/update/delete/
+                     try_delete/watch/unwatch/record_event/events/
+                     read_pod_log/append_pod_log/resource_version).
+  RemoteRuntime    — the operator-side run loop (clock + tickers + timers),
+                     shape-compatible with `Cluster` for OperatorManager and
+                     TrainingClient, but backed by a RemoteAPIServer.
+
+Errors round-trip as HTTP statuses: 404 NotFound, 409 Conflict (stale
+resourceVersion) / AlreadyExists (create), 422 admission rejection.
+
+Watch sessions are server-side WatchQueues keyed by a token; clients poll
+`GET /watches/<id>` (optionally long-polling via ?timeout=). Sessions idle
+longer than `session_ttl` are garbage-collected so a kill -9'd operator
+doesn't leak an ever-growing event queue.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import json
+import logging
+import threading
+import time as _time
+import urllib.error
+import urllib.parse
+import urllib.request
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from training_operator_tpu.cluster import wire
+from training_operator_tpu.cluster.apiserver import (
+    AlreadyExistsError,
+    APIServer,
+    ConflictError,
+    NotFoundError,
+    WatchQueue,
+)
+from training_operator_tpu.cluster.objects import Event
+from training_operator_tpu.cluster.runtime import Clock
+
+log = logging.getLogger(__name__)
+
+
+class ApiUnavailableError(Exception):
+    """Transport-level failure reaching the serving host (connection refused/
+    reset, socket timeout). Distinct from the API-semantic errors so callers
+    can retry instead of dying — a transient host hiccup must not take down
+    both the leader AND the standby operator."""
+
+
+# Empty namespace (cluster-scoped objects: Node, ClusterTrainingRuntime,
+# leases in "" if anyone does that) can't travel as an empty URL path
+# segment; "-" is the on-the-wire placeholder ("-" can never be a real
+# namespace: RFC1035 labels must start with a letter).
+def _ns_seg(namespace: str) -> str:
+    return namespace or "-"
+
+
+def _seg_ns(segment: str) -> str:
+    return "" if segment == "-" else segment
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+
+class ApiHTTPServer:
+    """Serve one APIServer over HTTP on a background thread.
+
+    The owning process keeps driving its Cluster loop; handler threads only
+    touch the APIServer, whose RLock makes every call atomic. Watch events
+    pushed by handler-thread writes are drained by local tickers on the next
+    step, identical to any other writer.
+    """
+
+    def __init__(
+        self,
+        api: APIServer,
+        port: int = 0,
+        bind: str = "127.0.0.1",
+        session_ttl: float = 120.0,
+    ):
+        self.api = api
+        self.session_ttl = session_ttl
+        # watch_id -> (WatchQueue, last_access_monotonic)
+        self._sessions: Dict[str, List[Any]] = {}
+        self._sessions_lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, payload: Any) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> Any:
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b"{}"
+                return json.loads(raw or b"{}")
+
+            def _route(self, method: str) -> None:
+                try:
+                    parsed = urllib.parse.urlsplit(self.path)
+                    parts = [p for p in parsed.path.split("/") if p]
+                    q = dict(urllib.parse.parse_qsl(parsed.query))
+                    outer._dispatch(self, method, parts, q)
+                except NotFoundError as e:
+                    self._send(404, {"error": "NotFound", "message": str(e)})
+                except ConflictError as e:
+                    self._send(409, {"error": "Conflict", "message": str(e)})
+                except AlreadyExistsError as e:
+                    self._send(409, {"error": "AlreadyExists", "message": str(e)})
+                except ValueError as e:
+                    self._send(422, {"error": "Invalid", "message": str(e)})
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001 — wire boundary
+                    log.exception("httpapi handler error")
+                    self._send(500, {"error": "Internal", "message": str(e)})
+
+            def do_GET(self):
+                self._route("GET")
+
+            def do_POST(self):
+                self._route("POST")
+
+            def do_PUT(self):
+                self._route("PUT")
+
+            def do_DELETE(self):
+                self._route("DELETE")
+
+        # Default listen backlog (5) is too small for several clients opening
+        # a fresh connection per request.
+        ThreadingHTTPServer.request_queue_size = 64
+        self._httpd = ThreadingHTTPServer((bind, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://{bind}:{self.port}"
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _dispatch(self, h, method: str, parts: List[str], q: Dict[str, str]) -> None:
+        if not parts:
+            h._send(404, {"error": "NotFound", "message": "no route"})
+            return
+        head = parts[0]
+        if head in ("healthz", "readyz"):
+            h._send(200, {"ok": True})
+        elif head == "objects":
+            self._objects(h, method, parts[1:], q)
+        elif head == "watches":
+            self._watches(h, method, parts[1:], q)
+        elif head == "logs":
+            self._logs(h, method, parts[1:], q)
+        elif head == "events":
+            self._events(h, method, q)
+        elif head == "version" and len(parts) == 4:
+            rv = self.api.resource_version(parts[1], _seg_ns(parts[2]), parts[3])
+            h._send(200, {"resourceVersion": rv})
+        else:
+            h._send(404, {"error": "NotFound", "message": f"no route {head}"})
+
+    def _objects(self, h, method: str, parts: List[str], q: Dict[str, str]) -> None:
+        if method == "POST" and not parts:
+            obj = wire.decode(h._body())
+            created = self.api.create(obj)
+            h._send(201, wire.encode(created))
+        elif method == "GET" and len(parts) == 1:
+            selector = None
+            if q.get("labelSelector"):
+                selector = dict(
+                    pair.split("=", 1) for pair in q["labelSelector"].split(",") if "=" in pair
+                )
+            objs = self.api.list(parts[0], q.get("namespace") or None, selector)
+            h._send(200, {"items": [wire.encode(o) for o in objs]})
+        elif method == "GET" and len(parts) == 3:
+            h._send(200, wire.encode(self.api.get(parts[0], _seg_ns(parts[1]), parts[2])))
+        elif method == "PUT" and len(parts) == 3:
+            obj = wire.decode(h._body())
+            updated = self.api.update(
+                obj,
+                check_version=q.get("check_version", "1") != "0",
+                status_only=q.get("status_only") == "1",
+            )
+            h._send(200, wire.encode(updated))
+        elif method == "DELETE" and len(parts) == 3:
+            gone = self.api.delete(parts[0], _seg_ns(parts[1]), parts[2])
+            h._send(200, wire.encode(gone))
+        else:
+            h._send(404, {"error": "NotFound", "message": "bad objects route"})
+
+    def _watches(self, h, method: str, parts: List[str], q: Dict[str, str]) -> None:
+        self._gc_sessions()
+        if method == "POST" and not parts:
+            body = h._body()
+            kinds = body.get("kinds")
+            wq = self.api.watch(kinds=kinds)
+            wid = uuid.uuid4().hex
+            with self._sessions_lock:
+                self._sessions[wid] = [wq, _time.monotonic()]
+            h._send(201, {"watch_id": wid})
+        elif method == "GET" and len(parts) == 1:
+            with self._sessions_lock:
+                session = self._sessions.get(parts[0])
+                if session is not None:
+                    session[1] = _time.monotonic()
+            if session is None:
+                raise NotFoundError(f"watch session {parts[0]}")
+            wq = session[0]
+            timeout = float(q.get("timeout", "0"))
+            deadline = _time.monotonic() + timeout
+            while not len(wq) and _time.monotonic() < deadline:
+                _time.sleep(0.01)
+            # Drain under the API lock: pushes happen while writers hold it,
+            # so this cannot race a concurrent push mid-drain.
+            with self.api._lock:
+                events = wq.drain()
+            h._send(200, {"events": [wire.encode_watch_event(ev) for ev in events]})
+        elif method == "DELETE" and len(parts) == 1:
+            with self._sessions_lock:
+                session = self._sessions.pop(parts[0], None)
+            if session is not None:
+                self.api.unwatch(session[0])
+            h._send(200, {"ok": True})
+        else:
+            h._send(404, {"error": "NotFound", "message": "bad watches route"})
+
+    def _gc_sessions(self) -> None:
+        now = _time.monotonic()
+        dead: List[Tuple[str, WatchQueue]] = []
+        with self._sessions_lock:
+            for wid, (wq, last) in list(self._sessions.items()):
+                if now - last > self.session_ttl:
+                    dead.append((wid, wq))
+                    del self._sessions[wid]
+        for _, wq in dead:
+            self.api.unwatch(wq)
+
+    def _logs(self, h, method: str, parts: List[str], q: Dict[str, str]) -> None:
+        if len(parts) != 2:
+            raise NotFoundError("logs route is /logs/<ns>/<pod>")
+        ns, name = _seg_ns(parts[0]), parts[1]
+        if method == "GET":
+            tail = int(q["tail"]) if q.get("tail") else None
+            lines, cursor = self.api.read_pod_log(
+                ns, name, since=int(q.get("since", "0")), tail=tail
+            )
+            h._send(200, {"lines": lines, "cursor": cursor})
+        elif method == "POST":
+            body = h._body()
+            self.api.append_pod_log(ns, name, body.get("line", ""), body.get("ts", 0.0))
+            h._send(200, {"ok": True})
+        else:
+            raise NotFoundError("bad logs method")
+
+    def _events(self, h, method: str, q: Dict[str, str]) -> None:
+        if method == "POST":
+            ev = wire.decode(h._body(), Event)
+            self.api.record_event(ev)
+            h._send(201, {"ok": True})
+        else:
+            evs = self.api.events(q.get("object_name") or None, q.get("reason") or None)
+            h._send(200, {"items": [wire.encode(e) for e in evs]})
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+
+class RemoteWatchQueue:
+    """Client-side handle on a server watch session.
+
+    `drain()` long-polls by default (`poll_timeout`): the server returns
+    immediately when events are pending and holds the request briefly when
+    none are — so an idle operator loop costs a few requests per second
+    instead of busy-polling an empty queue at tick rate, while event
+    delivery latency stays at one RTT."""
+
+    def __init__(self, remote: "RemoteAPIServer", watch_id: str, poll_timeout: float = 0.25):
+        self._remote = remote
+        self.watch_id = watch_id
+        self.poll_timeout = poll_timeout
+
+    def drain(self, timeout: Optional[float] = None) -> List[Any]:
+        t = self.poll_timeout if timeout is None else timeout
+        payload = self._remote._request(
+            "GET", f"/watches/{self.watch_id}", query={"timeout": str(t)}
+        )
+        return [wire.decode_watch_event(d) for d in payload["events"]]
+
+    def __len__(self) -> int:  # pragma: no cover - parity with WatchQueue
+        return 0
+
+
+class RemoteAPIServer:
+    """APIServer duck-type speaking the wire protocol.
+
+    Admission (`register_admission`) is a no-op here: validation and
+    defaulting are enforced inside the serving process, exactly as k8s
+    admission runs server-side no matter which client connects.
+    """
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    # -- transport ---------------------------------------------------------
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[Dict[str, Any]] = None,
+        query: Optional[Dict[str, str]] = None,
+    ) -> Any:
+        url = self.base_url + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            # HTTPError subclasses URLError — map the API-semantic statuses
+            # before the transport-failure arm below can swallow them.
+            try:
+                payload = json.loads(e.read() or b"{}")
+            except ValueError:
+                payload = {}
+            kind = payload.get("error", "")
+            msg = payload.get("message", str(e))
+            if e.code == 404:
+                raise NotFoundError(msg) from None
+            if e.code == 409 and kind == "AlreadyExists":
+                raise AlreadyExistsError(msg) from None
+            if e.code == 409:
+                raise ConflictError(msg) from None
+            if e.code == 422:
+                raise ValueError(msg) from None
+            raise RuntimeError(f"{method} {path}: {e.code} {msg}") from None
+        except (urllib.error.URLError, OSError) as e:
+            # Connection refused/reset, DNS, socket timeout: retryable.
+            raise ApiUnavailableError(f"{method} {path}: {e}") from None
+
+    # -- CRUD --------------------------------------------------------------
+
+    def create(self, obj: Any) -> Any:
+        out = wire.decode(self._request("POST", "/objects", body=wire.encode(obj)))
+        # The caller's object carries the assigned uid/resourceVersion after
+        # create (in-process contract), but the RETURNED object is the
+        # server's stored state — including server-side admission mutations
+        # (defaulting) the local copy never saw.
+        obj.metadata.uid = out.metadata.uid
+        obj.metadata.resource_version = out.metadata.resource_version
+        return out
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        return wire.decode(
+            self._request("GET", f"/objects/{kind}/{_ns_seg(namespace)}/{name}")
+        )
+
+    def try_get(self, kind: str, namespace: str, name: str) -> Optional[Any]:
+        try:
+            return self.get(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Any]:
+        query: Dict[str, str] = {}
+        if namespace is not None:
+            query["namespace"] = namespace
+        if label_selector:
+            query["labelSelector"] = ",".join(f"{k}={v}" for k, v in label_selector.items())
+        payload = self._request("GET", f"/objects/{kind}", query=query or None)
+        return [wire.decode(d) for d in payload["items"]]
+
+    def update(self, obj: Any, check_version: bool = True, status_only: bool = False) -> Any:
+        ns = getattr(obj.metadata, "namespace", "") or ""
+        out = wire.decode(
+            self._request(
+                "PUT",
+                f"/objects/{obj.KIND}/{_ns_seg(ns)}/{obj.metadata.name}",
+                body=wire.encode(obj),
+                query={
+                    "check_version": "1" if check_version else "0",
+                    "status_only": "1" if status_only else "0",
+                },
+            )
+        )
+        obj.metadata.resource_version = out.metadata.resource_version
+        return out
+
+    def delete(self, kind: str, namespace: str, name: str) -> Any:
+        return wire.decode(
+            self._request("DELETE", f"/objects/{kind}/{_ns_seg(namespace)}/{name}")
+        )
+
+    def try_delete(self, kind: str, namespace: str, name: str) -> Optional[Any]:
+        try:
+            return self.delete(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def resource_version(self, kind: str, namespace: str, name: str) -> Optional[int]:
+        return self._request("GET", f"/version/{kind}/{_ns_seg(namespace)}/{name}")[
+            "resourceVersion"
+        ]
+
+    # -- watch -------------------------------------------------------------
+
+    def watch(self, kinds: Optional[List[str]] = None) -> RemoteWatchQueue:
+        payload = self._request(
+            "POST", "/watches", body={"kinds": list(kinds) if kinds else None}
+        )
+        return RemoteWatchQueue(self, payload["watch_id"])
+
+    def unwatch(self, queue: RemoteWatchQueue) -> None:
+        try:
+            self._request("DELETE", f"/watches/{queue.watch_id}")
+        except (NotFoundError, ApiUnavailableError, RuntimeError):
+            pass  # best effort; the server GC reaps stale sessions anyway
+
+    # -- admission ---------------------------------------------------------
+
+    def register_admission(self, kind: str, fn: Callable[[Any], None]) -> None:
+        pass  # server-side concern (see class docstring)
+
+    def unregister_admission(self, kind: str, fn: Callable[[Any], None]) -> None:
+        pass
+
+    # -- logs / events -----------------------------------------------------
+
+    def append_pod_log(self, namespace: str, name: str, line: str, ts: float = 0.0) -> None:
+        self._request(
+            "POST", f"/logs/{_ns_seg(namespace)}/{name}", body={"line": line, "ts": ts}
+        )
+
+    def read_pod_log(
+        self, namespace: str, name: str, since: int = 0, tail: Optional[int] = None
+    ) -> Tuple[List[str], int]:
+        query = {"since": str(since)}
+        if tail is not None:
+            query["tail"] = str(tail)
+        payload = self._request("GET", f"/logs/{_ns_seg(namespace)}/{name}", query=query)
+        return payload["lines"], payload["cursor"]
+
+    def record_event(self, event: Event) -> None:
+        self._request("POST", "/events", body=wire.encode(event))
+
+    def events(
+        self, object_name: Optional[str] = None, reason: Optional[str] = None
+    ) -> List[Event]:
+        query: Dict[str, str] = {}
+        if object_name:
+            query["object_name"] = object_name
+        if reason:
+            query["reason"] = reason
+        payload = self._request("GET", "/events", query=query or None)
+        return [wire.decode(d, Event) for d in payload["items"]]
+
+
+# ---------------------------------------------------------------------------
+# Operator-side runtime
+# ---------------------------------------------------------------------------
+
+
+class RemoteRuntime:
+    """Run loop for a process whose API server lives elsewhere.
+
+    Shape-compatible with `Cluster` for everything the operator stack and
+    the SDK consume (`api`, `clock`, `add_ticker`/`remove_ticker`,
+    `schedule_at`/`schedule_after`, `run_until`/`run_for`, `live`), but with
+    no local store, scheduler, or kubelet — those live in the serving
+    process. Always real-clock: across OS processes there is no shared
+    virtual time.
+    """
+
+    def __init__(self, api: RemoteAPIServer, tick_interval: float = 0.02):
+        self.api = api
+        self.clock = Clock()
+        self.tick_interval = tick_interval
+        self._tickers: List[Callable[[], None]] = []
+        self._timers: List[Tuple[float, int, Callable[[], None]]] = []
+        self._timer_seq = itertools.count()
+
+    def add_ticker(self, fn: Callable[[], None]) -> None:
+        self._tickers.append(fn)
+
+    def remove_ticker(self, fn: Callable[[], None]) -> None:
+        try:
+            self._tickers.remove(fn)
+        except ValueError:
+            pass
+
+    def schedule_at(self, t: float, fn: Callable[[], None]) -> None:
+        heapq.heappush(self._timers, (t, next(self._timer_seq), fn))
+
+    def schedule_after(self, dt: float, fn: Callable[[], None]) -> None:
+        self.schedule_at(self.clock.now() + dt, fn)
+
+    def live(self, obj: Any) -> Any:
+        ns = getattr(obj.metadata, "namespace", "") or ""
+        return self.api.try_get(obj.KIND, ns, obj.metadata.name)
+
+    def step(self) -> None:
+        now = self.clock.now()
+        while self._timers and self._timers[0][0] <= now:
+            _, _, fn = heapq.heappop(self._timers)
+            fn()
+        for fn in list(self._tickers):
+            fn()
+
+    def run_until(self, predicate: Callable[[], bool], timeout: float = 30.0) -> bool:
+        deadline = self.clock.now() + timeout
+        while True:
+            if predicate():
+                return True
+            self.step()
+            if predicate():
+                return True
+            if self.clock.now() >= deadline:
+                return False
+            _time.sleep(self.tick_interval)
+
+    def run_for(self, seconds: float) -> None:
+        self.run_until(lambda: False, timeout=seconds)
+
+    def run_forever(self, stop: threading.Event) -> None:
+        """Operator main loop: a transient transport failure (host restart,
+        connection reset) is survived with backoff — the process must NOT
+        die, or one API hiccup would take out leader and standby together.
+        Leadership safety doesn't depend on this: an unrenewable lease just
+        expires and the healthiest candidate re-acquires."""
+        backoff = 0.1
+        while not stop.is_set():
+            try:
+                self.step()
+                backoff = 0.1
+            except ApiUnavailableError as e:
+                log.warning("API server unreachable (%s); retrying in %.1fs", e, backoff)
+                _time.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+                continue
+            _time.sleep(self.tick_interval)

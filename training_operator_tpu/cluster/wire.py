@@ -1,0 +1,160 @@
+"""Wire codec: JSON-serializable form of every stored API object kind.
+
+The reference talks JSON over real process boundaries everywhere — SDK ->
+apiserver REST (sdk/python/kubeflow/training/api/training_client.py:41),
+operator -> apiserver watch streams, webhook admission over HTTPS
+(cmd/training-operator.v1/main.go:134-166). This module is the serialization
+half of that boundary for the TPU-native substrate: a generic, type-driven
+codec over the dataclass object model, so the HTTP API server
+(cluster/httpapi.py) and remote clients exchange exactly the objects the
+in-process APIServer stores.
+
+Design: instead of hand-written to_dict/from_dict per class (the reference's
+generated zz_generated deepcopy/openapi machinery), one recursive codec walks
+`dataclasses.fields` + `typing.get_type_hints`:
+
+  encode: dataclass -> {field: encode(value)}, Enum -> .value,
+          list/tuple -> list, dict -> {key: encode(value)}
+  decode: driven by the declared field type — Optional[X], List[X],
+          Dict[str, X], nested dataclasses, Enums; `Any` passes through.
+
+Top-level objects carry a `"kind"` discriminator resolved via KIND_REGISTRY.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import typing
+from typing import Any, Dict, List, Optional, Type
+
+from training_operator_tpu.api import jobs as jobs_api
+from training_operator_tpu.cluster import objects as cluster_objects
+from training_operator_tpu.runtime import api as runtime_api
+
+# kind string -> class, for every kind the APIServer can store (plus Event,
+# which travels via the events subresource).
+KIND_REGISTRY: Dict[str, type] = {
+    cls.KIND: cls
+    for cls in (
+        cluster_objects.Pod,
+        cluster_objects.Service,
+        cluster_objects.Node,
+        cluster_objects.PodGroup,
+        cluster_objects.ConfigMap,
+        cluster_objects.HorizontalPodAutoscaler,
+        cluster_objects.Lease,
+        cluster_objects.Event,
+        jobs_api.JAXJob,
+        jobs_api.PyTorchJob,
+        jobs_api.TFJob,
+        jobs_api.XGBoostJob,
+        jobs_api.PaddleJob,
+        jobs_api.MPIJob,
+        runtime_api.TrainJob,
+        runtime_api.TrainingRuntime,
+        runtime_api.ClusterTrainingRuntime,
+    )
+}
+
+# Resolved type hints are cached per class: get_type_hints re-evaluates the
+# stringified `from __future__ import annotations` annotations on every call.
+_HINTS_CACHE: Dict[type, Dict[str, Any]] = {}
+
+
+def _hints(cls: type) -> Dict[str, Any]:
+    cached = _HINTS_CACHE.get(cls)
+    if cached is None:
+        cached = typing.get_type_hints(cls)
+        _HINTS_CACHE[cls] = cached
+    return cached
+
+
+def encode(obj: Any) -> Any:
+    """Recursively encode a model value to JSON-compatible data."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {f.name: encode(getattr(obj, f.name)) for f in dataclasses.fields(obj)}
+        kind = getattr(type(obj), "KIND", None)
+        if kind in KIND_REGISTRY:
+            out["kind"] = kind
+        return out
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, (list, tuple)):
+        return [encode(v) for v in obj]
+    if isinstance(obj, dict):
+        return {str(k): encode(v) for k, v in obj.items()}
+    return obj  # str/int/float/bool/None
+
+
+def decode(data: Dict[str, Any], cls: Optional[type] = None) -> Any:
+    """Decode a wire dict back into a model object.
+
+    `cls` overrides the kind lookup (for nested calls); top-level callers
+    normally rely on the `"kind"` discriminator.
+    """
+    if cls is None:
+        kind = data.get("kind")
+        cls = KIND_REGISTRY.get(kind or "")
+        if cls is None:
+            raise ValueError(f"unknown wire kind {kind!r}")
+    return _decode_dataclass(data, cls)
+
+
+def _decode_dataclass(data: Dict[str, Any], cls: type) -> Any:
+    hints = _hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name in data:
+            kwargs[f.name] = _decode_value(data[f.name], hints.get(f.name, Any))
+    return cls(**kwargs)
+
+
+def _decode_value(value: Any, hint: Any) -> Any:
+    if value is None:
+        return None
+    origin = typing.get_origin(hint)
+    if origin is typing.Union:
+        # Optional[X] and small unions: decode to the first non-None arm
+        # that is a structured type; primitives pass through.
+        for arm in typing.get_args(hint):
+            if arm is type(None):
+                continue
+            return _decode_value(value, arm)
+        return value
+    if origin in (list, tuple):
+        args = typing.get_args(hint)
+        elem = args[0] if args else Any
+        return [_decode_value(v, elem) for v in value]
+    if origin is dict:
+        args = typing.get_args(hint)
+        val_t = args[1] if len(args) == 2 else Any
+        return {k: _decode_value(v, val_t) for k, v in value.items()}
+    if isinstance(hint, type):
+        if dataclasses.is_dataclass(hint):
+            return _decode_dataclass(value, hint)
+        if issubclass(hint, enum.Enum):
+            return hint(value)
+        if hint is float and isinstance(value, int):
+            return float(value)
+    return value
+
+
+def encode_watch_event(ev) -> Dict[str, Any]:
+    return {
+        "type": ev.type,
+        "kind": ev.kind,
+        "status_only": ev.status_only,
+        "object": encode(ev.obj),
+    }
+
+
+def decode_watch_event(d: Dict[str, Any]):
+    from training_operator_tpu.cluster.apiserver import WatchEvent
+
+    return WatchEvent(
+        type=d["type"],
+        kind=d["kind"],
+        obj=decode(d["object"]),
+        status_only=bool(d.get("status_only", False)),
+    )

@@ -62,6 +62,9 @@ class OperatorConfig:
     # is released. Identity defaults to a per-manager unique string.
     leader_elect: bool = False
     leader_identity: Optional[str] = None
+    # Lease duration: how long a dead leader's lease blocks takeover
+    # (controller-runtime LeaseDuration; renew interval is duration/3).
+    leader_lease_duration: float = 15.0
 
     def validate(self) -> None:
         unknown = [s for s in self.enabled_schemes if s not in ALL_SCHEMES]
@@ -74,6 +77,11 @@ class OperatorConfig:
             )
         if self.controller_threads < 1:
             raise ValueError("controller_threads must be >= 1")
+        if self.leader_lease_duration <= 0:
+            # A non-positive lease is permanently expired: leadership would
+            # flap between candidates every tick, each transition firing a
+            # full resync — duplicated reconciling, not HA.
+            raise ValueError("leader_lease_duration must be > 0")
         if self.metrics_token is not None and not self.metrics_token.isascii():
             # HTTP header bytes are latin-1-decoded by the stdlib server;
             # a non-ASCII token can never round-trip through the comparison

@@ -40,6 +40,8 @@ class OperatorManager:
         namespace: Optional[str] = None,
         leader_elect: bool = False,
         identity: Optional[str] = None,
+        lease_duration: float = 15.0,
+        resync_period: Optional[float] = 300.0,
     ):
         self.cluster = cluster
         self.api = cluster.api
@@ -48,6 +50,12 @@ class OperatorManager:
         # Namespace scope (reference --namespace / cache.Options.Namespaces):
         # events outside the scope are ignored entirely.
         self.namespace = namespace or None
+        # Periodic full resync (controller-runtime's SyncPeriod): every job
+        # re-enqueued on a timer, so a DROPPED watch event (flaky informer
+        # connection) delays convergence instead of wedging it. None
+        # disables (tests that count reconciles exactly).
+        self.resync_period = resync_period
+        self._last_resync = cluster.clock.now()
         self.queue = RateLimitingQueue()
         self.controllers: Dict[str, Tuple[object, JobController]] = {}
         self._watch = self.api.watch()
@@ -68,6 +76,7 @@ class OperatorManager:
                 # Unique ACROSS processes (id() is only per-process unique,
                 # and a collision means silent split-brain).
                 identity or f"operator-{os.getpid()}-{uuid.uuid4().hex[:8]}",
+                lease_duration=lease_duration,
             )
             # Order matters: expectations from a previous term reference
             # events the standby discarded — clear them before the resync
@@ -160,6 +169,12 @@ class OperatorManager:
             # everything, so nothing observed here is load-bearing.
             self._watch.drain()
             return
+        if (
+            self.resync_period is not None
+            and self.cluster.clock.now() - self._last_resync >= self.resync_period
+        ):
+            self._last_resync = self.cluster.clock.now()
+            self._resync_all()
         for ev in self._watch.drain():
             self._handle_event(ev)
         for key in self.queue.drain(limit=self.reconciles_per_tick):

@@ -164,13 +164,28 @@ class TrainJobManager:
     controller (reference cmd/training-operator.v2alpha1/main.go:142-148 +
     SetupWithManager watch registrations, trainjob_controller.go:222-233)."""
 
-    def __init__(self, cluster: Cluster, registry: Optional[PluginRegistry] = None):
+    def __init__(
+        self,
+        cluster: Cluster,
+        registry: Optional[PluginRegistry] = None,
+        leader_gate=None,
+    ):
+        """`leader_gate` (callable -> bool): when provided, the tick stays
+        quiet unless it returns True — lets HA deployments ride the v1
+        manager's lease so only the elected leader reconciles TrainJobs
+        (reference: one manager process owns both controller generations
+        under one leader election)."""
         self.cluster = cluster
         self.api = cluster.api
+        self.leader_gate = leader_gate
         self.controller = TrainJobController(
             self.api, now_fn=cluster.clock.now, registry=registry
         )
         self.queue = RateLimitingQueue()
+        # True at start (and after standby periods): the first active tick
+        # re-lists every TrainJob — the informer initial-list, which also
+        # covers jobs created before this manager existed.
+        self._resync_pending = True
         self._watch = self.api.watch()
         cluster.add_ticker(self.tick)
         from training_operator_tpu.runtime.webhooks import validate_trainjob, validate_training_runtime
@@ -178,6 +193,12 @@ class TrainJobManager:
         self.api.register_admission(TrainJob.KIND, validate_trainjob)
         self.api.register_admission(TrainingRuntime.KIND, validate_training_runtime)
         self.api.register_admission(ClusterTrainingRuntime.KIND, validate_training_runtime)
+        # Built-in runtime catalog (reference manifests/v2/base/runtimes):
+        # a fresh cluster can run `client.train(...)` with the default
+        # runtime_ref without anyone hand-building a runtime first.
+        from training_operator_tpu.runtime.presets import install_presets
+
+        install_presets(self.api)
 
     def submit(self, obj: Any) -> Any:
         if isinstance(obj, TrainJob) and obj.metadata.creation_time is None:
@@ -185,6 +206,17 @@ class TrainJobManager:
         return self.api.create(obj)
 
     def tick(self) -> None:
+        if self.leader_gate is not None and not self.leader_gate():
+            # Standby: discard events; the resync below re-lists every
+            # TrainJob on the first leading tick, so nothing observed here
+            # is load-bearing.
+            self._watch.drain()
+            self._resync_pending = True
+            return
+        if self._resync_pending:
+            self._resync_pending = False
+            for tj in self.api.list(TrainJob.KIND):
+                self.queue.add(tj.key())
         for ev in self._watch.drain():
             self._handle_event(ev)
         for key in self.queue.drain(limit=256):

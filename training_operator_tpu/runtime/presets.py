@@ -1,0 +1,124 @@
+"""Built-in ClusterTrainingRuntime presets.
+
+Parity target: the reference ships ClusterTrainingRuntime manifests that
+users reference by name without ever building a runtime themselves
+(/root/reference/manifests/v2/base/runtimes/pre-training/
+torch-distributed.yaml:1-13 — `runtimeRef: {name: torch-distributed}`).
+These are the TPU-native equivalents, installed at startup by the v2
+manager (and the `--role host` process), so `TrainingClient.train("job")`
+works against a fresh cluster with its default
+`runtime_ref="tpu-jax-default"`.
+
+Catalog:
+  tpu-jax-default     one v5e 2x4 slice, 2 worker hosts, mesh data x fsdp
+  tpu-jax-multislice  2 x v5e 4x4 slices over DCN (data axis across slices)
+  torch-distributed   4-node torchrun (PET_* contract), 1 proc per node
+  plainml             num_nodes passthrough, no framework bootstrap
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List
+
+from training_operator_tpu.api.common import Container, PodTemplateSpec
+from training_operator_tpu.api.jobs import ObjectMeta, TPUPolicy
+from training_operator_tpu.cluster.apiserver import AlreadyExistsError
+from training_operator_tpu.runtime.api import (
+    ClusterTrainingRuntime,
+    MLPolicy,
+    PodGroupPolicy,
+    CoschedulingPolicy,
+    ReplicatedJobTemplate,
+    TorchPolicy,
+    TRAINER_NODE,
+    TrainingRuntimeSpec,
+)
+
+log = logging.getLogger(__name__)
+
+DEFAULT_TRAINER_IMAGE = "tpu-training/trainer"
+
+
+def _trainer_template(container: str = "trainer") -> ReplicatedJobTemplate:
+    return ReplicatedJobTemplate(
+        name=TRAINER_NODE,
+        template=PodTemplateSpec(
+            containers=[Container(name=container, image=DEFAULT_TRAINER_IMAGE)]
+        ),
+    )
+
+
+def builtin_runtimes() -> List[ClusterTrainingRuntime]:
+    """Fresh preset objects (callers hand them to an API server, which
+    stores its own copies)."""
+    return [
+        ClusterTrainingRuntime(
+            metadata=ObjectMeta(name="tpu-jax-default", namespace=""),
+            spec=TrainingRuntimeSpec(
+                ml_policy=MLPolicy(
+                    num_nodes=2,
+                    tpu=TPUPolicy(
+                        accelerator="v5e-8",
+                        topology="2x4",
+                        num_slices=1,
+                        mesh_axes={"data": 2, "fsdp": 4},
+                    ),
+                ),
+                pod_group_policy=PodGroupPolicy(coscheduling=CoschedulingPolicy()),
+                template=[_trainer_template()],
+            ),
+        ),
+        ClusterTrainingRuntime(
+            metadata=ObjectMeta(name="tpu-jax-multislice", namespace=""),
+            spec=TrainingRuntimeSpec(
+                ml_policy=MLPolicy(
+                    num_nodes=8,
+                    tpu=TPUPolicy(
+                        accelerator="v5e-16",
+                        topology="4x4",
+                        num_slices=2,
+                        mesh_axes={"data": 2, "fsdp": 16},
+                    ),
+                ),
+                pod_group_policy=PodGroupPolicy(coscheduling=CoschedulingPolicy()),
+                template=[_trainer_template()],
+            ),
+        ),
+        ClusterTrainingRuntime(
+            metadata=ObjectMeta(name="torch-distributed", namespace=""),
+            spec=TrainingRuntimeSpec(
+                ml_policy=MLPolicy(
+                    num_nodes=4,
+                    torch=TorchPolicy(num_proc_per_node=1),
+                ),
+                template=[_trainer_template()],
+            ),
+        ),
+        ClusterTrainingRuntime(
+            metadata=ObjectMeta(name="plainml", namespace=""),
+            spec=TrainingRuntimeSpec(
+                ml_policy=MLPolicy(num_nodes=1),
+                template=[_trainer_template()],
+            ),
+        ),
+    ]
+
+
+def install_presets(api) -> int:
+    """Create any missing preset runtime; returns how many were created.
+    Racing installers (two HA operators starting together) are benign:
+    the loser's AlreadyExists is swallowed. Existing runtimes are never
+    overwritten — operators may have customized them."""
+    created = 0
+    for rt in builtin_runtimes():
+        if api.try_get(ClusterTrainingRuntime.KIND, "", rt.metadata.name) is not None:
+            continue
+        try:
+            api.create(rt)
+            created += 1
+        except AlreadyExistsError:
+            pass
+    if created:
+        log.info("installed %d built-in ClusterTrainingRuntime preset(s)", created)
+    return created

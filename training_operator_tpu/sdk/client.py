@@ -47,10 +47,21 @@ class TimeoutException(Exception):
 class TrainingClient:
     def __init__(
         self,
-        cluster: Cluster,
+        cluster: Union[Cluster, str],
         namespace: str = "default",
         job_kind: str = "JAXJob",
     ):
+        """`cluster` is either an in-process Cluster or a base URL string
+        ("http://127.0.0.1:8443") of a serving host process — the remote
+        mode mirroring the reference client's REST relationship with the
+        kube-apiserver (training_client.py:41)."""
+        if isinstance(cluster, str):
+            from training_operator_tpu.cluster.httpapi import (
+                RemoteAPIServer,
+                RemoteRuntime,
+            )
+
+            cluster = RemoteRuntime(RemoteAPIServer(cluster))
         self.cluster = cluster
         self.api = cluster.api
         self.namespace = namespace
@@ -208,7 +219,20 @@ class TrainingClient:
         if replica_type:
             # Labels carry the replica type verbatim ("Worker", "Master" —
             # see engine/core.py replica_labels), unlike the reference's
-            # lowercased form.
+            # lowercased form. Validate against the job's actual replica
+            # types so a typo (or reference-style lowercase "worker")
+            # raises like the reference (training_client.py:1028-1053)
+            # instead of silently matching nothing.
+            for kind in JOB_KIND_NAMES:
+                job = self.api.try_get(kind, ns, name)
+                if job is not None and hasattr(job, "replica_specs"):
+                    known = sorted(job.replica_specs)
+                    if str(replica_type) not in known:
+                        raise ValueError(
+                            f"replica_type {replica_type!r} not in {kind} "
+                            f"{name}'s replica types {known}"
+                        )
+                    break
             sel[capi.REPLICA_TYPE_LABEL] = str(replica_type)
         if replica_index is not None:
             sel[capi.REPLICA_INDEX_LABEL] = str(replica_index)
