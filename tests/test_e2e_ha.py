@@ -53,19 +53,33 @@ def _spawn(args):
 
 
 def _read_line_with_prefix(proc, prefix, timeout=30.0):
-    """Read the subprocess's stdout until a `prefix=` announcement line."""
+    """Read the subprocess's stdout until a `prefix=` announcement line.
+    select()-gated so a silent-but-alive process trips the deadline instead
+    of blocking forever in readline()."""
+    import select
+
     deadline = time.monotonic() + timeout
+    buf = ""
     while time.monotonic() < deadline:
-        line = proc.stdout.readline()
-        if not line:
+        ready, _, _ = select.select([proc.stdout], [], [], 0.2)
+        if not ready:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"process exited rc={proc.returncode} before announcing {prefix}"
+                )
+            continue
+        chunk = os.read(proc.stdout.fileno(), 4096).decode(errors="replace")
+        if not chunk:
             if proc.poll() is not None:
                 raise AssertionError(
                     f"process exited rc={proc.returncode} before announcing {prefix}"
                 )
             time.sleep(0.05)
             continue
-        if line.startswith(prefix):
-            return line.strip().split("=", 1)[1]
+        buf += chunk
+        for line in buf.splitlines():
+            if line.startswith(prefix):
+                return line.strip().split("=", 1)[1]
     raise AssertionError(f"no {prefix} announcement within {timeout}s")
 
 

@@ -302,9 +302,18 @@ class TestHTTPApi:
         events = wq.drain()
         assert [e.type for e in events] == ["Added"]  # Node filtered out
         assert events[0].obj.name == "w-0"
+        # After the server forgets the session (explicit unwatch here; TTL
+        # GC in production), drain() transparently re-subscribes — the
+        # consumer's resync covers the gap — rather than killing the
+        # operator loop with NotFoundError.
+        old_id = wq.watch_id
         remote.unwatch(wq)
-        with pytest.raises(NotFoundError):
-            wq.drain()
+        assert wq.drain() == []
+        assert wq.watch_id != old_id
+        remote.create(Node(metadata=ObjectMeta(name="n10"), capacity={"cpu": 1}))
+        cluster.api.delete("Pod", "ns1", "w-0")
+        events = wq.drain()
+        assert [e.type for e in events] == ["Deleted"]  # kinds filter survived
 
     def test_logs_and_events(self, served_cluster):
         cluster, remote = served_cluster

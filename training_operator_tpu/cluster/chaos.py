@@ -20,6 +20,7 @@ from __future__ import annotations
 import random
 from typing import Dict, List, Optional, Tuple
 
+from training_operator_tpu.cluster.apiserver import ConflictError
 from training_operator_tpu.cluster.objects import PodPhase
 from training_operator_tpu.cluster.runtime import Cluster, SimKubelet
 
@@ -52,6 +53,11 @@ class ChaosMonkey:
         self.selector = selector
         self.namespace = namespace
         self.kills: List[Tuple[float, str]] = []
+        # Consecutive strikes that found no RUNNING victim. Without this, a
+        # monkey whose jobs all finished keeps generating virtual-clock
+        # events forever and run_until loops only end by timeout.
+        self.empty_strikes = 0
+        self.max_empty_strikes = 3
         self._armed = True
         self._schedule_next()
 
@@ -86,4 +92,154 @@ class ChaosMonkey:
                 log=f"chaos: killed at t={now:.1f}",
             ):
                 self.kills.append((now, pod.name))
+                self.empty_strikes = 0
+            else:
+                self.empty_strikes += 1
+        else:
+            self.empty_strikes += 1
+        if self.empty_strikes >= self.max_empty_strikes:
+            self._armed = False  # nothing left to kill: disarm, stop ticking
+            return
         self._schedule_next()
+
+
+class APIChaos:
+    """Control-plane fault injection against one APIServer.
+
+    The reference's subtlest machinery exists to survive exactly these
+    faults: the expectations cache absorbs the create->informer-echo gap
+    (expectation/expectation.go:29-40), adoption re-checks and versioned
+    writes absorb conflicts (control/controller_ref_manager.go:380), and
+    controller-runtime's SyncPeriod resync heals missed watch events. This
+    injector produces those faults ON DEMAND, seeded and budget-free:
+
+      conflict_rate  fraction of version-checked update() calls that raise
+                     ConflictError even when the version matches (the
+                     optimistic-concurrency writer must retry via its
+                     backoff/requeue path). Unversioned writes (kubelet
+                     status flips) are never targeted — real kubelets
+                     don't do optimistic concurrency here.
+      drop_rate      fraction of watch events NOT delivered to the victim
+                     watcher (flaky informer connection). Healed by the
+                     manager's periodic resync.
+      dup_rate       fraction of watch events delivered TWICE to the victim
+                     (reconnect replay) — reconciles must be idempotent and
+                     expectations must not double-count.
+      stall          (start, duration): during the window, the victim's
+                     events are buffered and delivered only after it ends
+                     (informer stall / network partition).
+
+    `victims` scopes drop/dup/stall to specific watch queues (normally the
+    operator manager's): faulting EVERY component's watch would model a
+    substrate with no reliable delivery anywhere, which even Kubernetes
+    does not claim to be.
+
+    `stop()` restores the pristine APIServer methods.
+    """
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        seed: int = 0,
+        conflict_rate: float = 0.0,
+        drop_rate: float = 0.0,
+        dup_rate: float = 0.0,
+        stall: Optional[Tuple[float, float]] = None,
+        victims: Optional[List[object]] = None,
+    ):
+        self.cluster = cluster
+        self.api = cluster.api
+        self.rng = random.Random(seed)
+        self.conflict_rate = conflict_rate
+        self.drop_rate = drop_rate
+        self.dup_rate = dup_rate
+        self.stall = stall
+        self.victims = list(victims or [])
+        self.injected_conflicts = 0
+        self.dropped_events = 0
+        self.duplicated_events = 0
+        self.stalled_events = 0
+        self._stall_buffer: List[Tuple[object, object]] = []
+        self._orig_update = self.api.update
+        self._orig_notify = self.api._notify
+        self.api.update = self._update
+        self.api._notify = self._notify
+        if stall is not None:
+            # Flush timer: buffered events land right after the window ends.
+            cluster.schedule_at(stall[0] + stall[1], self._flush_stall)
+
+    def stop(self) -> None:
+        self.api.update = self._orig_update
+        self.api._notify = self._orig_notify
+        self._flush_stall()
+
+    # ------------------------------------------------------------------
+
+    def _update(self, obj, check_version: bool = True, status_only: bool = False):
+        if check_version and self.conflict_rate and self.rng.random() < self.conflict_rate:
+            self.injected_conflicts += 1
+            key = (obj.KIND, getattr(obj.metadata, "namespace", ""), obj.metadata.name)
+            raise ConflictError(f"chaos: injected conflict on {key}")
+        return self._orig_update(obj, check_version=check_version, status_only=status_only)
+
+    def _in_stall(self) -> bool:
+        if self.stall is None:
+            return False
+        start, dur = self.stall
+        return start <= self.cluster.clock.now() < start + dur
+
+    def _flush_stall(self) -> None:
+        buffered, self._stall_buffer = self._stall_buffer, []
+        for victim, ev in buffered:
+            victim.push(ev)
+
+    def _notify(self, ev_type: str, obj, status_only: bool = False) -> None:
+        from training_operator_tpu.cluster.apiserver import WatchEvent
+
+        if not self.victims:
+            self._orig_notify(ev_type, obj, status_only=status_only)
+            return
+        # Deliver per-watcher so faults hit only the victims; everyone else
+        # observes perfectly ordered, exactly-once delivery.
+        ev = WatchEvent(ev_type, obj.KIND, obj, status_only=status_only)
+        for w in list(self.api._watchers):
+            if w not in self.victims:
+                w.push(ev)
+                continue
+            if self._in_stall():
+                self.stalled_events += 1
+                self._stall_buffer.append((w, ev))
+                continue
+            r = self.rng.random()
+            if r < self.drop_rate:
+                self.dropped_events += 1
+                continue
+            w.push(ev)
+            if r < self.drop_rate + self.dup_rate:
+                self.duplicated_events += 1
+                w.push(ev)
+
+
+class GangPause:
+    """Pause a component's ticker for a window (scheduler outage): ticks
+    inside [start, start+duration) are swallowed. Models the gang scheduler
+    or default scheduler being down while the rest of the control plane
+    keeps moving — pods must queue, not error."""
+
+    def __init__(self, cluster: Cluster, ticker, start: float, duration: float):
+        self.cluster = cluster
+        self.ticker = ticker
+        self.start = start
+        self.duration = duration
+        cluster.remove_ticker(ticker)
+        cluster.add_ticker(self._gated)
+
+    def _gated(self) -> None:
+        now = self.cluster.clock.now()
+        if self.start <= now < self.start + self.duration:
+            return
+        self.ticker()
+
+    def stop(self) -> None:
+        self.cluster.remove_ticker(self._gated)
+        self.cluster.add_ticker(self.ticker)

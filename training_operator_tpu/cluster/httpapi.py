@@ -152,11 +152,15 @@ class ApiHTTPServer:
             def do_DELETE(self):
                 self._route("DELETE")
 
-        # Default listen backlog (5) is too small for several clients opening
-        # a fresh connection per request.
-        ThreadingHTTPServer.request_queue_size = 64
-        self._httpd = ThreadingHTTPServer((bind, port), Handler)
-        self._httpd.daemon_threads = True
+        class _Server(ThreadingHTTPServer):
+            # Default listen backlog (5) is too small for several clients
+            # opening a fresh connection per request. Subclass, not a class-
+            # attribute mutation on the stdlib type, so unrelated servers in
+            # this process keep their own backlog.
+            request_queue_size = 64
+            daemon_threads = True
+
+        self._httpd = _Server((bind, port), Handler)
         self.port = self._httpd.server_address[1]
         self.url = f"http://{bind}:{self.port}"
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
@@ -306,16 +310,32 @@ class RemoteWatchQueue:
     instead of busy-polling an empty queue at tick rate, while event
     delivery latency stays at one RTT."""
 
-    def __init__(self, remote: "RemoteAPIServer", watch_id: str, poll_timeout: float = 0.25):
+    def __init__(
+        self,
+        remote: "RemoteAPIServer",
+        watch_id: str,
+        kinds: Optional[List[str]] = None,
+        poll_timeout: float = 0.25,
+    ):
         self._remote = remote
         self.watch_id = watch_id
+        self.kinds = kinds
         self.poll_timeout = poll_timeout
 
     def drain(self, timeout: Optional[float] = None) -> List[Any]:
         t = self.poll_timeout if timeout is None else timeout
-        payload = self._remote._request(
-            "GET", f"/watches/{self.watch_id}", query={"timeout": str(t)}
-        )
+        try:
+            payload = self._remote._request(
+                "GET", f"/watches/{self.watch_id}", query={"timeout": str(t)}
+            )
+        except NotFoundError:
+            # Session reaped server-side (we were paused past session_ttl).
+            # Re-subscribe in place; events missed in between are healed by
+            # the consumer's periodic resync, exactly like an informer
+            # relist after a dropped watch connection.
+            fresh = self._remote.watch(self.kinds)
+            self.watch_id = fresh.watch_id
+            return []
         return [wire.decode_watch_event(d) for d in payload["events"]]
 
     def __len__(self) -> int:  # pragma: no cover - parity with WatchQueue
@@ -451,7 +471,9 @@ class RemoteAPIServer:
         payload = self._request(
             "POST", "/watches", body={"kinds": list(kinds) if kinds else None}
         )
-        return RemoteWatchQueue(self, payload["watch_id"])
+        return RemoteWatchQueue(
+            self, payload["watch_id"], kinds=list(kinds) if kinds else None
+        )
 
     def unwatch(self, queue: RemoteWatchQueue) -> None:
         try:
@@ -575,8 +597,12 @@ class RemoteRuntime:
             try:
                 self.step()
                 backoff = 0.1
-            except ApiUnavailableError as e:
-                log.warning("API server unreachable (%s); retrying in %.1fs", e, backoff)
+            except (ApiUnavailableError, RuntimeError) as e:
+                # ApiUnavailableError: transport down. RuntimeError: the
+                # server answered 5xx — equally transient from here (k8s
+                # clients retry 500s the same way). Anything else is a
+                # local bug and should crash loudly.
+                log.warning("API server error (%s); retrying in %.1fs", e, backoff)
                 _time.sleep(backoff)
                 backoff = min(backoff * 2, 5.0)
                 continue

@@ -87,14 +87,23 @@ class LeaderElector:
         ReleaseOnCancel)."""
         if not self.is_leader:
             return
-        try:
-            lease = self.api.get(Lease.KIND, self.namespace, self.lease_name)
-            if lease.holder == self.identity:
-                lease.holder = ""
-                lease.renew_time = -self.lease_duration
-                self.api.update(lease)
-        except (NotFoundError, ConflictError):
-            pass
+        # One retry on conflict: a release racing our own just-committed
+        # renew (or any concurrent lease write) must not silently give up —
+        # that would stall failover for the full lease_duration, contrary
+        # to the ReleaseOnCancel intent. If the re-read shows someone else
+        # holds the lease, there is nothing to release.
+        for _ in range(2):
+            try:
+                lease = self.api.get(Lease.KIND, self.namespace, self.lease_name)
+                if lease.holder == self.identity:
+                    lease.holder = ""
+                    lease.renew_time = -self.lease_duration
+                    self.api.update(lease)
+                break
+            except ConflictError:
+                continue
+            except NotFoundError:
+                break
         self._set_leader(False)
 
     # ------------------------------------------------------------------

@@ -39,15 +39,15 @@ def _cache_dir() -> Path:
 
 def _build() -> Optional[ctypes.CDLL]:
     src = _SOURCE.read_bytes()
-    tag = hashlib.sha256(src).hexdigest()[:16]
+    flags = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread"]
+    # Cache key covers source AND compile command: changing flags (or the
+    # file paths baked into the command) must not load a stale .so.
+    tag = hashlib.sha256(src + "\0".join(flags).encode()).hexdigest()[:16]
     out = _cache_dir() / f"dataio-{tag}.so"
     if not out.exists():
         out.parent.mkdir(parents=True, exist_ok=True)
         tmp = out.with_suffix(f".tmp{os.getpid()}")
-        cmd = [
-            "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-            str(_SOURCE), "-o", str(tmp),
-        ]
+        cmd = [*flags, str(_SOURCE), "-o", str(tmp)]
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
         if proc.returncode != 0:
             raise RuntimeError(f"g++ failed: {proc.stderr[:500]}")

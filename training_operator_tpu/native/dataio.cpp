@@ -13,7 +13,9 @@
 //   tod_pack_tokens     flat token stream -> [n, row] matrix
 //   tod_prefetcher_*    double-buffered background gather pipeline
 //
-// Build: g++ -O3 -march=native -shared -fPIC -pthread (see build.py).
+// Built on demand by native/__init__.py _build():
+//   g++ -O3 -std=c++17 -shared -fPIC -pthread
+// (cached under ~/.cache/training_operator_tpu, keyed by source + command).
 
 #include <atomic>
 #include <condition_variable>

@@ -21,6 +21,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from training_operator_tpu.trainer.mesh import BATCH_AXES, axis_size
@@ -165,9 +166,18 @@ def attention(
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     if mesh is not None and axis_size(mesh, "sequence") > 1:
+        # "attn_out" names the residual attention output on EVERY backend,
+        # not just inside the flash custom_vjp, so the save_attn* remat
+        # policies keep their meaning when the dispatch picks ring/Ulysses
+        # or the XLA path (e.g. the GPipe stage body pins attn_impl="xla");
+        # without the name those policies silently degrade to full remat.
         if impl == "ulysses":
-            return ulysses_attention(q, k, v, mesh, causal=causal)
-        return ring_attention(q, k, v, mesh, causal=causal)
+            return checkpoint_name(
+                ulysses_attention(q, k, v, mesh, causal=causal), "attn_out"
+            )
+        return checkpoint_name(
+            ring_attention(q, k, v, mesh, causal=causal), "attn_out"
+        )
     if impl != "xla":
         from training_operator_tpu.trainer.flash import (
             FLASH_BWD_BLOCKS,
@@ -219,4 +229,5 @@ def attention(
                     fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
                     check_vma=False,
                 )(q, k, v)
-    return plain_attention(q, k, v, causal=causal)
+    # XLA fused path (see the "attn_out" note above).
+    return checkpoint_name(plain_attention(q, k, v, causal=causal), "attn_out")
