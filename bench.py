@@ -92,32 +92,54 @@ def _pct(sorted_vals, p):
 
 
 def build_workload(n_jobs: int, seed: int):
-    """Deterministic job mix. Returns a list of constructor thunks so each
-    run gets fresh objects."""
+    """Deterministic job mix: (kind, name, shape, workers, num_slices,
+    sim_duration, declared_duration). `declared` is what the user TELLS the
+    scheduler (ANNOTATION_EXPECTED_DURATION); `sim` is the truth. They start
+    equal (the oracle condition); perturb_declared() degrades them."""
     rng = random.Random(seed)
     specs = []
     for i in range(n_jobs):
         r = rng.random()
         dur = str(rng.randint(30, 120))
         if r < 0.35:
-            specs.append(("jax", f"jax-sub-{i}", "2x4", 2, 1, dur))
+            specs.append(("jax", f"jax-sub-{i}", "2x4", 2, 1, dur, dur))
         elif r < 0.55:
-            specs.append(("jax", f"jax-host-{i}", "1x4", 1, 1, dur))
+            specs.append(("jax", f"jax-host-{i}", "1x4", 1, 1, dur, dur))
         elif r < 0.70:
-            specs.append(("jax", f"jax-full-{i}", "4x4", 4, 1, dur))
+            specs.append(("jax", f"jax-full-{i}", "4x4", 4, 1, dur, dur))
         elif r < 0.75:
-            specs.append(("jax", f"jax-multi-{i}", "4x4", 8, 2, dur))
+            specs.append(("jax", f"jax-multi-{i}", "4x4", 8, 2, dur, dur))
         elif r < 0.90:
             gpus = rng.choice([4.0, 8.0])
             workers = rng.choice([2, 4])
-            specs.append(("gpu", f"ddp-{i}", gpus, workers, 1, dur))
+            specs.append(("gpu", f"ddp-{i}", gpus, workers, 1, dur, dur))
         else:
-            specs.append(("cpu", f"tf-{i}", 2.0, rng.choice([1, 2]), 1, dur))
+            specs.append(("cpu", f"tf-{i}", 2.0, rng.choice([1, 2]), 1, dur, dur))
     return specs
 
 
+def perturb_declared(specs, seed: int, noise_factor: float = 3.0, missing_frac: float = 0.0):
+    """Degrade the user estimates: multiply each declared duration by
+    exp(U(-ln f, +ln f)) — i.e. off by up to x/÷ `noise_factor` — and drop a
+    `missing_frac` share entirely (declared=None -> no annotation). The sim
+    (true) durations are untouched, so results compare directly against the
+    oracle-estimate runs."""
+    import math
+
+    rng = random.Random(seed ^ 0x5EED)
+    out = []
+    for kind, name, shape, workers, num_slices, dur, _decl in specs:
+        if missing_frac and rng.random() < missing_frac:
+            declared = None
+        else:
+            mult = math.exp(rng.uniform(-math.log(noise_factor), math.log(noise_factor)))
+            declared = str(max(1, round(float(dur) * mult)))
+        out.append((kind, name, shape, workers, num_slices, dur, declared))
+    return out
+
+
 def make_job(spec):
-    kind, name, shape, workers, num_slices, dur = spec
+    kind, name, shape, workers, num_slices, dur, declared = spec
     if kind == "jax":
         chips = 1
         for d in shape.split("x"):
@@ -127,7 +149,8 @@ def make_job(spec):
                                   resources={"cpu": 1.0, TPU_RESOURCE: 4.0})]
         )
         t.annotations[ANNOTATION_SIM_DURATION] = dur
-        t.annotations[ANNOTATION_EXPECTED_DURATION] = dur
+        if declared is not None:
+            t.annotations[ANNOTATION_EXPECTED_DURATION] = declared
         return JAXJob(
             metadata=ObjectMeta(name=name),
             replica_specs={"Worker": ReplicaSpec(replicas=workers, template=t)},
@@ -140,7 +163,8 @@ def make_job(spec):
                                   resources={"cpu": 2.0, GPU_RESOURCE: shape})]
         )
         t.annotations[ANNOTATION_SIM_DURATION] = dur
-        t.annotations[ANNOTATION_EXPECTED_DURATION] = dur
+        if declared is not None:
+            t.annotations[ANNOTATION_EXPECTED_DURATION] = declared
         return PyTorchJob(
             metadata=ObjectMeta(name=name),
             replica_specs={"Worker": ReplicaSpec(replicas=workers, template=t)},
@@ -150,7 +174,8 @@ def make_job(spec):
                               resources={"cpu": shape})]
     )
     t.annotations[ANNOTATION_SIM_DURATION] = dur
-    t.annotations[ANNOTATION_EXPECTED_DURATION] = dur
+    if declared is not None:
+        t.annotations[ANNOTATION_EXPECTED_DURATION] = declared
     return TFJob(
         metadata=ObjectMeta(name=name),
         replica_specs={"Worker": ReplicaSpec(replicas=workers, template=t)},
@@ -172,7 +197,7 @@ def oracle_bound(
 
     pools = {"tpu": tpu_chips, "gpu": gpus, "cpu": cpus}
     jobs = {"tpu": [], "gpu": [], "cpu": []}
-    for kind, _name, shape, workers, num_slices, dur in specs:
+    for kind, _name, shape, workers, num_slices, dur, _decl in specs:
         if kind == "jax":
             jobs["tpu"].append((_chips(shape) * num_slices, float(dur)))
         elif kind == "gpu":
@@ -228,7 +253,7 @@ def granular_oracle(
     gpu_free = [8.0] * N
     cpu_free = cpus
     jobs = []
-    for kind, _name, shape, workers, num_slices, dur in specs:
+    for kind, _name, shape, workers, num_slices, dur, _decl in specs:
         if kind == "jax":
             jobs.append(("tpu", _chips(shape) * num_slices, float(dur), shape, num_slices))
         elif kind == "gpu":
@@ -351,7 +376,8 @@ def granular_oracle(
     return {"p50_s": round(_pct(starts, 0.50), 3), "p90_s": round(_pct(starts, 0.90), 3), "p99_s": round(_pct(starts, 0.99), 3)}
 
 
-def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nodes=CPU_NODES):
+def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nodes=CPU_NODES,
+              return_latencies=False):
     cluster = Cluster(VirtualClock())
     cluster.add_nodes(make_tpu_pool(tpu_slices, slice_topology=SLICE_TOPOLOGY))
     cluster.add_nodes(make_gpu_pool(gpu_nodes, gpus_per_node=GPUS_PER_NODE, nodes_per_nvlink_domain=4))
@@ -440,10 +466,14 @@ def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nod
         raise RuntimeError(f"burst did not finish: {len(jobs) - len(finished)} jobs pending")
 
     latencies = []
+    by_name = {} if return_latencies else None
     for j in jobs:
         created = j.metadata.creation_time or 0.0
         if j.name in running_at:
-            latencies.append(running_at[j.name] - created)
+            lat = running_at[j.name] - created
+            latencies.append(lat)
+            if by_name is not None:
+                by_name[j.name] = lat
     latencies.sort()
 
     # Utilization post-hoc from pod lifetimes: chip-seconds / capacity.
@@ -456,7 +486,7 @@ def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nod
             end = p.status.finish_time if p.status.finish_time is not None else makespan
             busy_area += chips * (end - p.status.start_time)
     utilization = busy_area / (total_chips * makespan) if makespan else 0.0
-    return {
+    out = {
         "p50_s": round(_pct(latencies, 0.50), 3),
         "p90_s": round(_pct(latencies, 0.90), 3),
         "p99_s": round(_pct(latencies, 0.99), 3),
@@ -470,6 +500,11 @@ def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nod
         "bench_wall_s": round(wall, 1),
         "jobs_measured": len(latencies),
     }
+    if return_latencies:
+        # Diagnostic-only (never serialized into the headline JSON): the
+        # per-job latencies behind the percentiles, for tail analysis.
+        out["latencies_by_name"] = by_name
+    return out
 
 
 def _accelerator_reachable(timeout_s: float = 150.0) -> bool:
@@ -503,6 +538,13 @@ def main():
     ap.add_argument("--quick", action="store_true", help="100-job smoke run")
     ap.add_argument("--all-baselines", action="store_true",
                     help="also run the contiguity-aware first-fit straw-man")
+    ap.add_argument("--no-noise-sweep", action="store_true",
+                    help="skip the estimate-robustness packer runs "
+                         "(duration_noise block)")
+    ap.add_argument("--tail-breakdown", action="store_true",
+                    help="include per-job-class latency percentiles in the "
+                         "output (tail_by_class block) — the tail-latency "
+                         "diagnostic behind the README's analysis")
     trainer_group = ap.add_mutually_exclusive_group()
     trainer_group.add_argument("--no-trainer", action="store_true",
                                help="skip the single-chip trainer compute benchmark")
@@ -511,16 +553,25 @@ def main():
     args = ap.parse_args()
     n = 100 if args.quick else args.jobs
 
-    degraded = not _accelerator_reachable()
-    if degraded:
-        print(
-            "bench: accelerator backend unreachable (tunnel down?) — "
-            "forcing CPU for the scheduler bench, skipping the trainer block",
-            file=sys.stderr,
-        )
+    if args.no_trainer:
+        # Scheduler-only run: the solver is CPU-pinned regardless, so skip
+        # the (slow when the tunnel is dead) accelerator probe entirely and
+        # keep backend init off the possibly-hung TPU plugin.
+        degraded = True
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    else:
+        degraded = not _accelerator_reachable()
+        if degraded:
+            print(
+                "bench: accelerator backend unreachable (tunnel down?) — "
+                "forcing CPU for the scheduler bench, skipping the trainer block",
+                file=sys.stderr,
+            )
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
 
     trainer = None
     if not args.no_trainer:
@@ -547,7 +598,8 @@ def main():
     for s in seed_list:
         specs = build_workload(n, s)
         base = run_burst(specs, BaselinePlacer(whole_slice=True))
-        pack = run_burst(specs, TPUPacker())
+        pack = run_burst(specs, TPUPacker(),
+                         return_latencies=(args.tail_breakdown and s == args.seed))
         vs = round(base["p50_s"] / pack["p50_s"], 3) if pack["p50_s"] > 0 else None
         per_seed.append({
             "seed": s,
@@ -558,6 +610,51 @@ def main():
         if s == args.seed:
             primary = (specs, base, pack, vs)
     specs, base, pack, vs_primary = primary
+
+    # Per-class tail breakdown (primary seed): which job shapes populate
+    # the p90+ — the diagnostic behind the README tail-latency analysis.
+    tail_by_class = None
+    lat_by_name = pack.pop("latencies_by_name", None)
+    if lat_by_name:
+        import collections
+
+        by = collections.defaultdict(list)
+        for name, lat in lat_by_name.items():
+            by[name.rsplit("-", 1)[0]].append(lat)
+        tail_by_class = {
+            cls: {
+                "n": len(v),
+                "p50_s": round(_pct(sorted(v), 0.50), 1),
+                "p90_s": round(_pct(sorted(v), 0.90), 1),
+                "p99_s": round(_pct(sorted(v), 0.99), 1),
+            }
+            for cls, v in sorted(by.items())
+        }
+
+    # Estimate-robustness sweep (primary seed): the headline above is
+    # measured with EXACT declared durations — a best case no real user
+    # hits. Re-run the packer with degraded estimates (true durations, and
+    # therefore the baseline run, unchanged) so the claim carries its own
+    # sensitivity analysis instead of leaning on an oracle.
+    duration_noise = None
+    if not args.quick and not args.no_noise_sweep:
+        duration_noise = {}
+        for label, noise, missing in (
+            ("noise_x3", 3.0, 0.0),
+            ("missing30", 1.0, 0.30),
+            ("noise_x3_missing30", 3.0, 0.30),
+        ):
+            noisy = perturb_declared(specs, args.seed, noise_factor=noise,
+                                     missing_frac=missing)
+            run = run_burst(noisy, TPUPacker())
+            duration_noise[label] = {
+                "p50_s": run["p50_s"],
+                "p90_s": run["p90_s"],
+                "p99_s": run["p99_s"],
+                "vs_baseline": round(base["p50_s"] / run["p50_s"], 3)
+                if run["p50_s"] > 0 else None,
+            }
+
     oracle = oracle_bound(specs)
     goracle = granular_oracle(specs)
     ratios = sorted(e["vs_baseline"] for e in per_seed if e["vs_baseline"] is not None)
@@ -586,6 +683,10 @@ def main():
         "oracle_fungible": oracle,
         "oracle_granular": goracle,
     }
+    if duration_noise is not None:
+        out["duration_noise"] = duration_noise
+    if tail_by_class is not None:
+        out["tail_by_class"] = tail_by_class
     if trainer is not None:
         out["trainer"] = trainer
     if args.all_baselines:

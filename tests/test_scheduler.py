@@ -504,3 +504,115 @@ class TestWeightedSJF:
             demand=lambda r: r.total_chips(),
         )
         assert ordered[0].group.name == "old-big"
+
+
+class TestDrainPreassign:
+    """Tail-latency controls: starved whole-slice gangs get drained slices
+    handed to them directly; sticky reservations keep draining slices out
+    of smaller gangs' reach (packer drain_reserve_seconds/_drain_and_preassign)."""
+
+    def _env(self, slices=2):
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_tpu_pool(slices, slice_topology="4x4"))
+        mgr = OperatorManager(cluster, gang_enabled=True)
+        register_all(mgr)
+        return cluster, mgr
+
+    def _request(self, cluster, mgr, name, workers, topology, num_slices=1, created=0.0):
+        job = make_jax_job(name, workers=workers, topology=topology, num_slices=num_slices)
+        mgr.submit(job)
+        for _ in range(3):
+            cluster.step()
+        pg = cluster.api.get("PodGroup", "default", name)
+        pg.metadata.creation_time = created
+        return build_gang_request(cluster.api, pg)
+
+    def test_starved_whole_slice_gang_preassigned_before_kernel(self):
+        """A whole-slice gang past the drain threshold takes the fully-free
+        slice directly — the backlog of small gangs in the same batch must
+        not nibble it first despite their higher (smallest-work) priority."""
+        cluster, mgr = self._env(slices=1)
+        snap = ClusterSnapshot(cluster.api)
+        big = self._request(cluster, mgr, "big", 4, "4x4", created=0.0)
+        smalls = [
+            self._request(cluster, mgr, f"small-{i}", 1, "1x4", created=500.0)
+            for i in range(4)
+        ]
+        packer = TPUPacker(drain_reserve_seconds=150.0)
+        placements = packer.place([big] + smalls, snap, now=500.0)
+        assert placements[big.key] is not None
+        assert packer.last_drain_stats["preassigned_gangs"] == 1
+        # the one slice went whole to the starved gang; smalls wait
+        assert all(placements[s.key] is None for s in smalls)
+
+    def test_sticky_reservation_blocks_small_gangs_while_draining(self):
+        """A partially-busy slice under drain reservation is invisible to
+        small gangs even though it has free hosts."""
+        from training_operator_tpu.cluster.objects import Pod
+
+        cluster, mgr = self._env(slices=1)
+        # one busy host -> slice partially free (3 free hosts)
+        p = Pod(metadata=ObjectMeta(name="busy", namespace="default"))
+        p.spec.containers = [Container(name="c", resources={TPU_RESOURCE: 4.0})]
+        p.node_name = "slice-0-host-0"
+        p.status.phase = PodPhase.RUNNING
+        cluster.api.create(p)
+        snap = ClusterSnapshot(cluster.api)
+        big = self._request(cluster, mgr, "big", 4, "4x4", created=0.0)
+        small = self._request(cluster, mgr, "small", 1, "1x4", created=500.0)
+        packer = TPUPacker(drain_reserve_seconds=150.0)
+        placements = packer.place([big, small], snap, now=500.0)
+        # neither runs: big needs the whole slice (still draining), small is
+        # fenced off the reserved slice
+        assert placements[big.key] is None
+        assert placements[small.key] is None
+        assert packer.last_drain_stats["reserved_slices"] == 1
+        assert "slice-0" in packer._drain_set
+        # without the reservation the small gang WOULD have been placed
+        baseline = TPUPacker(drain_reserve_seconds=0)
+        placements2 = baseline.place([big, small], ClusterSnapshot(cluster.api), now=500.0)
+        assert placements2[small.key] is not None
+
+    def test_drain_disabled_by_default_profile_unchanged(self):
+        """drain_reserve_seconds=0 disables the mechanism entirely."""
+        cluster, mgr = self._env(slices=1)
+        snap = ClusterSnapshot(cluster.api)
+        big = self._request(cluster, mgr, "big", 4, "4x4", created=0.0)
+        packer = TPUPacker(drain_reserve_seconds=0)
+        placements = packer.place([big], snap, now=500.0)
+        # kernel still places it (slice is free) — but through the solve,
+        # not the preassign path
+        assert placements[big.key] is not None
+        assert packer.last_drain_stats == {}
+        assert packer._drain_set == set()
+
+    def test_multi_slice_starved_gang_accumulates_slices(self):
+        """A starved 2-slice gang with only one free slice keeps it reserved
+        (masked from others) until the second drains."""
+        from training_operator_tpu.cluster.objects import Pod
+
+        cluster, mgr = self._env(slices=2)
+        p = Pod(metadata=ObjectMeta(name="busy", namespace="default"))
+        p.spec.containers = [Container(name="c", resources={TPU_RESOURCE: 4.0})]
+        p.node_name = "slice-1-host-0"
+        p.status.phase = PodPhase.RUNNING
+        cluster.api.create(p)
+        snap = ClusterSnapshot(cluster.api)
+        multi = self._request(cluster, mgr, "multi", 8, "4x4", num_slices=2, created=0.0)
+        small = self._request(cluster, mgr, "small", 1, "1x4", created=500.0)
+        packer = TPUPacker(drain_reserve_seconds=150.0, max_drain_fraction=0.5)
+        placements = packer.place([multi, small], snap, now=500.0)
+        assert placements[multi.key] is None  # only 1 of 2 slices free
+        # slice-1 (partial) is sticky-reserved until it drains...
+        assert "slice-1" in packer._drain_set
+        # ...and the free slice-0 is ALSO effectively held: the aged multi
+        # gang at front priority claims it in the kernel every cycle (and
+        # forfeits, staying pending), so the small gang cannot nibble it —
+        # the accumulation behavior a 2-slice gang needs.
+        assert placements[small.key] is None
+        # Once the contender is gone the reservation clears (demand-driven)
+        # and the small gang places normally — by best-fit, onto the FULLER
+        # slice-1, which is no longer fenced.
+        placements2 = packer.place([small], ClusterSnapshot(cluster.api), now=500.0)
+        assert placements2[small.key] is not None
+        assert packer._drain_set == set()

@@ -28,7 +28,7 @@ single device program instead of 1k Python round-trips. Scoring axes:
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +45,14 @@ from training_operator_tpu.scheduler.snapshot import (
 )
 
 _NEG = np.int32(-(2**30))
+
+
+def _tolerations_sig(tolerations) -> Tuple:
+    """Hashable toleration identity for pod grouping (same canonical form
+    as GangRequest.toleration_sig / cluster.objects.toleration_key)."""
+    from training_operator_tpu.cluster.objects import toleration_key
+
+    return tuple(sorted(toleration_key(t) for t in tolerations or ()))
 
 
 def _next_pow2(n: int) -> int:
@@ -149,6 +157,8 @@ class TPUPacker:
         discipline: str = "wsjf-aging",
         aging_seconds: float = 300.0,
         default_expected_duration: float = 600.0,
+        drain_reserve_seconds: float = 300.0,
+        max_drain_fraction: float = 0.08,
     ) -> None:
         self.candidates = CandidateCache()
         self.last_solve_stats: Dict[str, float] = {}
@@ -167,6 +177,28 @@ class TPUPacker:
         self.discipline = discipline
         self.aging_seconds = aging_seconds
         self.default_expected_duration = default_expected_duration
+        # Tail-latency control: a whole-slice (or multi-slice) gang waiting
+        # longer than drain_reserve_seconds triggers DRAIN RESERVATIONS —
+        # the partially-free slices closest to empty are withheld from
+        # smaller gangs so they actually drain to fully-free, instead of
+        # small jobs perpetually backfilling every slice that large gangs
+        # starve behind (the p90/p99 pathology of pure smallest-work-first).
+        # At most max_drain_fraction of slices are withheld per cycle so the
+        # median path keeps its capacity. <=0 disables. Defaults (300s /
+        # 0.08) are the measured sweet spot on the 1k-burst bench: vs
+        # drain-off they trade nothing on p50 and improve p99 (-1.2%),
+        # utilization (+0.9pp), and makespan (-1%); aggressive settings
+        # (150s / 0.15) cut whole-slice p90 by ~20% but shift the tail onto
+        # sub-slice gangs — a class-fairness knob, not a free win (see
+        # README tail-latency section for the sweep).
+        self.drain_reserve_seconds = drain_reserve_seconds
+        self.max_drain_fraction = max_drain_fraction
+        # Sticky drain set (slice_id strings): a slice stays reserved across
+        # cycles until a starved gang consumes it or demand disappears —
+        # re-picking the "most free" slice fresh each cycle would abandon
+        # half-drained slices whenever another slice pulled ahead.
+        self._drain_set: set = set()
+        self.last_drain_stats: Dict[str, float] = {}
         # Candidate tensors cached across cycles: they depend only on the
         # slice inventory and the set of request classes, both of which are
         # stable between solves — rebuilding them in Python every cycle
@@ -248,6 +280,18 @@ class TPUPacker:
                 requests, key=lambda r: r.group.metadata.creation_time or 0.0
             )
         weigh = self.discipline == "wsjf-aging"
+        # Missing estimates are charged the MEDIAN of the batch's declared
+        # durations (robustness to partial adoption: a fixed pessimistic
+        # default sorts every estimate-less job behind ALL estimated ones,
+        # which under 30% missing turns "no estimate" into "scheduled last").
+        # Falls back to default_expected_duration when nobody declares.
+        missing_charge = self.default_expected_duration
+        if weigh:
+            declared = sorted(
+                r.expected_duration for r in requests if r.expected_duration
+            )
+            if declared:
+                missing_charge = declared[len(declared) // 2]
 
         def key(r: GangRequest):
             created = r.group.metadata.creation_time or 0.0
@@ -255,7 +299,7 @@ class TPUPacker:
                 return (0, created, 0.0)  # starved: FIFO at the front
             w = demand(r)
             if weigh:
-                w *= r.expected_duration or self.default_expected_duration
+                w *= r.expected_duration or missing_charge
             return (1, w, created)  # smallest work first
 
         return sorted(requests, key=key)
@@ -350,6 +394,127 @@ class TPUPacker:
         cache["dev"] = None  # packed tensors must pick up the new class
         return class_ids[key]
 
+    def _drain_and_preassign(
+        self,
+        requests: List[GangRequest],
+        slices: List[SliceInfo],
+        free: np.ndarray,
+        snapshot: ClusterSnapshot,
+        now: Optional[float],
+        out: Dict[str, Optional[Placement]],
+    ) -> Tuple[np.ndarray, frozenset]:
+        """Tail-latency mechanism for whole-slice gangs (see __init__).
+        Returns (masked free copy, reserved slice indices); writes direct
+        placements for satisfied starved gangs into `out`.
+
+        A whole-slice gang only runs when some slice is ENTIRELY free; with
+        best-fit backfill every slice stays partially busy indefinitely, so
+        priority promotion alone cannot help it (priority doesn't create a
+        free slice). Two coupled moves:
+
+        1. PRE-ASSIGN: starved whole-slice gangs (longest-waiting first)
+           take fully-free slices HERE, before the kernel runs — otherwise
+           the backlog of small gangs nibbles a freshly-drained slice in the
+           very cycle it finally empties (priority order alone cannot stop
+           that: small gangs fit where large ones don't).
+        2. STICKY RESERVE: for the still-unsatisfied slice demand, the
+           partially-free slices closest to empty are withheld from the
+           kernel until they drain; membership is sticky across cycles so a
+           half-drained slice is never abandoned mid-drain. Capped at
+           max_drain_fraction of slices so the median path keeps capacity.
+        """
+        if now is None or self.drain_reserve_seconds <= 0:
+            return free, frozenset()
+        starved: List[Tuple[float, GangRequest, List[int]]] = []
+        for req in requests:
+            created = req.group.metadata.creation_time or 0.0
+            if now - created < self.drain_reserve_seconds:
+                continue
+            if req.num_slices <= 0 or len(req.pods) % req.num_slices:
+                continue  # malformed gang: the kernel path skips it too
+            # Slices this gang could legally occupy WHOLE: tpu_type match
+            # and per-slice host need equal to the slice's host count (the
+            # same compatibility checks the kernel candidates apply).
+            compat = [
+                i for i, sl in enumerate(slices)
+                if (not req.tpu_type or sl.tpu_type == req.tpu_type)
+                and request_hosts_per_slice(req, sl.chips_per_host) == sl.num_hosts
+            ]
+            if compat:
+                starved.append((created, req, compat))
+        if not starved:
+            self._drain_set.clear()
+            self.last_drain_stats = {}
+            return free, frozenset()
+        starved.sort(key=lambda t: t[0])
+        free = free.copy()
+        avail = [
+            i for i, sl in enumerate(slices)
+            if bool(free[i, : sl.num_hosts].all())
+        ]
+        preassigned = 0
+        remaining: List[GangRequest] = []
+        for _, req, compat in starved:
+            k = req.num_slices
+            compat_set = set(compat)
+            usable = [
+                i for i in avail
+                if i in compat_set
+                and all(
+                    snapshot.tolerated(n, req.tolerations)
+                    for n in slices[i].host_nodes
+                )
+            ]
+            if len(usable) < k:
+                remaining.append(req)
+                continue
+            pods = req.sorted_pods()
+            pps = len(pods) // k
+            assignments: Dict[str, str] = {}
+            slices_used: List[str] = []
+            for sub, i in enumerate(usable[:k]):
+                sl = slices[i]
+                for pod, node in zip(pods[sub * pps : (sub + 1) * pps], sl.host_nodes):
+                    assignments[pod.name] = node
+                    snapshot.commit(pod.resources, node)
+                free[i, :] = False
+                avail.remove(i)
+                self._drain_set.discard(sl.slice_id)
+                slices_used.append(sl.slice_id)
+            out[req.key] = Placement(assignments=assignments, slices_used=slices_used)
+            preassigned += 1
+        demand = sum(r.num_slices for r in remaining)
+        cap = max(1, int(len(slices) * self.max_drain_fraction))
+        reserved: List[int] = []
+        if demand <= 0:
+            self._drain_set.clear()
+        else:
+            by_id = {sl.slice_id: i for i, sl in enumerate(slices)}
+            self._drain_set = {sid for sid in self._drain_set if sid in by_id}
+            reserved = [by_id[sid] for sid in self._drain_set]
+            need_more = min(demand, cap) - len(reserved)
+            if need_more > 0:
+                partial = sorted(
+                    (
+                        (int(free[i, : sl.num_hosts].sum()), i)
+                        for i, sl in enumerate(slices)
+                        if i not in self._drain_set
+                        and 0 < int(free[i, : sl.num_hosts].sum()) < sl.num_hosts
+                    ),
+                    reverse=True,
+                )
+                for _, i in partial[:need_more]:
+                    reserved.append(i)
+                    self._drain_set.add(slices[i].slice_id)
+            for i in reserved:
+                free[i, :] = False
+        self.last_drain_stats = {
+            "starved_gangs": float(len(starved)),
+            "preassigned_gangs": float(preassigned),
+            "reserved_slices": float(len(reserved)),
+        }
+        return free, frozenset(reserved)
+
     def _place_tpu_batch(
         self,
         requests: List[GangRequest],
@@ -372,6 +537,9 @@ class TPUPacker:
         for i, sl in enumerate(slices):
             for h, node in enumerate(sl.host_nodes):
                 free[i, h] = snapshot.host_free(node, sl.chips_per_host)
+        free, drain_reserved = self._drain_and_preassign(
+            requests, slices, free, snapshot, now, out
+        )
 
         # Expand to per-slice sub-items in priority order (see _order; the
         # order is conflict-resolution priority, not a gate — small gangs
@@ -383,6 +551,8 @@ class TPUPacker:
         ordered = self._order(requests, now, lambda r: r.total_chips())
         items: List[Tuple[GangRequest, int, int]] = []  # (req, sub_index, class)
         for req in ordered:
+            if out.get(req.key) is not None:
+                continue  # pre-assigned by the drain path above
             pods = req.sorted_pods()
             if req.num_slices <= 0 or len(pods) % req.num_slices:
                 continue
@@ -483,7 +653,8 @@ class TPUPacker:
             repaired = True
             for sub in dups:
                 alt = self._repair_duplicate_slice(
-                    class_cands[k], used_slices, kernel_taken, snapshot, slices
+                    class_cands[k], used_slices | drain_reserved, kernel_taken,
+                    snapshot, slices,
                 )
                 if alt is None:
                     repaired = False
@@ -584,9 +755,14 @@ class TPUPacker:
 
         ordered = self._order(requests, now, demand)
         for req in ordered:
-            assignments: Dict[str, str] = {}
-            committed: List[Tuple[np.ndarray, int]] = []
-            group_domains: set = set()
+            # Pods with identical (resources, tolerations) — the common case:
+            # a gang of k equal workers — are placed as ONE vectorized group:
+            # per-node fit counts, then greedy take in best-fit score order.
+            # Equivalent to per-pod sequential best-fit (filling a node only
+            # improves its best-fit rank until it no longer fits) but costs
+            # O(groups x nodes-touched) instead of O(pods x nodes) Python.
+            groups: List[Tuple[np.ndarray, Any, List[Any]]] = []
+            group_index: Dict[Tuple, int] = {}
             for pod in req.sorted_pods():
                 rv = np.zeros(len(res_keys))
                 for k, v in pod.resources.items():
@@ -594,34 +770,60 @@ class TPUPacker:
                         rv[ridx[k]] = v
                     elif v > 0:
                         rv[:] = np.inf  # unsatisfiable resource
-                feas = np.all(free >= rv, axis=1)
-                for i in tainted_cols:
-                    if not snapshot.tolerated(node_names[i], pod.tolerations):
-                        feas[i] = False
-                if not feas.any():
-                    for vec, i in committed:
-                        free[i] += vec
-                    assignments = {}
-                    break
-                # Best-fit on the requested dimensions, NVLink-domain
-                # locality as the tiebreak. Locality must NOT outrank
-                # best-fit: pulling a gang's later pods onto fully-free
-                # nodes of an already-used domain (over half-free nodes
-                # elsewhere) strands half-nodes across domains and starves
-                # whole-node gangs.
+                gkey = (tuple(rv), _tolerations_sig(pod.tolerations))
+                gi = group_index.get(gkey)
+                if gi is None:
+                    group_index[gkey] = len(groups)
+                    groups.append((rv, pod.tolerations, [pod]))
+                else:
+                    groups[gi][2].append(pod)
+
+            assignments: Dict[str, str] = {}
+            committed: List[Tuple[np.ndarray, int, int]] = []  # (rv, node, count)
+            group_domains: set = set()
+            placed_all = True
+            for rv, tolerations, pods in groups:
+                feas_base = np.isfinite(rv).all() and bool((free >= rv).all(axis=1).any())
                 requested = rv > 0
-                leftover = ((free - rv) * requested).sum(axis=1)
-                bonus = np.isin(domains, list(group_domains)) * 0.5 if group_domains else 0.0
-                score = np.where(feas, -leftover * 1024.0 + bonus, -np.inf)
-                i = int(np.argmax(score))
-                assignments[pod.name] = node_names[i]
-                free[i] -= rv
-                committed.append((rv, i))
-                group_domains.add(int(domains[i]))
-            if assignments:
+                remaining = list(pods)
+                tainted_bad = {
+                    i for i in tainted_cols
+                    if not snapshot.tolerated(node_names[i], tolerations)
+                }
+                while remaining:
+                    feas = np.all(free >= rv, axis=1) if feas_base else np.zeros(len(node_names), bool)
+                    for i in tainted_bad:
+                        feas[i] = False
+                    if not feas.any():
+                        placed_all = False
+                        break
+                    # Best-fit on the requested dimensions, NVLink-domain
+                    # locality as the tiebreak. Locality must NOT outrank
+                    # best-fit: pulling a gang's later pods onto fully-free
+                    # nodes of an already-used domain (over half-free nodes
+                    # elsewhere) strands half-nodes across domains and
+                    # starves whole-node gangs.
+                    leftover = ((free - rv) * requested).sum(axis=1)
+                    bonus = np.isin(domains, list(group_domains)) * 0.5 if group_domains else 0.0
+                    score = np.where(feas, -leftover * 1024.0 + bonus, -np.inf)
+                    i = int(np.argmax(score))
+                    with np.errstate(divide="ignore", invalid="ignore"):
+                        fits = np.where(requested, free[i] // np.where(requested, rv, 1.0), np.inf)
+                    cap = int(min(fits.min(), len(remaining))) if requested.any() else len(remaining)
+                    take, remaining = remaining[:cap], remaining[cap:]
+                    for pod in take:
+                        assignments[pod.name] = node_names[i]
+                    free[i] -= rv * len(take)
+                    committed.append((rv, i, len(take)))
+                    group_domains.add(int(domains[i]))
+                if not placed_all:
+                    break
+            if placed_all and assignments:
                 for pod in req.pods:
                     snapshot.commit(pod.resources, assignments[pod.name])
                 out[req.key] = Placement(assignments=assignments)
             else:
+                for rv, i, cnt in committed:
+                    free[i] += rv * cnt
                 out[req.key] = None
         return out
