@@ -246,3 +246,63 @@ class TestControlPlaneChaos:
             lambda: all(succeeded(cluster, j.name) for j in jobs), timeout=500
         )
         pause.stop()
+
+
+class TestGangChaos:
+    """The gang path (PodGroup admission, placement persistence, pod
+    binding) under injected control-plane conflicts + pod kills: the gang
+    scheduler must absorb ConflictErrors (skip + re-derive next tick),
+    never crash the cluster loop, and converge every TPU gang."""
+
+    def test_gang_jobs_converge_under_conflict_storm(self):
+        from training_operator_tpu.api.jobs import TPUPolicy
+        from training_operator_tpu.cluster.chaos import APIChaos, ChaosMonkey
+        from training_operator_tpu.cluster.inventory import TPU_RESOURCE, make_tpu_pool
+        from training_operator_tpu.scheduler import GangScheduler, TPUPacker
+
+        for seed in (21, 22):
+            cluster = Cluster(VirtualClock())
+            cluster.add_nodes(make_tpu_pool(2, slice_topology="4x4"))
+            DefaultScheduler(cluster)
+            kubelet = SimKubelet(cluster)
+            GangScheduler(cluster, TPUPacker(), min_solve_interval=0.25)
+            mgr = OperatorManager(cluster, gang_enabled=True, resync_period=30.0)
+            mgr.register(JAXController(cluster.api))
+            detector = DuplicatePodDetector(cluster)
+            chaos = APIChaos(cluster, seed=seed, conflict_rate=0.25,
+                             victims=[mgr._watch], drop_rate=0.15)
+            monkey = ChaosMonkey(cluster, kubelet, seed=seed, interval=9.0, budget=4)
+
+            jobs = []
+            for i in range(4):
+                tmpl = PodTemplateSpec(
+                    containers=[Container(
+                        name="jax", image="img",
+                        resources={"cpu": 1.0, TPU_RESOURCE: 4.0},
+                    )],
+                    annotations={ANNOTATION_SIM_DURATION: "12"},
+                )
+                jobs.append(JAXJob(
+                    metadata=ObjectMeta(name=f"gang-{seed}-{i}"),
+                    replica_specs={"Worker": ReplicaSpec(
+                        replicas=2, template=tmpl,
+                        restart_policy=RestartPolicy.EXIT_CODE,
+                    )},
+                    tpu_policy=TPUPolicy(accelerator="v5e-8", topology="2x4"),
+                ))
+            for j in jobs:
+                mgr.submit(j)
+
+            ok = cluster.run_until(
+                lambda: all(succeeded(cluster, j.name) for j in jobs),
+                timeout=3000,
+            )
+            assert ok, {
+                "conflicts": chaos.injected_conflicts,
+                "kills": len(monkey.kills),
+                "statuses": [cluster.api.get("JAXJob", "default", j.name).status
+                             for j in jobs],
+            }
+            assert detector.violations == []
+            assert chaos.injected_conflicts > 0
+            chaos.stop()

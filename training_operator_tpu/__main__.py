@@ -105,6 +105,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                     help="host role: HTTP API bind address")
     ap.add_argument("--api-server", default=None, metavar="URL",
                     help="operator role: base URL of the serving host")
+    ap.add_argument("--api-token", default=None,
+                    help="bearer token for the wire API: required of clients "
+                         "when the host sets it (env TPU_OPERATOR_API_TOKEN)")
     ap.add_argument(
         "--enable-scheme", action="append", default=None, metavar="SCHEME",
         help=f"enable a job scheme (repeatable); default: all of {ALL_SCHEMES}",
@@ -400,7 +403,12 @@ def run_host(args, cfg) -> int:
             resolve_period=cfg.resolve_period,
             min_solve_interval=cfg.min_solve_interval,
         )
-    server = ApiHTTPServer(cluster.api, port=args.serve_port, bind=args.serve_bind)
+    import os as _os
+
+    token = args.api_token or _os.environ.get("TPU_OPERATOR_API_TOKEN") or None
+    server = ApiHTTPServer(
+        cluster.api, port=args.serve_port, bind=args.serve_bind, token=token
+    )
     # Machine-parsable endpoint announcement (the e2e harness reads this).
     print(f"WIRE_API={server.url}", flush=True)
     log.info("host up: api=%s gang=%s", server.url, cfg.gang_scheduler_name)
@@ -433,7 +441,10 @@ def run_operator(args, cfg) -> int:
         raise SystemExit("--role operator requires --api-server URL")
     if args.workload:
         raise SystemExit("--workload is a standalone-role option; use the SDK remotely")
-    runtime = RemoteRuntime(RemoteAPIServer(args.api_server))
+    import os as _os
+
+    token = args.api_token or _os.environ.get("TPU_OPERATOR_API_TOKEN") or None
+    runtime = RemoteRuntime(RemoteAPIServer(args.api_server, token=token))
     mgr = OperatorManager(
         runtime,
         gang_enabled=cfg.gang_scheduler_name != "none",

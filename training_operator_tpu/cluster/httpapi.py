@@ -93,9 +93,16 @@ class ApiHTTPServer:
         port: int = 0,
         bind: str = "127.0.0.1",
         session_ttl: float = 120.0,
+        token: Optional[str] = None,
     ):
+        """`token`: require `Authorization: Bearer <token>` on every route
+        except /healthz and /readyz (probes stay open, like kubelet probes)
+        — the secure-serving analogue of the reference's cert-gated
+        apiserver connection (pkg/cert/cert.go:45), minus the rotation an
+        in-process CA would be theater for."""
         self.api = api
         self.session_ttl = session_ttl
+        self.token = token
         # watch_id -> (WatchQueue, last_access_monotonic)
         self._sessions: Dict[str, List[Any]] = {}
         self._sessions_lock = threading.Lock()
@@ -179,7 +186,17 @@ class ApiHTTPServer:
         head = parts[0]
         if head in ("healthz", "readyz"):
             h._send(200, {"ok": True})
-        elif head == "objects":
+            return
+        if self.token is not None:
+            import hmac
+
+            supplied = h.headers.get("Authorization", "")
+            if not hmac.compare_digest(
+                supplied.encode(), f"Bearer {self.token}".encode()
+            ):
+                h._send(401, {"error": "Unauthorized", "message": "bad or missing bearer token"})
+                return
+        if head == "objects":
             self._objects(h, method, parts[1:], q)
         elif head == "watches":
             self._watches(h, method, parts[1:], q)
@@ -350,9 +367,10 @@ class RemoteAPIServer:
     admission runs server-side no matter which client connects.
     """
 
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    def __init__(self, base_url: str, timeout: float = 30.0, token: Optional[str] = None):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.token = token
 
     # -- transport ---------------------------------------------------------
 
@@ -367,10 +385,10 @@ class RemoteAPIServer:
         if query:
             url += "?" + urllib.parse.urlencode(query)
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(
-            url, data=data, method=method,
-            headers={"Content-Type": "application/json"},
-        )
+        headers = {"Content-Type": "application/json"}
+        if self.token is not None:
+            headers["Authorization"] = f"Bearer {self.token}"
+        req = urllib.request.Request(url, data=data, method=method, headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return json.loads(resp.read() or b"{}")
@@ -391,6 +409,10 @@ class RemoteAPIServer:
                 raise ConflictError(msg) from None
             if e.code == 422:
                 raise ValueError(msg) from None
+            if e.code == 401:
+                # Auth failures are config errors, not transients — the
+                # operator loop must NOT retry these silently forever.
+                raise PermissionError(msg) from None
             raise RuntimeError(f"{method} {path}: {e.code} {msg}") from None
         except (urllib.error.URLError, OSError) as e:
             # Connection refused/reset, DNS, socket timeout: retryable.

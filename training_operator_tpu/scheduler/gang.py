@@ -344,10 +344,10 @@ class GangScheduler:
                 live.reserved_nodes = list(placement.reserved_nodes)
                 live.placement_score = placement.score
                 live.phase = PodGroupPhase.INQUEUE
-                self._persist(live)
-                metrics.podgroups_admitted.inc()
-                self._event(live, "Normal", "GangAdmitted",
-                            f"placed on {len(set(placement.assignments.values()))} nodes")
+                if self._persist(live):
+                    metrics.podgroups_admitted.inc()
+                    self._event(live, "Normal", "GangAdmitted",
+                                f"placed on {len(set(placement.assignments.values()))} nodes")
             else:
                 # Track attempts scheduler-side without an API write per
                 # cycle — persisting every failed attempt would look like
@@ -368,11 +368,36 @@ class GangScheduler:
         the fresh copy, so the follow-up update is version-check safe."""
         return self.api.try_get("PodGroup", pg.namespace, pg.name)
 
-    def _persist(self, pg: PodGroup) -> None:
+    def _persist(self, pg: PodGroup) -> bool:
         """Version-checked write + write-through of this component's cache
-        so same-tick readers see the new state before the watch echo."""
-        self.api.update(pg, check_version=True)
+        so same-tick readers see the new state before the watch echo.
+
+        A conflict (concurrent writer won between our fresh read and this
+        write, or an injected control-plane fault) is absorbed, not raised:
+        the cached copy is dropped and every phase is re-marked dirty so
+        the next tick re-reads and re-derives against the winner's state —
+        retrying unversioned here could silently revert their write."""
+        from training_operator_tpu.cluster.apiserver import ConflictError
+
+        try:
+            self.api.update(pg, check_version=True)
+        except ConflictError:
+            # Replace the cached copy with the WINNER's live state (not a
+            # pop: this cache is the scheduler's only view of the group —
+            # dropping it with no future watch event would make the gang
+            # invisible forever) and re-derive every phase next tick.
+            key = f"{pg.namespace}/{pg.name}"
+            live = self.api.try_get("PodGroup", pg.namespace, pg.name)
+            if live is not None:
+                self._groups[key] = live
+            else:
+                self._groups.pop(key, None)
+            self._solve_dirty = True
+            self._bind_dirty = True
+            self._advance_dirty = True
+            return False
         self._groups[f"{pg.namespace}/{pg.name}"] = pg
+        return True
 
     def _check_timeouts(self, groups: List[PodGroup]) -> None:
         now = self.cluster.clock.now()
@@ -390,9 +415,12 @@ class GangScheduler:
                     continue
                 live.phase = PodGroupPhase.UNSCHEDULABLE
                 live.creation_attempts = self._attempts.get(pg.metadata.uid, 0)
-                self._event(live, "Warning", "Unschedulable",
-                            f"no feasible placement after {timeout}s")
-                self._persist(live)
+                if self._persist(live):
+                    # Event only when the transition actually landed — a
+                    # conflict retries next tick, and an unconditional
+                    # event would duplicate every cycle until it does.
+                    self._event(live, "Warning", "Unschedulable",
+                                f"no feasible placement after {timeout}s")
 
     # ------------------------------------------------------------------
 
@@ -419,9 +447,9 @@ class GangScheduler:
                     continue
                 live.phase = PodGroupPhase.PENDING
                 live.placement = {}
-                self._persist(live)
-                self._event(live, "Warning", "PlacementInvalidated",
-                            f"node {target} is gone; re-solving")
+                if self._persist(live):  # conflict: re-derived next tick
+                    self._event(live, "Warning", "PlacementInvalidated",
+                                f"node {target} is gone; re-solving")
                 continue
             bind_pod(self.api, pod, target, now=self.cluster.clock.now())
             self._unbound.pop(key, None)

@@ -432,13 +432,17 @@ class TPUPacker:
                 continue
             if req.num_slices <= 0 or len(req.pods) % req.num_slices:
                 continue  # malformed gang: the kernel path skips it too
-            # Slices this gang could legally occupy WHOLE: tpu_type match
-            # and per-slice host need equal to the slice's host count (the
-            # same compatibility checks the kernel candidates apply).
+            pps = len(req.pods) // req.num_slices
+            # Slices this gang could legally occupy WHOLE: tpu_type match,
+            # per-slice host need equal to the slice's host count, AND one
+            # pod per host (the same checks the kernel candidates apply —
+            # _class_of rejects need != pods_per_slice; without it the
+            # zip(pods, host_nodes) below would silently truncate).
             compat = [
                 i for i, sl in enumerate(slices)
                 if (not req.tpu_type or sl.tpu_type == req.tpu_type)
                 and request_hosts_per_slice(req, sl.chips_per_host) == sl.num_hosts
+                and pps == sl.num_hosts
             ]
             if compat:
                 starved.append((created, req, compat))
@@ -453,7 +457,7 @@ class TPUPacker:
             if bool(free[i, : sl.num_hosts].all())
         ]
         preassigned = 0
-        remaining: List[GangRequest] = []
+        remaining: List[Tuple[GangRequest, List[int]]] = []
         for _, req, compat in starved:
             k = req.num_slices
             compat_set = set(compat)
@@ -466,7 +470,7 @@ class TPUPacker:
                 )
             ]
             if len(usable) < k:
-                remaining.append(req)
+                remaining.append((req, compat))
                 continue
             pods = req.sorted_pods()
             pps = len(pods) // k
@@ -483,22 +487,44 @@ class TPUPacker:
                 slices_used.append(sl.slice_id)
             out[req.key] = Placement(assignments=assignments, slices_used=slices_used)
             preassigned += 1
-        demand = sum(r.num_slices for r in remaining)
+        demand = sum(r.num_slices for r, _ in remaining)
         cap = max(1, int(len(slices) * self.max_drain_fraction))
         reserved: List[int] = []
         if demand <= 0:
             self._drain_set.clear()
         else:
+            # A reservation only helps a gang that could occupy the slice:
+            # restrict membership to the union of the remaining starved
+            # gangs' compatible slices (a drained v4 slice helps no v5e
+            # gang, it just idles capacity).
+            compat_union: set = set()
+            for _, compat in remaining:
+                compat_union.update(compat)
             by_id = {sl.slice_id: i for i, sl in enumerate(slices)}
-            self._drain_set = {sid for sid in self._drain_set if sid in by_id}
+            self._drain_set = {
+                sid for sid in self._drain_set
+                if sid in by_id and by_id[sid] in compat_union
+            }
             reserved = [by_id[sid] for sid in self._drain_set]
-            need_more = min(demand, cap) - len(reserved)
+            target = min(demand, cap)
+            if len(reserved) > target:
+                # Demand shrank: release the least-drained extras (fewest
+                # free hosts = furthest from helping anyone).
+                reserved.sort(
+                    key=lambda i: int(free[i, : slices[i].num_hosts].sum()),
+                    reverse=True,
+                )
+                for i in reserved[target:]:
+                    self._drain_set.discard(slices[i].slice_id)
+                reserved = reserved[:target]
+            need_more = target - len(reserved)
             if need_more > 0:
                 partial = sorted(
                     (
                         (int(free[i, : sl.num_hosts].sum()), i)
                         for i, sl in enumerate(slices)
-                        if i not in self._drain_set
+                        if i in compat_union
+                        and i not in {by_id[s] for s in self._drain_set}
                         and 0 < int(free[i, : sl.num_hosts].sum()) < sl.num_hosts
                     ),
                     reverse=True,

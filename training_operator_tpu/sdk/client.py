@@ -50,18 +50,20 @@ class TrainingClient:
         cluster: Union[Cluster, str],
         namespace: str = "default",
         job_kind: str = "JAXJob",
+        api_token: Optional[str] = None,
     ):
         """`cluster` is either an in-process Cluster or a base URL string
         ("http://127.0.0.1:8443") of a serving host process — the remote
         mode mirroring the reference client's REST relationship with the
-        kube-apiserver (training_client.py:41)."""
+        kube-apiserver (training_client.py:41). `api_token` is the bearer
+        token for a token-gated host (remote mode only)."""
         if isinstance(cluster, str):
             from training_operator_tpu.cluster.httpapi import (
                 RemoteAPIServer,
                 RemoteRuntime,
             )
 
-            cluster = RemoteRuntime(RemoteAPIServer(cluster))
+            cluster = RemoteRuntime(RemoteAPIServer(cluster, token=api_token))
         self.cluster = cluster
         self.api = cluster.api
         self.namespace = namespace
