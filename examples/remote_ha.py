@@ -1,0 +1,145 @@
+"""The wire deployment, end to end: apiserver host + two operator replicas
+as REAL OS processes, a job submitted over HTTP, the elected leader killed
+mid-run, and the standby converging the work.
+
+This is the reference's production shape — operator pods with
+--enable-leader-election against a kube-apiserver
+(cmd/training-operator.v1/main.go:134-166) — on the TPU-native substrate:
+`--role host` serves the cluster over HTTP (scheduler + kubelet + admission
+live there), `--role operator` runs only controllers + leader election
+against it, and `TrainingClient("http://...")` is the remote SDK.
+
+Run: python examples/remote_ha.py
+"""
+
+import os as _os
+import signal
+import subprocess
+import sys as _sys
+import time
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import training_operator_tpu.api.common as capi
+from training_operator_tpu.api.common import Container, PodTemplateSpec, ReplicaSpec
+from training_operator_tpu.api.jobs import JAXJob, ObjectMeta
+from training_operator_tpu.cluster.httpapi import RemoteAPIServer
+from training_operator_tpu.cluster.runtime import ANNOTATION_SIM_DURATION
+from training_operator_tpu.controllers.leader import DEFAULT_LEASE_NAME
+from training_operator_tpu.sdk.client import TrainingClient
+
+REPO = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+
+
+def _read_announcement(proc, prefix, timeout=30.0):
+    """select()-gated stdout scan for a `prefix...` line: a silent-but-alive
+    process trips the deadline instead of blocking readline() forever (and
+    leaking children past the finally block)."""
+    import select
+
+    deadline = time.monotonic() + timeout
+    buf = ""
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"process exited rc={proc.returncode} before {prefix}")
+        ready, _, _ = select.select([proc.stdout], [], [], 0.2)
+        if not ready:
+            continue
+        chunk = _os.read(proc.stdout.fileno(), 4096).decode(errors="replace")
+        buf += chunk
+        for line in buf.splitlines():
+            if line.startswith(prefix):
+                return line.strip().split("=", 1)[1]
+    raise RuntimeError(f"no {prefix} announcement within {timeout}s")
+
+
+def spawn(*args):
+    return subprocess.Popen(
+        [_sys.executable, "-m", "training_operator_tpu", *args],
+        cwd=REPO, text=True, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env={**_os.environ, "PYTHONPATH": REPO, "PYTHONUNBUFFERED": "1"},
+    )
+
+
+def main():
+    import json
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False) as f:
+        json.dump({"cpu_pools": [{"nodes": 2, "cpu_per_node": 8.0}]}, f)
+        inv = f.name
+
+    host = spawn("--role", "host", "--serve-port", "0",
+                 "--gang-scheduler-name", "none", "--cluster", inv)
+    procs = [host]
+    try:
+        url = _read_announcement(host, "WIRE_API=", timeout=30.0)
+        print(f"host up at {url}")
+
+        ops = {}
+        for ident in ("op-a", "op-b"):
+            p = spawn("--role", "operator", "--api-server", url,
+                      "--enable-scheme", "jax", "--gang-scheduler-name", "none",
+                      "--enable-leader-election", "--leader-identity", ident,
+                      "--leader-lease-seconds", "2")
+            procs.append(p)
+            ops[ident] = p
+        print("two operator replicas racing one lease...")
+
+        api = RemoteAPIServer(url)
+        client = TrainingClient(url)
+        lease = None
+        for _ in range(300):
+            lease = api.try_get("Lease", "operator-system", DEFAULT_LEASE_NAME)
+            if lease is not None and lease.holder in ops:
+                break
+            time.sleep(0.1)
+        assert lease is not None and lease.holder in ops, (
+            f"no operator won the lease in time: {lease}"
+        )
+        leader = lease.holder
+        standby = next(i for i in ops if i != leader)
+        print(f"leader: {leader}  standby: {standby}")
+
+        job = JAXJob(
+            metadata=ObjectMeta(name="ha-demo"),
+            replica_specs={"Worker": ReplicaSpec(
+                replicas=2,
+                template=PodTemplateSpec(
+                    containers=[Container(name="jax", image="trainer",
+                                          resources={"cpu": 1.0})],
+                    annotations={ANNOTATION_SIM_DURATION: "5"},
+                ),
+            )},
+        )
+        client.create_job(job)
+        client.wait_for_job_conditions(
+            "ha-demo", expected_conditions=(capi.JobConditionType.RUNNING,),
+            timeout=30,
+        )
+        print(f"job running under {leader}; kill -9 the leader")
+        ops[leader].send_signal(signal.SIGKILL)
+        ops[leader].wait()
+
+        done = client.wait_for_job_conditions(
+            "ha-demo", expected_conditions=(capi.JobConditionType.SUCCEEDED,),
+            timeout=60,
+        )
+        lease = api.get("Lease", "operator-system", DEFAULT_LEASE_NAME)
+        assert lease.holder == standby and capi.is_succeeded(done.status)
+        print(f"standby {lease.holder} took the lease (transition "
+              f"{lease.transitions}) and converged the job: Succeeded")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        _os.unlink(inv)
+
+
+if __name__ == "__main__":
+    main()

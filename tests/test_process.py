@@ -154,3 +154,90 @@ class TestSecureMetrics:
 def test_non_ascii_metrics_token_rejected():
     with pytest.raises(ValueError):
         OperatorConfig(metrics_token="café").validate()
+
+
+class TestWireRoles:
+    """CLI surface of the host/operator roles (the wire deployment)."""
+
+    def test_host_rejects_virtual_clock(self):
+        with pytest.raises(SystemExit):
+            process.main(["--role", "host", "--virtual-clock"])
+
+    def test_host_rejects_workload(self, tmp_path):
+        wl = tmp_path / "w.json"
+        wl.write_text("[]")
+        with pytest.raises(SystemExit):
+            process.main(["--role", "host", "--workload", str(wl)])
+
+    def test_operator_requires_api_server(self):
+        with pytest.raises(SystemExit):
+            process.main(["--role", "operator"])
+
+    def test_operator_rejects_workload(self, tmp_path):
+        wl = tmp_path / "w.json"
+        wl.write_text("[]")
+        with pytest.raises(SystemExit):
+            process.main([
+                "--role", "operator", "--api-server", "http://127.0.0.1:1",
+                "--workload", str(wl),
+            ])
+
+    def test_nonpositive_lease_duration_rejected(self):
+        with pytest.raises(ValueError):
+            process.main(["--leader-lease-seconds", "0", "--run-seconds", "0.1"])
+
+    def test_host_serves_and_exits_on_deadline(self, tmp_path):
+        """--role host with --run-seconds: comes up (WIRE_API reachable,
+        presets installed, admission live) and exits at the deadline."""
+        import json as _json
+        import threading
+        import urllib.request
+
+        from training_operator_tpu.cluster.httpapi import RemoteAPIServer
+
+        inv = tmp_path / "c.json"
+        inv.write_text('{"cpu_pools": [{"nodes": 1, "cpu_per_node": 4.0}]}')
+        # Capture the announced URL by running main in a thread with a
+        # patched stdout... simpler: pick a free port explicitly.
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        t = threading.Thread(
+            target=process.main,
+            args=([
+                "--role", "host", "--serve-port", str(port),
+                # Long enough that late-binding under CI load can't close
+                # the server while the assertions below still run.
+                "--cluster", str(inv), "--run-seconds", "12",
+                "--gang-scheduler-name", "none",
+            ],),
+        )
+        t.start()
+        try:
+            api = RemoteAPIServer(f"http://127.0.0.1:{port}", timeout=5.0)
+            import time as _time
+
+            for _ in range(8 * 10):
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=1
+                    ) as r:
+                        assert _json.loads(r.read())["ok"]
+                    break
+                except OSError:
+                    _time.sleep(0.1)
+            else:
+                raise AssertionError("host never became healthy")
+            # presets installed by the host
+            assert api.try_get("ClusterTrainingRuntime", "", "tpu-jax-default") is not None
+            # v1 admission enforced server-side
+            from training_operator_tpu.api.jobs import JAXJob, ObjectMeta
+
+            with pytest.raises(ValueError):
+                api.create(JAXJob(metadata=ObjectMeta(name="Bad!")))
+        finally:
+            t.join(timeout=30)
+        assert not t.is_alive()
