@@ -645,3 +645,37 @@ class TestVisionFamily:
         assert abs(got - want) < 1e-2, (got, want)
         params2, opt_state2, m = step2(params2, opt_state2, batch)
         assert np.isfinite(float(m["loss"]))
+
+
+class TestRematNames:
+    """The save_attn* remat policies depend on the 'attn_out' checkpoint
+    name being bound on EVERY attention backend — the flash custom_vjp
+    names it internally, and the dispatch names the ring/Ulysses/XLA
+    outputs (advisor r3: under GPipe the stage body pins attn_impl='xla',
+    which previously had no name, silently degrading save_attn to full
+    remat). Guard: the name survives into the jaxpr."""
+
+    def test_attn_out_named_on_xla_path(self):
+        import jax
+        import jax.numpy as jnp
+
+        from training_operator_tpu.trainer.attention import attention
+
+        q = jnp.zeros((1, 8, 2, 16))
+        jaxpr = str(jax.make_jaxpr(lambda a, b, c: attention(a, b, c, impl="xla"))(q, q, q))
+        assert "attn_out" in jaxpr
+
+    def test_attn_out_named_on_ring_and_ulysses(self):
+        import jax
+        import jax.numpy as jnp
+
+        from training_operator_tpu.trainer.attention import attention
+        from training_operator_tpu.trainer.mesh import MeshSpec, build_mesh
+
+        mesh = build_mesh(MeshSpec({"sequence": 2}))
+        q = jnp.zeros((1, 8, 2, 16))
+        for impl in ("ring", "ulysses"):
+            jaxpr = str(jax.make_jaxpr(
+                lambda a, b, c: attention(a, b, c, mesh=mesh, impl=impl)
+            )(q, q, q))
+            assert "attn_out" in jaxpr, impl
