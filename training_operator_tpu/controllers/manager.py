@@ -179,6 +179,7 @@ class OperatorManager:
             self._handle_event(ev)
         for key in self.queue.drain(limit=self.reconciles_per_tick):
             self._process(key)
+        metrics.workqueue_depth.set(value=float(len(self.queue)))
 
     def _handle_event(self, ev) -> None:
         kind = ev.kind
@@ -232,11 +233,18 @@ class OperatorManager:
         if entry is None:
             return
         _, jc = entry
+        import time as _time
+
+        t0 = _time.perf_counter()
         try:
             jc.reconcile(ns, name)
         except Exception:
             log.exception("reconcile failed for %s", key)
+            metrics.reconcile_total.inc(kind, "error")
             delay = self.queue.failure_delay(key)
             self.cluster.schedule_after(delay, lambda: self.queue.add(key))
         else:
+            metrics.reconcile_total.inc(kind, "success")
             self.queue.forget(key)
+        finally:
+            metrics.reconcile_seconds.observe(_time.perf_counter() - t0)

@@ -176,3 +176,20 @@ scheduler_solve_seconds = registry.histogram(
     "training_operator_scheduler_solve_seconds",
     "Wall time of gang-scheduler placement solves",
 )
+# controller-runtime parity: per-reconcile latency + outcome and live
+# workqueue depth (controller_runtime_reconcile_time_seconds /
+# controller_runtime_reconcile_total / workqueue_depth).
+reconcile_seconds = registry.histogram(
+    "training_operator_reconcile_seconds",
+    "Wall time of one reconcile pass (all kinds)",
+)
+reconcile_total = registry.counter(
+    "training_operator_reconcile_total",
+    "Reconcile passes by kind and result",
+    ("kind", "result"),  # result: success | error
+)
+workqueue_depth = registry.gauge(
+    "training_operator_workqueue_depth",
+    "Keys pending in the manager workqueue after the current tick",
+    (),
+)
