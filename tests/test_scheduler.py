@@ -616,3 +616,52 @@ class TestDrainPreassign:
         placements2 = packer.place([small], ClusterSnapshot(cluster.api), now=500.0)
         assert placements2[small.key] is not None
         assert packer._drain_set == set()
+
+
+class TestEstimateRobustness:
+    """WSJF ordering under degraded estimates: estimate-less gangs are
+    charged the batch's MEDIAN declared duration (not a pessimistic
+    constant that would send them to the back of every queue)."""
+
+    def _req(self, cluster, mgr, name, workers, topology, created, duration=None):
+        job = make_jax_job(name, workers=workers, topology=topology)
+        if duration is not None:
+            from training_operator_tpu.scheduler.snapshot import (
+                ANNOTATION_EXPECTED_DURATION,
+            )
+
+            for spec in job.replica_specs.values():
+                spec.template.annotations[ANNOTATION_EXPECTED_DURATION] = str(duration)
+        mgr.submit(job)
+        for _ in range(3):
+            cluster.step()
+        pg = cluster.api.get("PodGroup", "default", name)
+        pg.metadata.creation_time = created
+        return build_gang_request(cluster.api, pg)
+
+    def test_missing_estimate_charged_batch_median(self):
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_tpu_pool(4, slice_topology="4x4"))
+        mgr = OperatorManager(cluster, gang_enabled=True)
+        register_all(mgr)
+        # Same shape/demand; only the declared duration differs.
+        short = self._req(cluster, mgr, "short", 1, "1x4", created=0.0, duration=10)
+        long = self._req(cluster, mgr, "long", 1, "1x4", created=0.0, duration=1000)
+        nodecl = self._req(cluster, mgr, "nodecl", 1, "1x4", created=0.0)
+        packer = TPUPacker(default_expected_duration=600.0)
+        ordered = packer._order([long, nodecl, short], now=1.0,
+                                demand=lambda r: r.total_chips())
+        names = [r.group.name for r in ordered]
+        # Median of declared = (10+1000)/2-ish -> sorted() median picks 1000
+        # for an even list's upper middle; with [10, 1000] the charge is
+        # 1000, so nodecl ties with long and FIFO (creation) breaks it.
+        # The essential property: nodecl must NOT be dead-last merely for
+        # declaring nothing when the batch median is small.
+        assert names[0] == "short"
+        # And with a batch whose median is small, the estimate-less gang
+        # outranks a declared-long gang:
+        short2 = self._req(cluster, mgr, "short2", 1, "1x4", created=0.0, duration=20)
+        ordered2 = packer._order([long, nodecl, short, short2], now=1.0,
+                                 demand=lambda r: r.total_chips())
+        names2 = [r.group.name for r in ordered2]
+        assert names2.index("nodecl") < names2.index("long"), names2
