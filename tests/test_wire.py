@@ -485,3 +485,56 @@ class TestWireAuth:
             assert client.api.try_get("Pod", "ns1", "w-0") is not None
         finally:
             server.close()
+
+
+class TestCodecFuzz:
+    """Randomized round-trip: the codec must be lossless for arbitrary
+    populated model objects, not just the hand-picked fixtures above."""
+
+    def test_randomized_jobs_round_trip(self):
+        import random
+
+        rng = random.Random(1234)
+        kinds = [JAXJob, PyTorchJob, TFJob, MPIJob]
+        for i in range(50):
+            cls = rng.choice(kinds)
+            job = cls(
+                metadata=ObjectMeta(
+                    name=f"f{i}", namespace=rng.choice(["default", "ns2", ""]),
+                    labels={f"k{j}": f"v{j}" for j in range(rng.randint(0, 3))},
+                    annotations={"n": str(rng.random())},
+                    resource_version=rng.randint(0, 9),
+                ),
+                replica_specs={
+                    rng.choice(["Worker", "Master"]): ReplicaSpec(
+                        replicas=rng.choice([None, 1, 4]),
+                        template=PodTemplateSpec(
+                            containers=[Container(
+                                name="c", image="i",
+                                command=["run"] * rng.randint(0, 2),
+                                env={"A": "1"} if rng.random() < 0.5 else {},
+                                resources={"cpu": rng.choice([0.5, 2.0])},
+                            )],
+                            tolerations=[{"key": "t", "operator": "Exists"}]
+                            if rng.random() < 0.3 else [],
+                            restart_policy=rng.choice(list(RestartPolicy) + [None]),
+                        ),
+                    )
+                },
+                run_policy=RunPolicy(
+                    backoff_limit=rng.choice([None, 0, 3]),
+                    ttl_seconds_after_finished=rng.choice([None, 60]),
+                    suspend=rng.random() < 0.2,
+                ),
+                tpu_policy=TPUPolicy(
+                    topology=rng.choice([None, "2x4"]),
+                    num_slices=rng.randint(1, 3),
+                    mesh_axes={"data": 2} if rng.random() < 0.5 else {},
+                ) if rng.random() < 0.5 else None,
+            )
+            capi.update_job_conditions(
+                job.status, rng.choice(list(JobConditionType)), True, "R", "m",
+                now=float(i),
+            )
+            out = wire.decode(wire.encode(job))
+            assert out == job and type(out) is cls, (cls, i)
