@@ -265,3 +265,67 @@ class TestReconcileMetrics:
         rendered = m.registry.render()
         assert "training_operator_reconcile_seconds" in rendered
         assert "training_operator_workqueue_depth" in rendered
+
+
+class TestStackWiring:
+    """build_stack must include the HPA control loop (kube-controller-
+    manager's role): an elastic job scales with NO manually-attached
+    autoscaler — the process stack provides it."""
+
+    def test_elastic_scales_through_process_stack(self):
+        import json as _json
+
+        import training_operator_tpu.api.common as capi
+        from training_operator_tpu.api.common import (
+            Container,
+            PodTemplateSpec,
+            ReplicaSpec,
+        )
+        from training_operator_tpu.api.jobs import (
+            ElasticPolicy,
+            ObjectMeta,
+            PyTorchJob,
+        )
+        from training_operator_tpu.cluster.inventory import (
+            GPU_RESOURCE,
+            make_gpu_pool,
+        )
+        from training_operator_tpu.cluster.runtime import Cluster, VirtualClock
+        from training_operator_tpu.scheduler.elastic import (
+            ANNOTATION_LOAD_PROFILE_PREFIX,
+        )
+
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_gpu_pool(8, gpus_per_node=8))
+        cfg = OperatorConfig()
+        mgr, _v2 = process.build_stack(cluster, cfg)
+
+        template = PodTemplateSpec(
+            containers=[Container(name="pytorch", image="t",
+                                  resources={"cpu": 2.0, GPU_RESOURCE: 8.0})]
+        )
+        template.annotations[ANNOTATION_LOAD_PROFILE_PREFIX + "gpu_util"] = _json.dumps(
+            [[0, 70.0], [40, 140.0]]
+        )
+        job = PyTorchJob(
+            metadata=ObjectMeta(name="stack-elastic"),
+            replica_specs={"Worker": ReplicaSpec(replicas=2, template=template)},
+            elastic_policy=ElasticPolicy(
+                min_replicas=2, max_replicas=4,
+                metrics=[{"name": "gpu_util", "target": 70.0}],
+            ),
+        )
+        mgr.submit(job)
+
+        def running():
+            return [
+                p for p in cluster.api.list(
+                    "Pod", "default", {capi.JOB_NAME_LABEL: "stack-elastic"}
+                )
+                if p.status.phase.value == "Running"
+            ]
+
+        assert cluster.run_until(lambda: len(running()) == 2, timeout=60)
+        assert cluster.run_until(lambda: len(running()) == 4, timeout=600), (
+            "the stack's HPA loop never scaled the elastic job"
+        )

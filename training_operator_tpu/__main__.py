@@ -205,11 +205,18 @@ def build_cluster(args: argparse.Namespace) -> Cluster:
     return cluster
 
 
-def build_stack(cluster: Cluster, cfg: OperatorConfig):
+def wire_cluster_services(cluster: Cluster, cfg: OperatorConfig) -> None:
+    """The cluster-side control loops every deployment shape needs: default
+    scheduler, kubelet, the HPA loop (kube-controller-manager's role
+    upstream — it acts on HPA objects the controllers create), and the
+    configured gang scheduler. Shared by standalone build_stack and the
+    host role so the two can't drift."""
+    from training_operator_tpu.scheduler.elastic import HorizontalAutoscaler
+
     DefaultScheduler(cluster)
     SimKubelet(cluster)
-    gang_enabled = cfg.gang_scheduler_name != "none"
-    if gang_enabled:
+    HorizontalAutoscaler(cluster)
+    if cfg.gang_scheduler_name != "none":
         placer = {
             "tpu-packer": lambda: TPUPacker(),
             "baseline": lambda: BaselinePlacer(whole_slice=True),
@@ -222,6 +229,11 @@ def build_stack(cluster: Cluster, cfg: OperatorConfig):
             resolve_period=cfg.resolve_period,
             min_solve_interval=cfg.min_solve_interval,
         )
+
+
+def build_stack(cluster: Cluster, cfg: OperatorConfig):
+    wire_cluster_services(cluster, cfg)
+    gang_enabled = cfg.gang_scheduler_name != "none"
     mgr = OperatorManager(
         cluster,
         gang_enabled=gang_enabled,
@@ -390,19 +402,7 @@ def run_host(args, cfg) -> int:
 
     install_presets(cluster.api)
 
-    DefaultScheduler(cluster)
-    SimKubelet(cluster)
-    if cfg.gang_scheduler_name != "none":
-        placer = {
-            "tpu-packer": lambda: TPUPacker(),
-            "baseline": lambda: BaselinePlacer(whole_slice=True),
-            "baseline-firstfit": lambda: BaselinePlacer(whole_slice=False),
-        }[cfg.gang_scheduler_name]()
-        GangScheduler(
-            cluster, placer, prewarm=cfg.gang_scheduler_name == "tpu-packer",
-            resolve_period=cfg.resolve_period,
-            min_solve_interval=cfg.min_solve_interval,
-        )
+    wire_cluster_services(cluster, cfg)
     import os as _os
 
     token = args.api_token or _os.environ.get("TPU_OPERATOR_API_TOKEN") or None
