@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast bench bench-quick dryrun operator-demo native clean
+.PHONY: test test-fast bench bench-quick dryrun operator-demo ha-demo native clean
 
 test:            ## full suite (no hardware needed; ~10 min)
 	$(PY) -m pytest tests/ -q
@@ -29,6 +29,9 @@ operator-demo:   ## the operator process end-to-end on the example workload
 	  --cluster examples/process/cluster.json \
 	  --workload examples/process/workload.json \
 	  --virtual-clock
+
+ha-demo:         ## wire deployment: host + 2 operator processes, leader killed
+	$(PY) examples/remote_ha.py
 
 native:          ## force-rebuild the C++ data-path core (drops the hash cache)
 	$(PY) -c "from training_operator_tpu import native; import glob, os; \
