@@ -47,7 +47,11 @@ def _read_announcement(proc, prefix, timeout=30.0):
             continue
         chunk = _os.read(proc.stdout.fileno(), 4096).decode(errors="replace")
         buf += chunk
-        for line in buf.splitlines():
+        # Only COMPLETE lines may match: a chunk boundary mid-announcement
+        # would otherwise return a truncated value (e.g. half a port).
+        lines = buf.split("\n")
+        buf = lines.pop()
+        for line in lines:
             if line.startswith(prefix):
                 return line.strip().split("=", 1)[1]
     raise RuntimeError(f"no {prefix} announcement within {timeout}s")

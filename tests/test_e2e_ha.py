@@ -77,7 +77,11 @@ def _read_line_with_prefix(proc, prefix, timeout=30.0):
             time.sleep(0.05)
             continue
         buf += chunk
-        for line in buf.splitlines():
+        # Only complete lines may match — a chunk boundary mid-announcement
+        # would return a truncated value (half a port number).
+        lines = buf.split("\n")
+        buf = lines.pop()
+        for line in lines:
             if line.startswith(prefix):
                 return line.strip().split("=", 1)[1]
     raise AssertionError(f"no {prefix} announcement within {timeout}s")

@@ -61,6 +61,12 @@ class ApiUnavailableError(Exception):
     both the leader AND the standby operator."""
 
 
+class ApiServerError(Exception):
+    """The host answered 5xx (handler exception, overload). Retryable like
+    a transport failure — but a DISTINCT type from RuntimeError so the
+    operator loop's retry arm cannot swallow genuine local bugs."""
+
+
 # Empty namespace (cluster-scoped objects: Node, ClusterTrainingRuntime,
 # leases in "" if anyone does that) can't travel as an empty URL path
 # segment; "-" is the on-the-wire placeholder ("-" can never be a real
@@ -172,8 +178,21 @@ class ApiHTTPServer:
         self.url = f"http://{bind}:{self.port}"
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
+        # Background session GC: route-handler GC alone never runs once the
+        # last watch client dies (kill -9 both operators), and the dead
+        # sessions' queues would then accumulate every write's event until
+        # OOM. A daemon timer sweeps regardless of request traffic.
+        self._gc_stop = threading.Event()
+
+        def _gc_loop():
+            while not self._gc_stop.wait(min(30.0, max(1.0, session_ttl / 4))):
+                self._gc_sessions()
+
+        self._gc_thread = threading.Thread(target=_gc_loop, daemon=True)
+        self._gc_thread.start()
 
     def close(self) -> None:
+        self._gc_stop.set()
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -413,7 +432,7 @@ class RemoteAPIServer:
                 # Auth failures are config errors, not transients — the
                 # operator loop must NOT retry these silently forever.
                 raise PermissionError(msg) from None
-            raise RuntimeError(f"{method} {path}: {e.code} {msg}") from None
+            raise ApiServerError(f"{method} {path}: {e.code} {msg}") from None
         except (urllib.error.URLError, OSError) as e:
             # Connection refused/reset, DNS, socket timeout: retryable.
             raise ApiUnavailableError(f"{method} {path}: {e}") from None
@@ -500,7 +519,7 @@ class RemoteAPIServer:
     def unwatch(self, queue: RemoteWatchQueue) -> None:
         try:
             self._request("DELETE", f"/watches/{queue.watch_id}")
-        except (NotFoundError, ApiUnavailableError, RuntimeError):
+        except (NotFoundError, ApiUnavailableError, ApiServerError):
             pass  # best effort; the server GC reaps stale sessions anyway
 
     # -- admission ---------------------------------------------------------
@@ -619,11 +638,11 @@ class RemoteRuntime:
             try:
                 self.step()
                 backoff = 0.1
-            except (ApiUnavailableError, RuntimeError) as e:
-                # ApiUnavailableError: transport down. RuntimeError: the
-                # server answered 5xx — equally transient from here (k8s
-                # clients retry 500s the same way). Anything else is a
-                # local bug and should crash loudly.
+            except (ApiUnavailableError, ApiServerError) as e:
+                # Transport down, or the server answered 5xx — equally
+                # transient from here (k8s clients retry 500s the same
+                # way). Anything else — including plain RuntimeError from
+                # local code — is a bug and crashes loudly.
                 log.warning("API server error (%s); retrying in %.1fs", e, backoff)
                 _time.sleep(backoff)
                 backoff = min(backoff * 2, 5.0)

@@ -55,7 +55,11 @@ class OperatorManager:
         # connection) delays convergence instead of wedging it. None
         # disables (tests that count reconciles exactly).
         self.resync_period = resync_period
-        self._last_resync = cluster.clock.now()
+        # None => the first tick performs the informer INITIAL LIST: without
+        # it, a manager attached to a store with pre-existing jobs (remote
+        # operator without leader election — with it, the on_started_leading
+        # resync covers this) would ignore them for a full resync_period.
+        self._last_resync: Optional[float] = None
         self.queue = RateLimitingQueue()
         self.controllers: Dict[str, Tuple[object, JobController]] = {}
         self._watch = self.api.watch()
@@ -169,9 +173,9 @@ class OperatorManager:
             # everything, so nothing observed here is load-bearing.
             self._watch.drain()
             return
-        if (
-            self.resync_period is not None
-            and self.cluster.clock.now() - self._last_resync >= self.resync_period
+        if self.resync_period is not None and (
+            self._last_resync is None
+            or self.cluster.clock.now() - self._last_resync >= self.resync_period
         ):
             self._last_resync = self.cluster.clock.now()
             self._resync_all()

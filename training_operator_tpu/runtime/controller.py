@@ -169,6 +169,7 @@ class TrainJobManager:
         cluster: Cluster,
         registry: Optional[PluginRegistry] = None,
         leader_gate=None,
+        resync_period: Optional[float] = 300.0,
     ):
         """`leader_gate` (callable -> bool): when provided, the tick stays
         quiet unless it returns True — lets HA deployments ride the v1
@@ -184,8 +185,13 @@ class TrainJobManager:
         self.queue = RateLimitingQueue()
         # True at start (and after standby periods): the first active tick
         # re-lists every TrainJob — the informer initial-list, which also
-        # covers jobs created before this manager existed.
+        # covers jobs created before this manager existed. The PERIODIC
+        # resync (controller-runtime SyncPeriod, matching the v1 manager)
+        # additionally heals watch events lost to a dropped/reaped remote
+        # session — RemoteWatchQueue's reap-heal path depends on it.
         self._resync_pending = True
+        self.resync_period = resync_period
+        self._last_resync = cluster.clock.now()
         self._watch = self.api.watch()
         cluster.add_ticker(self.tick)
         from training_operator_tpu.runtime.webhooks import validate_trainjob, validate_training_runtime
@@ -213,8 +219,15 @@ class TrainJobManager:
             self._watch.drain()
             self._resync_pending = True
             return
+        now = self.cluster.clock.now()
+        if (
+            self.resync_period is not None
+            and now - self._last_resync >= self.resync_period
+        ):
+            self._resync_pending = True
         if self._resync_pending:
             self._resync_pending = False
+            self._last_resync = now
             for tj in self.api.list(TrainJob.KIND):
                 self.queue.add(tj.key())
         for ev in self._watch.drain():

@@ -457,7 +457,8 @@ class TPUPacker:
             if bool(free[i, : sl.num_hosts].all())
         ]
         preassigned = 0
-        remaining: List[Tuple[GangRequest, List[int]]] = []
+        accum_reserved: List[int] = []
+        remaining: List[Tuple[GangRequest, List[int], int]] = []
         for _, req, compat in starved:
             k = req.num_slices
             compat_set = set(compat)
@@ -470,7 +471,17 @@ class TPUPacker:
                 )
             ]
             if len(usable) < k:
-                remaining.append((req, compat))
+                # ACCUMULATE: reserve this gang's already-free compatible
+                # slices so the small-gang backfill can't re-fragment them
+                # in the very cycle they freed — otherwise a multi-slice
+                # gang loses its progress every time one slice drains
+                # before the others.
+                for i in usable:
+                    accum_reserved.append(i)
+                    avail.remove(i)
+                    free[i, :] = False
+                    self._drain_set.add(slices[i].slice_id)
+                remaining.append((req, compat, k - len(usable)))
                 continue
             pods = req.sorted_pods()
             pps = len(pods) // k
@@ -487,8 +498,15 @@ class TPUPacker:
                 slices_used.append(sl.slice_id)
             out[req.key] = Placement(assignments=assignments, slices_used=slices_used)
             preassigned += 1
-        demand = sum(r.num_slices for r, _ in remaining)
-        cap = max(1, int(len(slices) * self.max_drain_fraction))
+        demand = sum(short for _, _, short in remaining)
+        # The cap must at least admit the largest single gang's shortfall,
+        # or on small pools (cap=1) a multi-slice gang could never
+        # accumulate enough reserved slices to run at all.
+        cap = max(
+            1,
+            int(len(slices) * self.max_drain_fraction),
+            max((short for _, _, short in remaining), default=1),
+        )
         reserved: List[int] = []
         if demand <= 0:
             self._drain_set.clear()
@@ -498,7 +516,7 @@ class TPUPacker:
             # gangs' compatible slices (a drained v4 slice helps no v5e
             # gang, it just idles capacity).
             compat_union: set = set()
-            for _, compat in remaining:
+            for _, compat, _short in remaining:
                 compat_union.update(compat)
             by_id = {sl.slice_id: i for i, sl in enumerate(slices)}
             self._drain_set = {
@@ -506,7 +524,7 @@ class TPUPacker:
                 if sid in by_id and by_id[sid] in compat_union
             }
             reserved = [by_id[sid] for sid in self._drain_set]
-            target = min(demand, cap)
+            target = min(demand, cap) + len(accum_reserved)
             if len(reserved) > target:
                 # Demand shrank: release the least-drained extras (fewest
                 # free hosts = furthest from helping anyone).

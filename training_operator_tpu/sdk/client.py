@@ -68,6 +68,9 @@ class TrainingClient:
         self.api = cluster.api
         self.namespace = namespace
         self.job_kind = job_kind
+        # (ns, name) -> [kind]: which kind a job turned out to be, so
+        # repeated filtered pod lookups don't re-probe every kind.
+        self._kind_memo: Dict[Any, List[str]] = {}
 
     # -- CRUD --------------------------------------------------------------
 
@@ -224,10 +227,20 @@ class TrainingClient:
             # lowercased form. Validate against the job's actual replica
             # types so a typo (or reference-style lowercase "worker")
             # raises like the reference (training_client.py:1028-1053)
-            # instead of silently matching nothing.
-            for kind in JOB_KIND_NAMES:
+            # instead of silently matching nothing. The job's kind is
+            # memoized per (ns, name): this runs inside polling loops, and
+            # in remote mode each probe is an HTTP round-trip — the
+            # client's default kind is tried first.
+            cache_key = (ns, name)
+            kinds = self._kind_memo.get(cache_key)
+            if kinds is None:
+                kinds = [self.job_kind] + [
+                    k for k in JOB_KIND_NAMES if k != self.job_kind
+                ]
+            for kind in kinds:
                 job = self.api.try_get(kind, ns, name)
                 if job is not None and hasattr(job, "replica_specs"):
+                    self._kind_memo[cache_key] = [kind]
                     known = sorted(job.replica_specs)
                     if str(replica_type) not in known:
                         raise ValueError(
