@@ -541,10 +541,13 @@ def main():
     ap.add_argument("--no-noise-sweep", action="store_true",
                     help="skip the estimate-robustness packer runs "
                          "(duration_noise block)")
-    ap.add_argument("--tail-breakdown", action="store_true",
+    ap.add_argument("--tail-breakdown", dest="tail_breakdown",
+                    action="store_true", default=True,
                     help="include per-job-class latency percentiles in the "
                          "output (tail_by_class block) — the tail-latency "
-                         "diagnostic behind the README's analysis")
+                         "diagnostic behind the README's analysis (default on)")
+    ap.add_argument("--no-tail-breakdown", dest="tail_breakdown",
+                    action="store_false")
     trainer_group = ap.add_mutually_exclusive_group()
     trainer_group.add_argument("--no-trainer", action="store_true",
                                help="skip the single-chip trainer compute benchmark")
