@@ -1,13 +1,14 @@
 """The wire deployment, end to end: apiserver host + two operator replicas
-as REAL OS processes, a job submitted over HTTP, the elected leader killed
-mid-run, and the standby converging the work.
+as REAL OS processes, a job submitted over HTTPS (host-minted CA, verified), the elected leader
+killed mid-run, and the standby converging the work.
 
 This is the reference's production shape — operator pods with
 --enable-leader-election against a kube-apiserver
 (cmd/training-operator.v1/main.go:134-166) — on the TPU-native substrate:
-`--role host` serves the cluster over HTTP (scheduler + kubelet + admission
-live there), `--role operator` runs only controllers + leader election
-against it, and `TrainingClient("http://...")` is the remote SDK.
+`--role host` serves the cluster over HTTPS (scheduler + kubelet + admission
+live there; TLS cert minted at startup, pkg/cert/cert.go:45 analogue),
+`--role operator` runs only controllers + leader election against it, and
+`TrainingClient("https://...", ca_file=...)` is the remote SDK.
 
 Run: python examples/remote_ha.py
 """
@@ -32,29 +33,9 @@ REPO = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
 
 
 def _read_announcement(proc, prefix, timeout=30.0):
-    """select()-gated stdout scan for a `prefix...` line: a silent-but-alive
-    process trips the deadline instead of blocking readline() forever (and
-    leaking children past the finally block)."""
-    import select
+    from training_operator_tpu.utils.procio import read_announcement
 
-    deadline = time.monotonic() + timeout
-    buf = ""
-    while time.monotonic() < deadline:
-        if proc.poll() is not None:
-            raise RuntimeError(f"process exited rc={proc.returncode} before {prefix}")
-        ready, _, _ = select.select([proc.stdout], [], [], 0.2)
-        if not ready:
-            continue
-        chunk = _os.read(proc.stdout.fileno(), 4096).decode(errors="replace")
-        buf += chunk
-        # Only COMPLETE lines may match: a chunk boundary mid-announcement
-        # would otherwise return a truncated value (e.g. half a port).
-        lines = buf.split("\n")
-        buf = lines.pop()
-        for line in lines:
-            if line.startswith(prefix):
-                return line.strip().split("=", 1)[1]
-    raise RuntimeError(f"no {prefix} announcement within {timeout}s")
+    return read_announcement(proc, prefix, timeout=timeout)
 
 
 def spawn(*args):
@@ -78,11 +59,13 @@ def main():
     procs = [host]
     try:
         url = _read_announcement(host, "WIRE_API=", timeout=30.0)
-        print(f"host up at {url}")
+        ca = _read_announcement(host, "WIRE_CA=", timeout=10.0)
+        print(f"host up at {url} (CA: {ca})")
 
         ops = {}
         for ident in ("op-a", "op-b"):
             p = spawn("--role", "operator", "--api-server", url,
+                      "--ca-cert", ca,
                       "--enable-scheme", "jax", "--gang-scheduler-name", "none",
                       "--enable-leader-election", "--leader-identity", ident,
                       "--leader-lease-seconds", "2")
@@ -90,8 +73,8 @@ def main():
             ops[ident] = p
         print("two operator replicas racing one lease...")
 
-        api = RemoteAPIServer(url)
-        client = TrainingClient(url)
+        api = RemoteAPIServer(url, ca_file=ca)
+        client = TrainingClient(url, ca_file=ca)
         lease = None
         for _ in range(300):
             lease = api.try_get("Lease", "operator-system", DEFAULT_LEASE_NAME)

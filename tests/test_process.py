@@ -208,7 +208,7 @@ class TestWireRoles:
         t = threading.Thread(
             target=process.main,
             args=([
-                "--role", "host", "--serve-port", str(port),
+                "--role", "host", "--serve-port", str(port), "--insecure",
                 # Long enough that late-binding under CI load can't close
                 # the server while the assertions below still run.
                 "--cluster", str(inv), "--run-seconds", "12",

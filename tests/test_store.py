@@ -1,0 +1,266 @@
+"""Durable host state: snapshot + journal recovery (cluster/store.py).
+
+The reference substrate survives apiserver restarts because etcd is durable
+(SURVEY.md §1 substrate row); these tests pin the same property onto the
+HostStore: every acknowledged write is recoverable, a torn final journal
+record (crash mid-write) is dropped without corrupting the prefix, and
+compaction loses nothing.
+"""
+
+import json
+import os
+
+import pytest
+
+from training_operator_tpu.api.common import Container, PodTemplateSpec, ReplicaSpec
+from training_operator_tpu.api.jobs import JAXJob, ObjectMeta
+from training_operator_tpu.cluster.apiserver import APIServer
+from training_operator_tpu.cluster.objects import Event, Lease, Pod
+from training_operator_tpu.cluster.store import SNAPSHOT, HostStore, journal_name
+
+
+def _job(name: str) -> JAXJob:
+    return JAXJob(
+        metadata=ObjectMeta(name=name),
+        replica_specs={
+            "Worker": ReplicaSpec(
+                replicas=2,
+                template=PodTemplateSpec(
+                    containers=[Container(name="jax", image="trainer")]
+                ),
+            )
+        },
+    )
+
+
+def _pod(name: str) -> Pod:
+    return Pod(
+        metadata=ObjectMeta(name=name),
+        spec=PodTemplateSpec(containers=[Container(name="c", image="trainer")]),
+    )
+
+
+def _recover(tmp_path) -> APIServer:
+    api = APIServer()
+    HostStore(str(tmp_path)).load_into(api)
+    return api
+
+
+class TestJournalRecovery:
+    def test_writes_survive_restart(self, tmp_path):
+        api = APIServer()
+        store = HostStore(str(tmp_path))
+        store.load_into(api)
+        store.attach(api)
+
+        api.create(_job("alpha"))
+        api.create(_pod("alpha-worker-0"))
+        job = api.get("JAXJob", "default", "alpha")
+        api.update(job)  # a version-bumping update rides the journal too
+        api.create(
+            Lease(metadata=ObjectMeta(name="l", namespace="sys"), holder="op-a",
+                  renew_time=123.0)
+        )
+        api.record_event(Event(object_name="alpha", reason="Created", message="m"))
+        api.append_pod_log("default", "alpha-worker-0", "line one\nline two", 1.5)
+        api.delete("Pod", "default", "alpha-worker-0")
+        store.close()
+
+        api2 = _recover(tmp_path)
+        assert api2.try_get("JAXJob", "default", "alpha") is not None
+        assert api2.try_get("Pod", "default", "alpha-worker-0") is None
+        lease = api2.get("Lease", "sys", "l")
+        assert lease.holder == "op-a" and lease.renew_time == 123.0
+        assert [e.reason for e in api2.events("alpha")] == ["Created"]
+        # resourceVersion counter resumes past every persisted write: a new
+        # write can never collide with a pre-crash version.
+        rv_before = api.version()
+        assert api2.version() >= rv_before
+
+    def test_torn_final_record_dropped(self, tmp_path):
+        api = APIServer()
+        store = HostStore(str(tmp_path))
+        store.load_into(api)
+        store.attach(api)
+        api.create(_job("keep-me"))
+        store.close()
+
+        # Crash mid-write: the final record is half a JSON object.
+        with open(tmp_path / journal_name(0), "a") as f:
+            f.write('{"op": "put", "obj": {"kind": "JAXJob", "metadata"')
+
+        api2 = _recover(tmp_path)
+        assert api2.try_get("JAXJob", "default", "keep-me") is not None
+        assert len(api2.list("JAXJob")) == 1
+
+    def test_replay_is_idempotent_across_snapshot_and_journal(self, tmp_path):
+        """An object present in the snapshot AND re-written in the journal
+        converges to the journal (later) state."""
+        api = APIServer()
+        store = HostStore(str(tmp_path))
+        store.load_into(api)
+        store.attach(api)
+        lease = Lease(metadata=ObjectMeta(name="l", namespace="sys"), holder="a")
+        api.create(lease)
+        store.compact(api)  # snapshot holds holder=a
+        got = api.get("Lease", "sys", "l")
+        got.holder = "b"
+        api.update(got)     # journal holds holder=b
+        store.close()
+
+        api2 = _recover(tmp_path)
+        assert api2.get("Lease", "sys", "l").holder == "b"
+
+    def test_pod_logs_and_cursors_survive(self, tmp_path):
+        api = APIServer()
+        store = HostStore(str(tmp_path))
+        store.load_into(api)
+        store.attach(api)
+        api.create(_pod("p"))
+        api.append_pod_log("default", "p", "first", 1.0)
+        api.append_pod_log("default", "p", "second", 2.0)
+        store.close()
+
+        api2 = _recover(tmp_path)
+        lines, cursor = api2.read_pod_log("default", "p")
+        assert [ln.split(" ", 1)[1] for ln in lines] == ["first", "second"]
+        assert cursor == 2
+
+    def test_uid_counter_advances_past_restored_uids(self, tmp_path):
+        api = APIServer()
+        store = HostStore(str(tmp_path))
+        store.load_into(api)
+        store.attach(api)
+        created = api.create(_pod("p"))
+        old_uid = created.metadata.uid
+        store.close()
+
+        api2 = _recover(tmp_path)
+        api2.delete("Pod", "default", "p")
+        fresh = api2.create(_pod("p"))
+        # A recreated name must get a NEW incarnation uid — controllers key
+        # liveness decisions on uid.
+        assert fresh.metadata.uid != old_uid
+
+
+class TestCompaction:
+    def test_compaction_truncates_journal_losslessly(self, tmp_path):
+        api = APIServer()
+        store = HostStore(str(tmp_path), compact_every=5)
+        store.load_into(api)
+        store.attach(api)
+        for i in range(12):
+            api.create(_pod(f"p-{i}"))
+            store.maybe_compact(api)
+        store.close()
+
+        # The journal was rotated at least once: old generations deleted,
+        # the live one shorter than the full history.
+        import json as _json
+        snap_gen = _json.load(open(tmp_path / SNAPSHOT))["gen"]
+        assert snap_gen >= 1
+        assert not os.path.exists(tmp_path / journal_name(0))
+        live = open(tmp_path / journal_name(snap_gen)).read().strip().splitlines()
+        assert len(live) < 12
+
+        api2 = _recover(tmp_path)
+        assert len(api2.list("Pod")) == 12
+
+    def test_boot_compaction_folds_torn_tail(self, tmp_path):
+        api = APIServer()
+        store = HostStore(str(tmp_path))
+        store.load_into(api)
+        store.attach(api)
+        api.create(_pod("p"))
+        store.close()
+        with open(tmp_path / journal_name(0), "a") as f:
+            f.write('{"op": "pu')  # torn, no trailing newline
+
+        # Recovery TRUNCATES the torn tail, so a process appending to the
+        # same generation can never merge a record onto the fragment and
+        # silently lose everything after the corrupt line.
+        api2 = APIServer()
+        store2 = HostStore(str(tmp_path))
+        store2.load_into(api2)
+        store2.attach(api2)
+        api2.create(_pod("q"))  # appends to the truncated gen-0 journal
+        store2.close()
+
+        api3 = _recover(tmp_path)
+        assert api3.try_get("Pod", "default", "p") is not None
+        assert api3.try_get("Pod", "default", "q") is not None
+
+    def test_stale_journal_not_double_applied(self, tmp_path):
+        """Crash window: snapshot landed but the old-generation journal was
+        not yet deleted. Recovery must skip it — events and pod-log records
+        are append-only and would otherwise be applied twice."""
+        api = APIServer()
+        store = HostStore(str(tmp_path))
+        store.load_into(api)
+        store.attach(api)
+        api.create(_pod("p"))
+        api.record_event(Event(object_name="p", reason="Scheduled", message="m"))
+        api.append_pod_log("default", "p", "only-once", 1.0)
+        store.compact(api)
+        store.close()
+
+        # Simulate the crash: resurrect the pre-compact journal the store
+        # deleted (its records are all inside the snapshot now).
+        with open(tmp_path / journal_name(0), "w") as f:
+            f.write(json.dumps({"op": "event", "event": {
+                "object_name": "p", "reason": "Scheduled", "message": "m"}}) + "\n")
+            f.write(json.dumps({"op": "log", "ns": "default", "name": "p",
+                                "line": "only-once", "ts": 1.0}) + "\n")
+
+        api2 = _recover(tmp_path)
+        assert len(api2.events("p")) == 1
+        lines, _ = api2.read_pod_log("default", "p")
+        assert len(lines) == 1
+        # And the stale file was cleaned up.
+        assert not os.path.exists(tmp_path / journal_name(0))
+
+    def test_compact_during_concurrent_writes_loses_nothing(self, tmp_path):
+        """Records landing while the snapshot file is being written (outside
+        the API lock) belong to the new generation and survive recovery."""
+        import threading
+
+        api = APIServer()
+        store = HostStore(str(tmp_path))
+        store.load_into(api)
+        store.attach(api)
+        stop = threading.Event()
+        created = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                api.create(_pod(f"w-{i}"))
+                created.append(f"w-{i}")
+                i += 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            for _ in range(20):
+                store.compact(api)
+        finally:
+            stop.set()
+            t.join()
+        store.close()
+
+        api2 = _recover(tmp_path)
+        names = {p.metadata.name for p in api2.list("Pod")}
+        assert names == set(created)
+
+    def test_snapshot_is_atomic(self, tmp_path):
+        """No .tmp file left behind; the snapshot is valid JSON."""
+        api = APIServer()
+        store = HostStore(str(tmp_path))
+        store.load_into(api)
+        store.attach(api)
+        api.create(_pod("p"))
+        store.compact(api)
+        store.close()
+        assert not os.path.exists(tmp_path / (SNAPSHOT + ".tmp"))
+        snap = json.load(open(tmp_path / SNAPSHOT))
+        assert snap["rv"] >= 1 and len(snap["objects"]) == 1

@@ -1,0 +1,114 @@
+"""TLS on the wire (cluster/certs.py + httpapi TLS integration).
+
+Parity target: the reference serves HTTPS with self-signed certs minted at
+startup and rotated in-process (pkg/cert/cert.go:45, consumed by
+cmd/training-operator.v1/main.go:152-166). Pinned here: the host-minted CA
+verifies, a foreign CA is rejected LOUDLY (config error, not a silent
+retry), plain HTTP against the TLS port fails, and cert rotation is
+invisible to clients because their trust anchor is the CA.
+"""
+
+import pytest
+
+from training_operator_tpu.api.jobs import ObjectMeta
+from training_operator_tpu.cluster import certs
+from training_operator_tpu.cluster.apiserver import APIServer
+from training_operator_tpu.cluster.httpapi import (
+    ApiHTTPServer,
+    ApiUnavailableError,
+    RemoteAPIServer,
+)
+from training_operator_tpu.cluster.objects import ConfigMap
+
+
+@pytest.fixture()
+def tls_server(tmp_path):
+    ca_cert, ca_key = certs.mint_ca(str(tmp_path))
+    cert, key = certs.mint_server_cert(str(tmp_path), ca_cert, ca_key)
+    api = APIServer()
+    server = ApiHTTPServer(api, tls=(cert, key))
+    yield server, ca_cert, (str(tmp_path), ca_cert, ca_key)
+    server.close()
+
+
+def _cm(name="c"):
+    return ConfigMap(metadata=ObjectMeta(name=name), data={"k": "v"})
+
+
+class TestWireTLS:
+    def test_verified_roundtrip(self, tls_server):
+        server, ca, _ = tls_server
+        assert server.url.startswith("https://")
+        remote = RemoteAPIServer(server.url, timeout=5.0, ca_file=ca)
+        remote.create(_cm())
+        assert remote.get("ConfigMap", "default", "c").data == {"k": "v"}
+
+    def test_foreign_ca_rejected_loudly(self, tls_server, tmp_path):
+        """A server cert not signed by the pinned CA is a config error /
+        impersonation — PermissionError, never the retryable transport arm
+        (an operator retry-looping a bad pin forever would mask it)."""
+        server, _, _ = tls_server
+        other_dir = tmp_path / "other"
+        other_ca, _ = certs.mint_ca(str(other_dir))
+        remote = RemoteAPIServer(server.url, timeout=5.0, ca_file=str(other_ca))
+        with pytest.raises(PermissionError):
+            remote.list("ConfigMap")
+
+    def test_plain_http_cannot_reach_tls_port(self, tls_server):
+        server, _, _ = tls_server
+        plain = RemoteAPIServer(
+            server.url.replace("https://", "http://"), timeout=5.0
+        )
+        with pytest.raises(ApiUnavailableError):
+            plain.list("ConfigMap")
+
+    def test_rotation_invisible_to_pinned_client(self, tls_server):
+        """Re-minting the serving cert and hot-loading it must not disturb
+        a client whose trust anchor is the CA — the reference's rotated
+        webhook serving certs behave identically."""
+        server, ca, (cert_dir, ca_cert, ca_key) = tls_server
+        remote = RemoteAPIServer(server.url, timeout=5.0, ca_file=ca)
+        remote.create(_cm("before"))
+
+        fresh = certs.mint_server_cert(cert_dir, ca_cert, ca_key)
+        server.rotate_cert(*fresh)
+
+        remote.create(_cm("after"))  # new connection, new handshake
+        assert {c.metadata.name for c in remote.list("ConfigMap")} == {
+            "before", "after"
+        }
+
+    def test_rotate_without_tls_raises(self):
+        api = APIServer()
+        server = ApiHTTPServer(api)
+        try:
+            with pytest.raises(RuntimeError):
+                server.rotate_cert("x", "y")
+        finally:
+            server.close()
+
+    def test_ca_reused_across_mints(self, tmp_path):
+        """mint_ca is idempotent per directory — operator pins must survive
+        a host restart (the restart e2e asserts the same end to end)."""
+        a = certs.mint_ca(str(tmp_path))
+        b = certs.mint_ca(str(tmp_path))
+        assert a == b
+        assert open(a[0], "rb").read() == open(b[0], "rb").read()
+
+    def test_server_cert_sans_cover_loopback_and_extra_hosts(self, tmp_path):
+        from cryptography import x509
+
+        ca_cert, ca_key = certs.mint_ca(str(tmp_path))
+        cert_path, _ = certs.mint_server_cert(
+            str(tmp_path), ca_cert, ca_key,
+            hosts=["10.0.0.7", "host.internal", "0.0.0.0"],
+        )
+        cert = x509.load_pem_x509_certificate(open(cert_path, "rb").read())
+        sans = cert.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName
+        ).value
+        dns = set(sans.get_values_for_type(x509.DNSName))
+        ips = {str(ip) for ip in sans.get_values_for_type(x509.IPAddress)}
+        assert "localhost" in dns and "host.internal" in dns
+        assert "127.0.0.1" in ips and "10.0.0.7" in ips
+        assert "0.0.0.0" not in ips  # bind wildcard, not a dialable address

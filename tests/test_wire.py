@@ -303,16 +303,20 @@ class TestHTTPApi:
         assert [e.type for e in events] == ["Added"]  # Node filtered out
         assert events[0].obj.name == "w-0"
         # After the server forgets the session (explicit unwatch here; TTL
-        # GC in production), drain() transparently re-subscribes — the
-        # consumer's resync covers the gap — rather than killing the
-        # operator loop with NotFoundError.
-        old_id = wq.watch_id
+        # GC in production), drain() transparently re-subscribes and
+        # RELISTS — the ListAndWatch reconnect contract: existing state
+        # comes back as synthetic Added events (never NotFoundError killing
+        # the operator loop, never silently-lost events wedging the
+        # expectations cache until its TTL).
         remote.unwatch(wq)
-        assert wq.drain() == []
-        assert wq.watch_id != old_id
+        relisted = wq.drain()
+        assert [e.type for e in relisted] == ["Added"]  # w-0 re-announced
+        assert relisted[0].obj.name == "w-0"
         remote.create(Node(metadata=ObjectMeta(name="n10"), capacity={"cpu": 1}))
         cluster.api.delete("Pod", "ns1", "w-0")
-        events = wq.drain()
+        # Explicit timeout = explicit fetch (bare drain() may defer to the
+        # shared session's next block window).
+        events = wq.drain(timeout=1.0)
         assert [e.type for e in events] == ["Deleted"]  # kinds filter survived
 
     def test_logs_and_events(self, served_cluster):

@@ -30,6 +30,7 @@ import json
 import logging
 import signal
 import sys
+import tempfile
 import threading
 import time
 
@@ -103,11 +104,38 @@ def parse_args(argv=None) -> argparse.Namespace:
                          "endpoint is printed as WIRE_API=... on stdout)")
     ap.add_argument("--serve-bind", default="127.0.0.1",
                     help="host role: HTTP API bind address")
+    ap.add_argument("--state-dir", default=None,
+                    help="host role: persist API state here (snapshot + "
+                         "write-ahead journal) and restore it on startup, so "
+                         "a host crash/restart does not erase the cluster "
+                         "(the etcd-durability analogue; omit = volatile)")
     ap.add_argument("--api-server", default=None, metavar="URL",
                     help="operator role: base URL of the serving host")
     ap.add_argument("--api-token", default=None,
                     help="bearer token for the wire API: required of clients "
                          "when the host sets it (env TPU_OPERATOR_API_TOKEN)")
+    ap.add_argument("--insecure", action="store_true",
+                    help="host role: serve plain HTTP instead of the default "
+                         "TLS (loopback-only development; the reference "
+                         "serves HTTPS with rotated self-signed certs, "
+                         "pkg/cert/cert.go:45)")
+    ap.add_argument("--ca-cert", default=None, metavar="PEM",
+                    help="operator role: CA bundle to verify the https host "
+                         "against (the host announces its CA path as "
+                         "WIRE_CA=...; env TPU_OPERATOR_CA_CERT)")
+    ap.add_argument("--tls-san", action="append", default=None, metavar="HOST",
+                    help="host role: extra DNS name / IP literal to include "
+                         "in the serving cert's SANs (repeatable); "
+                         "127.0.0.1 + localhost are always included")
+    ap.add_argument("--tls-rotate-seconds", type=float, default=None,
+                    help="host role: re-mint the serving cert from the CA on "
+                         "this period (default: half the cert lifetime). "
+                         "Clients pin the CA, so rotation is invisible")
+    ap.add_argument("--wire-chaos", default=None, metavar="SPEC",
+                    help="host role, TESTING: inject transport faults into "
+                         "the wire API per request — "
+                         "\"seed=3,error=0.1,reset=0.05,reap=0.02\" "
+                         "(env TPU_OPERATOR_WIRE_CHAOS)")
     ap.add_argument(
         "--enable-scheme", action="append", default=None, metavar="SCHEME",
         help=f"enable a job scheme (repeatable); default: all of {ALL_SCHEMES}",
@@ -117,6 +145,16 @@ def parse_args(argv=None) -> argparse.Namespace:
         choices=("none", "tpu-packer", "baseline", "baseline-firstfit"),
         help="gang scheduling backend (default from config: tpu-packer)",
     )
+    ap.add_argument("--drain-reserve-seconds", type=float, default=None,
+                    help="tpu-packer tail SLO: whole-slice gangs waiting "
+                         "longer than this trigger drain reservations "
+                         "(<=0 disables; default 300)")
+    ap.add_argument("--max-drain-fraction", type=float, default=None,
+                    help="tpu-packer tail SLO: max fraction of slices "
+                         "withheld for draining per cycle (default 0.08)")
+    ap.add_argument("--aging-seconds", type=float, default=None,
+                    help="tpu-packer starvation bound: gangs waiting longer "
+                         "are promoted to FIFO front (default 300)")
     ap.add_argument("--namespace", default=None, help="namespace scope (default: all)")
     ap.add_argument("--controller-threads", type=int, default=None,
                     help="reconciles drained per manager tick")
@@ -154,6 +192,12 @@ def build_config(args: argparse.Namespace) -> OperatorConfig:
         cfg.enabled_schemes = list(dict.fromkeys(args.enable_scheme))
     if args.gang_scheduler_name is not None:
         cfg.gang_scheduler_name = args.gang_scheduler_name
+    if args.drain_reserve_seconds is not None:
+        cfg.drain_reserve_seconds = args.drain_reserve_seconds
+    if args.max_drain_fraction is not None:
+        cfg.max_drain_fraction = args.max_drain_fraction
+    if args.aging_seconds is not None:
+        cfg.aging_seconds = args.aging_seconds
     if args.namespace is not None:
         cfg.namespace = args.namespace
     if args.controller_threads is not None:
@@ -174,8 +218,8 @@ def build_config(args: argparse.Namespace) -> OperatorConfig:
     return cfg
 
 
-def build_cluster(args: argparse.Namespace) -> Cluster:
-    cluster = Cluster(VirtualClock() if args.virtual_clock else Clock())
+def build_cluster(args: argparse.Namespace, clock: "Clock | None" = None) -> Cluster:
+    cluster = Cluster(clock or (VirtualClock() if args.virtual_clock else Clock()))
     if args.cluster:
         with open(args.cluster) as f:
             inv = json.load(f)
@@ -218,7 +262,11 @@ def wire_cluster_services(cluster: Cluster, cfg: OperatorConfig) -> None:
     HorizontalAutoscaler(cluster)
     if cfg.gang_scheduler_name != "none":
         placer = {
-            "tpu-packer": lambda: TPUPacker(),
+            "tpu-packer": lambda: TPUPacker(
+                drain_reserve_seconds=cfg.drain_reserve_seconds,
+                max_drain_fraction=cfg.max_drain_fraction,
+                aging_seconds=cfg.aging_seconds,
+            ),
             "baseline": lambda: BaselinePlacer(whole_slice=True),
             "baseline-firstfit": lambda: BaselinePlacer(whole_slice=False),
         }[cfg.gang_scheduler_name]()
@@ -375,7 +423,22 @@ def run_host(args, cfg) -> int:
         raise SystemExit("--role host requires a real clock (remote processes share no virtual time)")
     if args.workload:
         raise SystemExit("--workload runs controllers; submit via an operator/SDK instead")
-    cluster = build_cluster(args)
+    from training_operator_tpu.cluster.runtime import WallClock
+
+    # Wall clock, not monotonic: host timestamps go into durable state and
+    # must survive a process restart; operators slave to it via /time.
+    cluster = build_cluster(args, clock=WallClock())
+    store = None
+    if args.state_dir:
+        from training_operator_tpu.cluster.store import HostStore
+
+        store = HostStore(args.state_dir)
+        store.load_into(cluster.api)
+        store.attach(cluster.api)
+        # Fold the replayed journal (and any torn tail) into a fresh
+        # snapshot now, so repeated crash/restart cycles can't grow the
+        # journal without bound.
+        store.compact(cluster.api)
 
     def admit(job) -> None:
         default_job(job, now=cluster.clock.now())
@@ -406,11 +469,49 @@ def run_host(args, cfg) -> int:
     import os as _os
 
     token = args.api_token or _os.environ.get("TPU_OPERATOR_API_TOKEN") or None
+    tls = None
+    ca_path = None
+    if not args.insecure:
+        # TLS is the default: the wire carries job specs and the bearer
+        # token. CA lives in the state dir (reused across restarts so
+        # operator pins survive); ephemeral hosts get a temp dir.
+        from training_operator_tpu.cluster import certs
+
+        cert_dir = args.state_dir or tempfile.mkdtemp(prefix="tpu-operator-certs-")
+        ca_path, ca_key = certs.mint_ca(cert_dir)
+        tls = certs.mint_server_cert(
+            cert_dir, ca_path, ca_key, hosts=args.tls_san or []
+        )
+    chaos_spec = args.wire_chaos or _os.environ.get("TPU_OPERATOR_WIRE_CHAOS")
+    chaos = None
+    if chaos_spec:
+        from training_operator_tpu.cluster.chaos import WireChaos
+
+        chaos = WireChaos.from_spec(chaos_spec)
+        log.warning("wire chaos ACTIVE: %s", chaos_spec)
     server = ApiHTTPServer(
-        cluster.api, port=args.serve_port, bind=args.serve_bind, token=token
+        cluster.api, port=args.serve_port, bind=args.serve_bind, token=token,
+        now_fn=cluster.clock.now, tls=tls, chaos=chaos,
     )
-    # Machine-parsable endpoint announcement (the e2e harness reads this).
+    if tls is not None:
+        from training_operator_tpu.cluster import certs
+
+        rotate_every = args.tls_rotate_seconds or (
+            certs.SERVER_CERT_DAYS * 86400 / 2
+        )
+
+        def rotate():
+            fresh = certs.mint_server_cert(
+                cert_dir, ca_path, ca_key, hosts=args.tls_san or []
+            )
+            server.rotate_cert(*fresh)
+            cluster.schedule_after(rotate_every, rotate)
+
+        cluster.schedule_after(rotate_every, rotate)
+    # Machine-parsable endpoint announcements (the e2e harness reads these).
     print(f"WIRE_API={server.url}", flush=True)
+    if ca_path is not None:
+        print(f"WIRE_CA={ca_path}", flush=True)
     log.info("host up: api=%s gang=%s", server.url, cfg.gang_scheduler_name)
     if cfg.health_port:
         serve_probes(cluster, cfg.health_port, cfg.metrics_token, cfg.health_bind_address)
@@ -422,11 +523,15 @@ def run_host(args, cfg) -> int:
     try:
         while not stop.is_set():
             cluster.step()
+            if store is not None:
+                store.maybe_compact(cluster.api)
             if deadline is not None and cluster.clock.now() >= deadline:
                 break
             time.sleep(0.01)
     finally:
         server.close()
+        if store is not None:
+            store.close()
     return 0
 
 
@@ -444,7 +549,10 @@ def run_operator(args, cfg) -> int:
     import os as _os
 
     token = args.api_token or _os.environ.get("TPU_OPERATOR_API_TOKEN") or None
-    runtime = RemoteRuntime(RemoteAPIServer(args.api_server, token=token))
+    ca_file = args.ca_cert or _os.environ.get("TPU_OPERATOR_CA_CERT") or None
+    runtime = RemoteRuntime(
+        RemoteAPIServer(args.api_server, token=token, ca_file=ca_file)
+    )
     mgr = OperatorManager(
         runtime,
         gang_enabled=cfg.gang_scheduler_name != "none",

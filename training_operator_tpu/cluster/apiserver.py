@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import copy as _copylib
 import threading
+import time as _time
 from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Deque, Dict, Iterable, List, Optional, Tuple
@@ -132,6 +133,15 @@ class APIServer:
         self._watchers: List[WatchQueue] = []
         self._events: List[Event] = []
         self._lock = threading.RLock()
+        # Signalled on every watch push; wait_and_drain blocks on it so a
+        # cross-thread watch consumer (the HTTP long-poll handler) parks on
+        # a condition instead of spinning. Shares the store lock: a waiter
+        # holding the condition atomically releases the lock while blocked.
+        self._watch_cond = threading.Condition(self._lock)
+        # Durability sink (cluster/store.py HostStore): called inside the
+        # lock after every mutation, so the journal order IS the write
+        # order. None = volatile store (tests, standalone role).
+        self._journal: Optional[Callable[..., None]] = None
         # Admission hooks: kind -> [callable(obj) raising on rejection]
         self._admission: Dict[str, List[Callable[[Any], None]]] = {}
         # Per-pod log buffers (the k8s pod-log subresource analogue): the
@@ -155,6 +165,81 @@ class APIServer:
             bucket = self._by_label.get((key[0], lk, lv))
             if bucket is not None:
                 bucket.discard(key[1:])
+
+    # -- durability --------------------------------------------------------
+
+    def attach_journal(self, sink: Callable[..., None]) -> None:
+        """Register the durability sink; see HostStore. Calls arrive inside
+        the store lock as sink(op, *args) with op in put/del/event/log."""
+        with self._lock:
+            self._journal = sink
+
+    def locked(self):
+        """The store lock as a public context manager — for consumers that
+        must compose several calls atomically (snapshot+journal rotation)
+        without reaching into `_lock`."""
+        return self._lock
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        """Wire-encoded full state for a snapshot file. Caller should hold
+        `locked()` if atomicity with other effects matters."""
+        from training_operator_tpu.cluster import wire
+
+        with self._lock:
+            return {
+                "rv": self._rv_value,
+                "objects": [wire.encode(o) for o in self._objects.values()],
+                "events": [wire.encode(e) for e in self._events],
+                "pod_logs": [
+                    {"ns": ns, "name": name, "base": buf["base"],
+                     "lines": [[ts, ln] for ts, ln in buf["lines"]]}
+                    for (ns, name), buf in self._pod_logs.items()
+                ],
+            }
+
+    def restore(
+        self,
+        objects: List[Any],
+        rv: int,
+        events: Optional[List[Event]] = None,
+        pod_logs: Optional[Dict[Tuple[str, str], Dict[str, Any]]] = None,
+    ) -> None:
+        """Load recovered state (HostStore.load_into). Bypasses admission
+        and uid assignment — these objects already passed both in their
+        first life — but announces each as an Added watch event so informers
+        constructed before the restore converge. Advances the uid counter
+        past every restored uid so a recreated name can never collide with
+        a dead incarnation's uid (controllers key liveness on uid)."""
+        import itertools as _it
+        import re as _re
+
+        from training_operator_tpu.api.jobs import ObjectMeta
+
+        with self._lock:
+            max_uid_seq = 0
+            for obj in objects:
+                key = self._key(obj)
+                stored = self._clone(obj)
+                self._objects[key] = stored
+                self._by_kind.setdefault(key[0], {})[key[1:]] = stored
+                self._index_labels(key, stored)
+                m = _re.search(r"-(\d+)$", obj.metadata.uid or "")
+                if m:
+                    max_uid_seq = max(max_uid_seq, int(m.group(1)))
+                self._notify("Added", self._clone(stored))
+            self._rv_value = max(self._rv_value, rv)
+            if events:
+                self._events.extend(events)
+            if pod_logs:
+                for key2, buf in pod_logs.items():
+                    self._pod_logs[key2] = {
+                        "lines": list(buf["lines"]), "base": int(buf["base"])
+                    }
+            if max_uid_seq:
+                # Class-level counter: all stores in-process share it, so
+                # only ever advance it.
+                current = next(ObjectMeta._uid_counter)
+                ObjectMeta._uid_counter = _it.count(max(current, max_uid_seq + 1))
 
     # -- admission ---------------------------------------------------------
 
@@ -194,6 +279,24 @@ class APIServer:
         ev = WatchEvent(ev_type, obj.KIND, obj, status_only=status_only)
         for w in self._watchers:
             w.push(ev)
+        self._watch_cond.notify_all()
+
+    def wait_and_drain(self, queue: WatchQueue, timeout: float = 0.0) -> List[WatchEvent]:
+        """Block until `queue` has events (or `timeout` elapses), then drain.
+
+        The cross-thread watch-consumer API: the HTTP wire's long-poll
+        handler parks here on the store's condition variable, so a waiting
+        watch client costs zero CPU between writes instead of a sleep-spin,
+        and the drain is atomic with respect to concurrent pushes (both run
+        under the store lock). In-process tick-driven consumers keep calling
+        queue.drain() directly — they never want to block."""
+        deadline = _time.monotonic() + timeout
+        with self._watch_cond:
+            while not len(queue):
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0 or not self._watch_cond.wait(remaining):
+                    break
+            return queue.drain()
 
     # -- CRUD --------------------------------------------------------------
 
@@ -216,6 +319,8 @@ class APIServer:
             self._by_kind.setdefault(key[0], {})[key[1:]] = stored
             self._index_labels(key, stored)
             self._notify("Added", self._clone(stored))
+            if self._journal is not None:
+                self._journal("put", stored)
             return obj
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
@@ -257,6 +362,8 @@ class APIServer:
             self._by_kind.setdefault(key[0], {})[key[1:]] = stored
             self._index_labels(key, stored)
             self._notify("Modified", self._clone(stored), status_only=status_only)
+            if self._journal is not None:
+                self._journal("put", stored)
             return obj
 
     def delete(self, kind: str, namespace: str, name: str) -> Any:
@@ -270,6 +377,8 @@ class APIServer:
             if kind == "Pod":
                 self._pod_logs.pop(key[1:], None)
             self._notify("Deleted", obj)  # orphaned: safe to hand out as-is
+            if self._journal is not None:
+                self._journal("del", kind, namespace or "", name, self._rv_value)
             return obj
 
     def try_delete(self, kind: str, namespace: str, name: str) -> Optional[Any]:
@@ -326,6 +435,8 @@ class APIServer:
             if overflow > 0:
                 del buf["lines"][:overflow]
                 buf["base"] += overflow
+            if self._journal is not None:
+                self._journal("log", namespace or "", name, str(line), ts)
 
     def read_pod_log(
         self,
@@ -353,6 +464,8 @@ class APIServer:
     def record_event(self, event: Event) -> None:
         with self._lock:
             self._events.append(event)
+            if self._journal is not None:
+                self._journal("event", event)
 
     def events(
         self, object_name: Optional[str] = None, reason: Optional[str] = None

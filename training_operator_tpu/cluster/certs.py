@@ -1,0 +1,195 @@
+"""Self-signed CA + serving certificates for the wire boundary.
+
+Parity target: the reference serves its webhook/metrics endpoints over HTTPS
+with certs minted at operator startup by an in-process cert-controller
+(`pkg/cert/cert.go:45` CreateCertManagers — self-signed CA written into a
+Secret, consumed by the webhook server in cmd/training-operator.v1/
+main.go:152-166). Round 3 argued an in-process stack has no transport to
+protect; the HTTP wire (`httpapi.py`) ended that argument — job specs and
+the bearer token now cross real sockets. This module is the cert.go
+analogue for that boundary:
+
+  mint_ca(dir)               one elliptic-curve CA per host state dir,
+                             reused across restarts so operator CA pins
+                             survive a host crash/restart
+  mint_server_cert(...)      short-lived serving cert signed by the CA,
+                             SANs for every name/IP the host serves on
+  server_context / client_context
+                             ssl.SSLContexts for the two ends; the client
+                             verifies the server against the pinned CA
+                             (hostname check included)
+
+Rotation analogue: the serving cert is deliberately short-lived
+(`SERVER_CERT_DAYS`); `ApiHTTPServer.rotate_cert()` re-mints it from the
+same CA and reloads it into the LIVE ssl context — new handshakes pick up
+the fresh cert, existing connections finish on the old one, and clients
+never notice because their trust anchor is the (long-lived) CA, exactly how
+the reference's rotated serving certs stay invisible to kube-apiserver.
+
+Uses the `cryptography` package (baked into the image).
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import logging
+import os
+import ssl
+from typing import List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+CA_CERT = "ca.pem"
+CA_KEY = "ca-key.pem"
+SERVER_CERT = "server.pem"
+SERVER_KEY = "server-key.pem"
+
+CA_DAYS = 3650
+SERVER_CERT_DAYS = 7  # short-lived by design; rotation re-mints from the CA
+
+
+def _now() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def mint_ca(dirpath: str) -> Tuple[str, str]:
+    """Create (or reuse) a self-signed CA under `dirpath`; returns
+    (cert_path, key_path). Reuse matters: operators pin this CA by file
+    path, and a host restart that re-minted the CA would invalidate every
+    standing pin — the reference likewise persists its CA in a Secret
+    rather than re-creating it per boot (pkg/cert/cert.go:45)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    os.makedirs(dirpath, exist_ok=True)
+    cert_path = os.path.join(dirpath, CA_CERT)
+    key_path = os.path.join(dirpath, CA_KEY)
+    if os.path.exists(cert_path) and os.path.exists(key_path):
+        return cert_path, key_path
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "training-operator-tpu-ca")]
+    )
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(_now() - datetime.timedelta(minutes=5))
+        .not_valid_after(_now() + datetime.timedelta(days=CA_DAYS))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=0), critical=True)
+        .add_extension(
+            x509.KeyUsage(
+                digital_signature=True, key_cert_sign=True, crl_sign=True,
+                content_commitment=False, key_encipherment=False,
+                data_encipherment=False, key_agreement=False,
+                encipher_only=False, decipher_only=False,
+            ),
+            critical=True,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    _write_private(key_path, key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    ))
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    log.info("minted CA at %s", cert_path)
+    return cert_path, key_path
+
+
+def mint_server_cert(
+    dirpath: str,
+    ca_cert_path: str,
+    ca_key_path: str,
+    hosts: Optional[List[str]] = None,
+    days: float = SERVER_CERT_DAYS,
+) -> Tuple[str, str]:
+    """Mint a serving cert signed by the CA with SANs for `hosts` (DNS
+    names and/or IP literals; 127.0.0.1 + localhost always included so
+    loopback clients verify). Overwrites any previous serving cert —
+    that IS the rotation."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+
+    with open(ca_cert_path, "rb") as f:
+        ca_cert = x509.load_pem_x509_certificate(f.read())
+    with open(ca_key_path, "rb") as f:
+        ca_key = serialization.load_pem_private_key(f.read(), password=None)
+
+    sans: List[x509.GeneralName] = []
+    seen = set()
+    for h in ["127.0.0.1", "localhost", *(hosts or [])]:
+        if not h or h in seen or h == "0.0.0.0":
+            # 0.0.0.0 is a bind wildcard, not an address clients dial.
+            seen.add(h)
+            continue
+        seen.add(h)
+        try:
+            sans.append(x509.IPAddress(ipaddress.ip_address(h)))
+        except ValueError:
+            sans.append(x509.DNSName(h))
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(
+            x509.Name(
+                [x509.NameAttribute(NameOID.COMMON_NAME, "training-operator-tpu-host")]
+            )
+        )
+        .issuer_name(ca_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(_now() - datetime.timedelta(minutes=5))
+        .not_valid_after(_now() + datetime.timedelta(days=days))
+        .add_extension(x509.SubjectAlternativeName(sans), critical=False)
+        .add_extension(
+            x509.ExtendedKeyUsage([ExtendedKeyUsageOID.SERVER_AUTH]), critical=False
+        )
+        .sign(ca_key, hashes.SHA256())
+    )
+    cert_path = os.path.join(dirpath, SERVER_CERT)
+    key_path = os.path.join(dirpath, SERVER_KEY)
+    _write_private(key_path, key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    ))
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    return cert_path, key_path
+
+
+def _write_private(path: str, data: bytes) -> None:
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "wb") as f:
+        f.write(data)
+
+
+def server_context(cert_path: str, key_path: str) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    ctx.load_cert_chain(cert_path, key_path)
+    return ctx
+
+
+def client_context(ca_cert_path: str) -> ssl.SSLContext:
+    """Verify the server against the pinned CA — full chain + hostname
+    verification, nothing less; a cert pin that skips hostname checking
+    would accept ANY cert the CA ever signed from ANY endpoint."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    ctx.check_hostname = True
+    ctx.load_verify_locations(cafile=ca_cert_path)
+    return ctx

@@ -74,14 +74,9 @@ class ChaosMonkey:
     def _strike(self) -> None:
         if not self._armed or len(self.kills) >= self.budget:
             return
+        pods = self.cluster.api.list("Pod", self.namespace, self.selector)
         victims = sorted(
-            (
-                p
-                for p in self.cluster.api.list(
-                    "Pod", self.namespace, self.selector
-                )
-                if p.status.phase == PodPhase.RUNNING
-            ),
+            (p for p in pods if p.status.phase == PodPhase.RUNNING),
             key=lambda p: (p.namespace, p.name),
         )
         if victims:
@@ -95,6 +90,12 @@ class ChaosMonkey:
                 self.empty_strikes = 0
             else:
                 self.empty_strikes += 1
+        elif any(not p.is_terminal() for p in pods):
+            # Matching pods exist but none are RUNNING yet (scheduling /
+            # backoff delay): keep the monkey armed — disarming here would
+            # silently strip chaos from a workload that is merely slow to
+            # start, and tests relying on kills would pass vacuously.
+            pass
         else:
             self.empty_strikes += 1
         if self.empty_strikes >= self.max_empty_strikes:
@@ -243,3 +244,79 @@ class GangPause:
     def stop(self) -> None:
         self.cluster.remove_ticker(self._gated)
         self.cluster.add_ticker(self.ticker)
+
+
+class WireChaos:
+    """Fault injection at the HTTP wire boundary (`ApiHTTPServer`).
+
+    `APIChaos` above attacks the STORE's semantics (conflicts, dropped
+    watch events); this tier attacks the TRANSPORT the way real networks
+    do, exercising the client-side arms none of the in-process chaos can
+    reach: `RemoteAPIServer`'s 5xx mapping (`ApiServerError`), the
+    connection-reset path (`ApiUnavailableError`), `RemoteRuntime.
+    run_forever`'s retry/backoff arm, and `RemoteWatchQueue.drain`'s
+    resubscribe-after-reap healing (httpapi.py). Seeded; sampling is
+    serialized under a lock so a seed reproduces the same DECISION
+    sequence (request arrival order stays OS-scheduled, as in any real
+    network test).
+
+      error_rate   probability a request is answered 500 before dispatch
+      reset_rate   probability the connection is closed with no response
+                   at all (TCP reset as the client sees it)
+      reap_rate    probability ALL server-side watch sessions are reaped
+                   before serving (session loss under memory pressure /
+                   host failover; clients must resubscribe + resync)
+
+    Probes (/healthz, /readyz) are exempt, like kubelet probes riding a
+    management port. `injected` counts per-kind injections so tests can
+    assert the storm actually happened.
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        error_rate: float = 0.0,
+        reset_rate: float = 0.0,
+        reap_rate: float = 0.0,
+    ):
+        import threading
+
+        self.rng = random.Random(seed)
+        self.error_rate = error_rate
+        self.reset_rate = reset_rate
+        self.reap_rate = reap_rate
+        self.injected: Dict[str, int] = {"error": 0, "reset": 0, "reap": 0}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "WireChaos":
+        """Parse "seed=3,error=0.1,reset=0.05,reap=0.02" (env/CLI form)."""
+        kwargs: Dict[str, float] = {}
+        for pair in spec.split(","):
+            if not pair.strip():
+                continue
+            key, _, value = pair.partition("=")
+            key = key.strip()
+            name = {"seed": "seed", "error": "error_rate",
+                    "reset": "reset_rate", "reap": "reap_rate"}.get(key)
+            if name is None:
+                raise ValueError(f"unknown wire-chaos key {key!r} in {spec!r}")
+            kwargs[name] = int(value) if name == "seed" else float(value)
+        return cls(**kwargs)
+
+    def sample(self) -> Optional[str]:
+        """One decision per request: "error" | "reset" | "reap" | None."""
+        with self._lock:
+            r = self.rng.random()
+            if r < self.error_rate:
+                self.injected["error"] += 1
+                return "error"
+            r -= self.error_rate
+            if r < self.reset_rate:
+                self.injected["reset"] += 1
+                return "reset"
+            r -= self.reset_rate
+            if r < self.reap_rate:
+                self.injected["reap"] += 1
+                return "reap"
+            return None

@@ -72,7 +72,14 @@ class ApiServerError(Exception):
 # segment; "-" is the on-the-wire placeholder ("-" can never be a real
 # namespace: RFC1035 labels must start with a letter).
 def _ns_seg(namespace: str) -> str:
-    return namespace or "-"
+    return _quote_seg(namespace or "-")
+
+
+# Names are never validated against RFC1123, so a '/', '?', '#', space, or
+# non-ASCII in a name must ride as percent-encoding — otherwise the object
+# routes wrongly (create succeeds, get/update/delete 404).
+def _quote_seg(segment: str) -> str:
+    return urllib.parse.quote(str(segment), safe="")
 
 
 def _seg_ns(segment: str) -> str:
@@ -100,15 +107,38 @@ class ApiHTTPServer:
         bind: str = "127.0.0.1",
         session_ttl: float = 120.0,
         token: Optional[str] = None,
+        now_fn: Optional[Callable[[], float]] = None,
+        tls: Optional[Tuple[str, str]] = None,
+        chaos: Optional[object] = None,
     ):
         """`token`: require `Authorization: Bearer <token>` on every route
         except /healthz and /readyz (probes stay open, like kubelet probes)
-        — the secure-serving analogue of the reference's cert-gated
-        apiserver connection (pkg/cert/cert.go:45), minus the rotation an
-        in-process CA would be theater for."""
+        — the authn half of the reference's cert-gated apiserver connection
+        (pkg/cert/cert.go:45); the transport half is TLS (see `certs.py`).
+
+        `now_fn`: the serving process's cluster clock, exposed at GET /time
+        so remote operators can run their lease/TTL arithmetic on HOST time
+        (SyncedClock). Leases written by operators on different machines
+        would otherwise compare renew_time against incomparable per-machine
+        monotonic epochs — takeover permanently blocked, or split-brain.
+
+        `tls`: (cert_path, key_path) pair (see certs.mint_server_cert) —
+        serve HTTPS; the cert can be hot-rotated via rotate_cert().
+
+        `chaos`: a cluster.chaos.WireChaos policy — per-request transport
+        fault injection (5xx, connection reset, watch-session reap) for
+        adversarial testing of the client retry/resubscribe arms."""
         self.api = api
         self.session_ttl = session_ttl
         self.token = token
+        self.chaos = chaos
+        self.now_fn = now_fn or _time.time
+        if token and tls is None and bind not in ("127.0.0.1", "::1", "localhost"):
+            log.warning(
+                "bearer token configured on a non-loopback cleartext bind "
+                "(%s): the token and all API traffic are sniffable; serve "
+                "TLS (--tls) for non-local deployments", bind,
+            )
         # watch_id -> (WatchQueue, last_access_monotonic)
         self._sessions: Dict[str, List[Any]] = {}
         self._sessions_lock = threading.Lock()
@@ -116,6 +146,10 @@ class ApiHTTPServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # Response headers and body go out as separate send()s; with
+            # Nagle on a keep-alive connection the second segment waits on
+            # the client's delayed ACK — a flat ~40ms tax on EVERY request.
+            disable_nagle_algorithm = True
 
             def log_message(self, *a):  # quiet
                 pass
@@ -136,7 +170,13 @@ class ApiHTTPServer:
             def _route(self, method: str) -> None:
                 try:
                     parsed = urllib.parse.urlsplit(self.path)
-                    parts = [p for p in parsed.path.split("/") if p]
+                    # Unquote AFTER splitting: a %2F inside an object name
+                    # must not become a path separator.
+                    parts = [
+                        urllib.parse.unquote(p)
+                        for p in parsed.path.split("/")
+                        if p
+                    ]
                     q = dict(urllib.parse.parse_qsl(parsed.query))
                     outer._dispatch(self, method, parts, q)
                 except NotFoundError as e:
@@ -173,9 +213,28 @@ class ApiHTTPServer:
             request_queue_size = 64
             daemon_threads = True
 
+            def handle_error(self, request, client_address):
+                # TLS handshake failures (plain-HTTP probe against the HTTPS
+                # port, cert rejected by a mis-pinned client) arrive here per
+                # connection; stdlib prints a full traceback to stderr.
+                log.debug("connection error from %s", client_address, exc_info=True)
+
         self._httpd = _Server((bind, port), Handler)
+        self._ssl_context = None
+        scheme = "http"
+        if tls is not None:
+            from training_operator_tpu.cluster import certs as _certs
+
+            self._ssl_context = _certs.server_context(*tls)
+            # Handshake deferred to the handler thread (first read), so a
+            # slow client's handshake can't stall the accept loop.
+            self._httpd.socket = self._ssl_context.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False,
+            )
+            scheme = "https"
         self.port = self._httpd.server_address[1]
-        self.url = f"http://{bind}:{self.port}"
+        self.url = f"{scheme}://{bind}:{self.port}"
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
         # Background session GC: route-handler GC alone never runs once the
@@ -196,6 +255,17 @@ class ApiHTTPServer:
         self._httpd.shutdown()
         self._httpd.server_close()
 
+    def rotate_cert(self, cert_path: str, key_path: str) -> None:
+        """Hot-rotate the serving cert: reload into the LIVE ssl context so
+        new handshakes present the fresh cert while established connections
+        finish on the old one. Clients pin the CA, not the serving cert, so
+        rotation is invisible to them — the reference's rotated webhook
+        serving certs behave the same way (pkg/cert/cert.go:45)."""
+        if self._ssl_context is None:
+            raise RuntimeError("server is not serving TLS")
+        self._ssl_context.load_cert_chain(cert_path, key_path)
+        log.info("rotated serving certificate from %s", cert_path)
+
     # -- dispatch ----------------------------------------------------------
 
     def _dispatch(self, h, method: str, parts: List[str], q: Dict[str, str]) -> None:
@@ -206,6 +276,32 @@ class ApiHTTPServer:
         if head in ("healthz", "readyz"):
             h._send(200, {"ok": True})
             return
+        if head == "time":
+            # Open like the probes: clock sync must work before a client
+            # has its token plumbed, and the value is not sensitive.
+            h._send(200, {"now": self.now_fn()})
+            return
+        if self.chaos is not None:
+            action = self.chaos.sample()
+            if action == "error":
+                h._send(500, {"error": "Internal", "message": "chaos: injected"})
+                return
+            if action == "reset":
+                # No response at all — the client sees a connection reset
+                # (transport failure, not an API status).
+                import socket as _socket
+
+                try:
+                    h.connection.shutdown(_socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                h.close_connection = True
+                return
+            if action == "reap":
+                # Session loss (failover / memory pressure): every watch
+                # client must resubscribe and heal by resync. The request
+                # itself is then served normally.
+                self._reap_all_sessions()
         if self.token is not None:
             import hmac
 
@@ -276,14 +372,18 @@ class ApiHTTPServer:
             if session is None:
                 raise NotFoundError(f"watch session {parts[0]}")
             wq = session[0]
-            timeout = float(q.get("timeout", "0"))
-            deadline = _time.monotonic() + timeout
-            while not len(wq) and _time.monotonic() < deadline:
-                _time.sleep(0.01)
-            # Drain under the API lock: pushes happen while writers hold it,
-            # so this cannot race a concurrent push mid-drain.
-            with self.api._lock:
-                events = wq.drain()
+            # Clamp the client-supplied long-poll timeout well under the
+            # session TTL: a poll allowed to outlive the TTL could have its
+            # session GC'd mid-wait, dropping the buffered events it was
+            # about to receive and forcing a needless resubscribe+resync.
+            timeout = min(float(q.get("timeout", "0")), self.session_ttl / 4)
+            # Park on the store's condition variable — zero CPU while idle,
+            # wakes on the next write, drain atomic w.r.t. pushes.
+            events = self.api.wait_and_drain(wq, timeout=timeout)
+            with self._sessions_lock:
+                session = self._sessions.get(parts[0])
+                if session is not None:
+                    session[1] = _time.monotonic()  # poll completion counts as activity
             h._send(200, {"events": [wire.encode_watch_event(ev) for ev in events]})
         elif method == "DELETE" and len(parts) == 1:
             with self._sessions_lock:
@@ -293,6 +393,13 @@ class ApiHTTPServer:
             h._send(200, {"ok": True})
         else:
             h._send(404, {"error": "NotFound", "message": "bad watches route"})
+
+    def _reap_all_sessions(self) -> None:
+        with self._sessions_lock:
+            dead = list(self._sessions.values())
+            self._sessions.clear()
+        for wq, _ in dead:
+            self.api.unwatch(wq)
 
     def _gc_sessions(self) -> None:
         now = _time.monotonic()
@@ -338,44 +445,182 @@ class ApiHTTPServer:
 
 
 class RemoteWatchQueue:
-    """Client-side handle on a server watch session.
+    """Fanout handle on the client's ONE shared wire watch session.
 
-    `drain()` long-polls by default (`poll_timeout`): the server returns
-    immediately when events are pending and holds the request briefly when
-    none are — so an idle operator loop costs a few requests per second
-    instead of busy-polling an empty queue at tick rate, while event
-    delivery latency stays at one RTT."""
+    Early rounds gave every consumer its own server-side session; with
+    several consumers per process (v1 manager + v2 manager), every idle
+    tick serialized multiple empty long-polls — over a second of pure
+    blocking per tick, a 12x submit->Running overhead on the wire vs
+    in-process. This is the informer fix: one wire session per
+    RemoteAPIServer (see _SharedWatch), events fanned out client-side by
+    kind filter, and at most ONE blocking long-poll per block interval
+    across all consumers. Matches the reference, where any number of
+    controllers share one informer's watch connection per resource.
+
+    `drain()` semantics are unchanged for consumers: returns pending
+    events, long-polling briefly when idle; after a server-side session
+    loss it transparently resubscribes and RELISTS (ListAndWatch), so
+    lost events can delay work but never wedge it.
+    """
+
+    def __init__(self, shared: "_SharedWatch", kinds: Optional[List[str]] = None):
+        from collections import deque
+
+        self._shared = shared
+        self.kinds = set(kinds) if kinds else None
+        self._local: "deque" = deque()
+
+    @property
+    def watch_id(self) -> Optional[str]:
+        return self._shared.watch_id
+
+    def drain(self, timeout: Optional[float] = None) -> List[Any]:
+        return self._shared.drain_for(self, timeout)
+
+    def __len__(self) -> int:
+        return len(self._local)
+
+
+class _SharedWatch:
+    """The one wire watch session a RemoteAPIServer multiplexes.
+
+    The server session subscribes to ALL kinds (client-side filters do the
+    narrowing): per-subscriber server sessions would resurrect the
+    serialized-empty-poll problem this class exists to kill, and the
+    operator-side consumers want all kinds anyway.
+
+    Blocking policy: a drain may long-poll the wire only if no blocking
+    poll happened within `min_block_interval` (one tick); otherwise an
+    empty local queue returns [] immediately. Net effect: an idle process
+    holds ONE cheap long-poll open per window (the server parks it on the
+    store's condition variable — zero CPU both sides), and event delivery
+    latency stays ~one RTT because the parked poll wakes on the write.
+    """
 
     def __init__(
         self,
         remote: "RemoteAPIServer",
-        watch_id: str,
-        kinds: Optional[List[str]] = None,
         poll_timeout: float = 0.25,
+        min_block_interval: float = 0.02,
     ):
         self._remote = remote
-        self.watch_id = watch_id
-        self.kinds = kinds
         self.poll_timeout = poll_timeout
+        self.min_block_interval = min_block_interval
+        self.watch_id: Optional[str] = None
+        self._subs: List[RemoteWatchQueue] = []
+        self._needs_relist = False
+        self._last_block = -float("inf")
+        self._lock = threading.RLock()
 
-    def drain(self, timeout: Optional[float] = None) -> List[Any]:
-        t = self.poll_timeout if timeout is None else timeout
+    # -- subscriber management --------------------------------------------
+
+    def subscribe(self, kinds: Optional[List[str]]) -> RemoteWatchQueue:
+        with self._lock:
+            q = RemoteWatchQueue(self, kinds)
+            self._subs.append(q)
+            if self.watch_id is None:
+                self._open()
+            return q
+
+    def unsubscribe(self, q: RemoteWatchQueue) -> None:
+        with self._lock:
+            if q in self._subs:
+                self._subs.remove(q)
+            if not self._subs and self.watch_id is not None:
+                wid, self.watch_id = self.watch_id, None
+                try:
+                    self._remote._request("DELETE", f"/watches/{wid}")
+                except (NotFoundError, ApiUnavailableError, ApiServerError,
+                        PermissionError):
+                    pass  # server GC reaps stale sessions anyway
+
+    def _open(self) -> None:
+        payload = self._remote._request("POST", "/watches", body={"kinds": None})
+        self.watch_id = payload["watch_id"]
+
+    # -- pumping ----------------------------------------------------------
+
+    def drain_for(self, q: RemoteWatchQueue, timeout: Optional[float]) -> List[Any]:
+        with self._lock:
+            if q not in self._subs:
+                # Drained after unwatch (or a fresh consumer of a dead
+                # handle): rejoin, and heal the unobserved gap by relist.
+                self._subs.append(q)
+                self._needs_relist = True
+            if not q._local:
+                # Contract: an EXPLICIT timeout is an explicit fetch — it
+                # always hits the wire. A bare drain() (the tick-loop form)
+                # is subject to the block window: if some consumer blocked
+                # within the last interval, pending events were already
+                # distributed and the next tick's pump is <=interval away.
+                if self._needs_relist:
+                    self._pump(0.0)
+                elif timeout is not None:
+                    self._pump(timeout)
+                elif (
+                    _time.monotonic() - self._last_block
+                    >= self.min_block_interval
+                ):
+                    self._pump(self.poll_timeout)
+            out = list(q._local)
+            q._local.clear()
+            return out
+
+    def _pump(self, t: float) -> None:
+        if self.watch_id is None:
+            self._open()
+            self._needs_relist = True
+        if self._needs_relist:
+            self._relist()
+            return
+        if t > 0:
+            # Count the attempt, success or not: a 5xx storm must not turn
+            # every consumer's drain back into a serial blocking poll.
+            self._last_block = _time.monotonic()
         try:
             payload = self._remote._request(
-                "GET", f"/watches/{self.watch_id}", query={"timeout": str(t)}
+                "GET", f"/watches/{self.watch_id}", query={"timeout": str(t)},
+                channel="watch",
             )
         except NotFoundError:
-            # Session reaped server-side (we were paused past session_ttl).
-            # Re-subscribe in place; events missed in between are healed by
-            # the consumer's periodic resync, exactly like an informer
-            # relist after a dropped watch connection.
-            fresh = self._remote.watch(self.kinds)
-            self.watch_id = fresh.watch_id
-            return []
-        return [wire.decode_watch_event(d) for d in payload["events"]]
+            # Session reaped server-side (idle past session_ttl, host
+            # restart, injected chaos). Re-subscribe, then RELIST and
+            # synthesize Added events for everything that exists — the
+            # informer ListAndWatch contract on reconnect. Without the
+            # relist, events lost in the gap (above all pod create-echoes)
+            # would wedge the engine's expectations cache until its 5-min
+            # TTL: a job-key resync re-ENQUEUES work but cannot OBSERVE
+            # the pods the lost events carried.
+            self._needs_relist = True
+            self._open()
+            self._relist()
+            return
+        for d in payload["events"]:
+            self._distribute(wire.decode_watch_event(d))
 
-    def __len__(self) -> int:  # pragma: no cover - parity with WatchQueue
-        return 0
+    def _relist(self) -> List[Any]:
+        """Synthesize Added events for the full current state. Watch is
+        (re)opened BEFORE the lists, so an object written in between can be
+        seen twice (consumers are idempotent; expectations tolerate
+        over-observation) but never lost. Only a FULLY successful relist
+        clears the flag — a 5xx mid-relist retries on the next drain."""
+        from training_operator_tpu.cluster.apiserver import WatchEvent
+
+        events = []
+        for kind in wire.KIND_REGISTRY:
+            for obj in self._remote.list(kind):
+                events.append(WatchEvent("Added", kind, obj))
+        self._needs_relist = False
+        for ev in events:
+            self._distribute(ev)
+        return events
+
+    def _distribute(self, ev: Any) -> None:
+        # One shared decoded copy per event, same as the in-process
+        # informer contract (apiserver.py module docstring).
+        for q in self._subs:
+            if q.kinds is None or ev.kind in q.kinds:
+                q._local.append(ev)
 
 
 class RemoteAPIServer:
@@ -386,12 +631,82 @@ class RemoteAPIServer:
     admission runs server-side no matter which client connects.
     """
 
-    def __init__(self, base_url: str, timeout: float = 30.0, token: Optional[str] = None):
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 30.0,
+        token: Optional[str] = None,
+        ca_file: Optional[str] = None,
+    ):
+        """`ca_file`: PEM CA bundle to verify an https host against (the
+        pin on the host-minted CA, certs.mint_ca). Without it an https URL
+        is verified against the system trust store — which will reject a
+        self-signed host CA, loudly, rather than silently not verifying."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.token = token
+        self.ca_file = ca_file
+        self._shared_watch: Optional[_SharedWatch] = None
+        self._local = threading.local()
+        self._ssl_context = None
+        if self.base_url.startswith("https"):
+            from training_operator_tpu.cluster import certs as _certs
+            import ssl as _ssl
+
+            self._ssl_context = (
+                _certs.client_context(ca_file) if ca_file
+                else _ssl.create_default_context()
+            )
 
     # -- transport ---------------------------------------------------------
+
+    def _conn(self, channel: str = "main"):
+        """Thread-local persistent connection (HTTP/1.1 keep-alive), one per
+        (thread, channel).
+
+        urllib opens a fresh TCP (+TLS handshake) connection per request; a
+        reconcile makes ~8 wire calls and a 50-job burst makes hundreds —
+        per-request handshakes alone put the wire deployment several times
+        over the in-process control-plane latency. One keep-alive connection
+        per thread brings a call back to ~one round trip, which is the
+        wire_overhead bench's whole budget.
+
+        `channel` exists because requests on one connection are strictly
+        sequential: the watch long-poll BLOCKS its connection for up to the
+        poll timeout, and CRUD calls queued behind it would eat that wait on
+        every reconcile. Watch traffic therefore rides its own connection.
+        """
+        import http.client
+
+        conn = getattr(self._local, "conn_" + channel, None)
+        if conn is None:
+            parsed = urllib.parse.urlsplit(self.base_url)
+            if parsed.scheme == "https":
+                conn = http.client.HTTPSConnection(
+                    parsed.hostname, parsed.port, timeout=self.timeout,
+                    context=self._ssl_context,
+                )
+            else:
+                conn = http.client.HTTPConnection(
+                    parsed.hostname, parsed.port, timeout=self.timeout
+                )
+            conn.connect()
+            # Same delayed-ACK tax in the other direction: the request line/
+            # headers and the JSON body are separate send()s too.
+            import socket as _socket
+
+            conn.sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            setattr(self._local, "conn_" + channel, conn)
+        return conn
+
+    def _drop_conn(self, channel: str = "main") -> None:
+        conn = getattr(self._local, "conn_" + channel, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            setattr(self._local, "conn_" + channel, None)
 
     def _request(
         self,
@@ -399,43 +714,80 @@ class RemoteAPIServer:
         path: str,
         body: Optional[Dict[str, Any]] = None,
         query: Optional[Dict[str, str]] = None,
+        channel: str = "main",
     ) -> Any:
-        url = self.base_url + path
+        import http.client
+        import socket
+        import ssl as _ssl
+
+        target = path
         if query:
-            url += "?" + urllib.parse.urlencode(query)
+            target += "?" + urllib.parse.urlencode(query)
         data = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"}
         if self.token is not None:
             headers["Authorization"] = f"Bearer {self.token}"
-        req = urllib.request.Request(url, data=data, method=method, headers=headers)
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return json.loads(resp.read() or b"{}")
-        except urllib.error.HTTPError as e:
-            # HTTPError subclasses URLError — map the API-semantic statuses
-            # before the transport-failure arm below can swallow them.
+
+        for attempt in (0, 1):
             try:
-                payload = json.loads(e.read() or b"{}")
-            except ValueError:
-                payload = {}
-            kind = payload.get("error", "")
-            msg = payload.get("message", str(e))
-            if e.code == 404:
-                raise NotFoundError(msg) from None
-            if e.code == 409 and kind == "AlreadyExists":
-                raise AlreadyExistsError(msg) from None
-            if e.code == 409:
-                raise ConflictError(msg) from None
-            if e.code == 422:
-                raise ValueError(msg) from None
-            if e.code == 401:
-                # Auth failures are config errors, not transients — the
-                # operator loop must NOT retry these silently forever.
-                raise PermissionError(msg) from None
-            raise ApiServerError(f"{method} {path}: {e.code} {msg}") from None
-        except (urllib.error.URLError, OSError) as e:
-            # Connection refused/reset, DNS, socket timeout: retryable.
-            raise ApiUnavailableError(f"{method} {path}: {e}") from None
+                # Inside the try: _conn() performs the TCP connect AND the
+                # TLS handshake, where cert verification failures surface.
+                conn = self._conn(channel)
+                conn.request(method, target, body=data, headers=headers)
+                resp = conn.getresponse()
+                raw = resp.read()
+                status = resp.status
+                break
+            except (http.client.HTTPException, socket.timeout, OSError) as e:
+                self._drop_conn(channel)
+                if isinstance(e, _ssl.SSLCertVerificationError):
+                    # A server cert the pinned CA didn't sign is a
+                    # configuration (or impersonation) problem — retrying
+                    # forever in the operator loop would just mask it.
+                    raise PermissionError(
+                        f"{method} {path}: TLS verification failed: {e}"
+                    ) from None
+                if attempt == 0 and method == "GET" and isinstance(
+                    e,
+                    (
+                        http.client.RemoteDisconnected,
+                        http.client.BadStatusLine,
+                        ConnectionResetError,
+                        BrokenPipeError,
+                    ),
+                ):
+                    # A stale keep-alive connection the server closed while
+                    # we were idle dies exactly this way on the next use;
+                    # one transparent retry on a FRESH connection is standard
+                    # (urllib3 does the same) — but only for GET: replaying
+                    # a POST whose response was lost could double-apply a
+                    # create/log-append server-side. Non-idempotent calls
+                    # surface ApiUnavailableError and the caller's retry arm
+                    # (reconcile requeue) absorbs it.
+                    continue
+                raise ApiUnavailableError(f"{method} {path}: {e}") from None
+
+        if status < 400:
+            return json.loads(raw or b"{}")
+        try:
+            payload = json.loads(raw or b"{}")
+        except ValueError:
+            payload = {}
+        kind = payload.get("error", "")
+        msg = payload.get("message", f"HTTP {status}")
+        if status == 404:
+            raise NotFoundError(msg)
+        if status == 409 and kind == "AlreadyExists":
+            raise AlreadyExistsError(msg)
+        if status == 409:
+            raise ConflictError(msg)
+        if status == 422:
+            raise ValueError(msg)
+        if status == 401:
+            # Auth failures are config errors, not transients — the
+            # operator loop must NOT retry these silently forever.
+            raise PermissionError(msg)
+        raise ApiServerError(f"{method} {path}: {status} {msg}")
 
     # -- CRUD --------------------------------------------------------------
 
@@ -451,7 +803,7 @@ class RemoteAPIServer:
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
         return wire.decode(
-            self._request("GET", f"/objects/{kind}/{_ns_seg(namespace)}/{name}")
+            self._request("GET", f"/objects/{_quote_seg(kind)}/{_ns_seg(namespace)}/{_quote_seg(name)}")
         )
 
     def try_get(self, kind: str, namespace: str, name: str) -> Optional[Any]:
@@ -471,7 +823,7 @@ class RemoteAPIServer:
             query["namespace"] = namespace
         if label_selector:
             query["labelSelector"] = ",".join(f"{k}={v}" for k, v in label_selector.items())
-        payload = self._request("GET", f"/objects/{kind}", query=query or None)
+        payload = self._request("GET", f"/objects/{_quote_seg(kind)}", query=query or None)
         return [wire.decode(d) for d in payload["items"]]
 
     def update(self, obj: Any, check_version: bool = True, status_only: bool = False) -> Any:
@@ -479,7 +831,7 @@ class RemoteAPIServer:
         out = wire.decode(
             self._request(
                 "PUT",
-                f"/objects/{obj.KIND}/{_ns_seg(ns)}/{obj.metadata.name}",
+                f"/objects/{_quote_seg(obj.KIND)}/{_ns_seg(ns)}/{_quote_seg(obj.metadata.name)}",
                 body=wire.encode(obj),
                 query={
                     "check_version": "1" if check_version else "0",
@@ -492,7 +844,7 @@ class RemoteAPIServer:
 
     def delete(self, kind: str, namespace: str, name: str) -> Any:
         return wire.decode(
-            self._request("DELETE", f"/objects/{kind}/{_ns_seg(namespace)}/{name}")
+            self._request("DELETE", f"/objects/{_quote_seg(kind)}/{_ns_seg(namespace)}/{_quote_seg(name)}")
         )
 
     def try_delete(self, kind: str, namespace: str, name: str) -> Optional[Any]:
@@ -502,25 +854,24 @@ class RemoteAPIServer:
             return None
 
     def resource_version(self, kind: str, namespace: str, name: str) -> Optional[int]:
-        return self._request("GET", f"/version/{kind}/{_ns_seg(namespace)}/{name}")[
+        return self._request("GET", f"/version/{_quote_seg(kind)}/{_ns_seg(namespace)}/{_quote_seg(name)}")[
             "resourceVersion"
         ]
+
+    def server_time(self) -> float:
+        """The serving host's cluster-clock reading (GET /time)."""
+        return float(self._request("GET", "/time")["now"])
 
     # -- watch -------------------------------------------------------------
 
     def watch(self, kinds: Optional[List[str]] = None) -> RemoteWatchQueue:
-        payload = self._request(
-            "POST", "/watches", body={"kinds": list(kinds) if kinds else None}
-        )
-        return RemoteWatchQueue(
-            self, payload["watch_id"], kinds=list(kinds) if kinds else None
-        )
+        if self._shared_watch is None:
+            self._shared_watch = _SharedWatch(self)
+        return self._shared_watch.subscribe(list(kinds) if kinds else None)
 
     def unwatch(self, queue: RemoteWatchQueue) -> None:
-        try:
-            self._request("DELETE", f"/watches/{queue.watch_id}")
-        except (NotFoundError, ApiUnavailableError, ApiServerError):
-            pass  # best effort; the server GC reaps stale sessions anyway
+        if self._shared_watch is not None:
+            self._shared_watch.unsubscribe(queue)
 
     # -- admission ---------------------------------------------------------
 
@@ -534,7 +885,7 @@ class RemoteAPIServer:
 
     def append_pod_log(self, namespace: str, name: str, line: str, ts: float = 0.0) -> None:
         self._request(
-            "POST", f"/logs/{_ns_seg(namespace)}/{name}", body={"line": line, "ts": ts}
+            "POST", f"/logs/{_ns_seg(namespace)}/{_quote_seg(name)}", body={"line": line, "ts": ts}
         )
 
     def read_pod_log(
@@ -543,7 +894,7 @@ class RemoteAPIServer:
         query = {"since": str(since)}
         if tail is not None:
             query["tail"] = str(tail)
-        payload = self._request("GET", f"/logs/{_ns_seg(namespace)}/{name}", query=query)
+        payload = self._request("GET", f"/logs/{_ns_seg(namespace)}/{_quote_seg(name)}", query=query)
         return payload["lines"], payload["cursor"]
 
     def record_event(self, event: Event) -> None:
@@ -566,6 +917,70 @@ class RemoteAPIServer:
 # ---------------------------------------------------------------------------
 
 
+class SyncedClock(Clock):
+    """A clock slaved to the serving host's cluster clock via GET /time.
+
+    Every timestamp a remote operator writes into shared state — lease
+    acquire/renew times above all — must be comparable with timestamps other
+    processes write. Per-process `time.monotonic()` epochs are machine-boot-
+    relative: two operators on different machines would compare leases
+    across incomparable epochs, permanently blocking takeover or causing
+    instant split-brain. The reference avoids this by using apiserver-
+    comparable wall time for lease renewTime; this clock goes one better
+    and slaves directly to the HOST's clock, so even wall-clock skew
+    between machines cancels out.
+
+    now() = local_monotonic + offset, where offset is estimated against
+    /time with a midpoint RTT correction and re-estimated every
+    `resync_interval`. Between resyncs the clock advances on the local
+    monotonic rate (no network call per now()); a failed resync keeps the
+    previous offset — a host outage must not stop operator-local time.
+    """
+
+    def __init__(self, remote: "RemoteAPIServer", resync_interval: float = 30.0):
+        # Dedicated short-timeout client: the probe runs INSIDE now(), i.e.
+        # inside the operator tick loop — inheriting the 30s CRUD timeout
+        # would freeze ticks for up to 30s per resync attempt during a
+        # blackholed-host partition, exactly when responsiveness matters.
+        self._probe = RemoteAPIServer(
+            remote.base_url, timeout=2.0, token=remote.token,
+            ca_file=remote.ca_file,
+        )
+        self._resync_interval = resync_interval
+        self._offset: Optional[float] = None
+        self._last_sync = -float("inf")
+        self._sync()
+
+    def _sync(self) -> None:
+        t0 = _time.monotonic()
+        try:
+            server_now = self._probe.server_time()
+        except (ApiUnavailableError, ApiServerError, PermissionError):
+            # Count the ATTEMPT as the last sync: during a host outage,
+            # now() must keep running on the cached offset at local rate —
+            # one failed probe per resync_interval, not a blocking network
+            # call per now() (which would freeze the operator tick loop for
+            # the socket timeout, per call, exactly when responsiveness to
+            # the host's return matters most).
+            self._last_sync = _time.monotonic()
+            if self._offset is None:
+                # Never synced: fall back to wall time so timestamps are at
+                # least cross-machine *meaningful*; a later successful
+                # resync snaps onto the host epoch.
+                self._offset = _time.time() - t0
+            return
+        t1 = _time.monotonic()
+        self._offset = server_now - (t0 + t1) / 2.0
+        self._last_sync = t1
+
+    def now(self) -> float:
+        local = _time.monotonic()
+        if local - self._last_sync > self._resync_interval:
+            self._sync()
+            local = _time.monotonic()
+        return local + self._offset
+
+
 class RemoteRuntime:
     """Run loop for a process whose API server lives elsewhere.
 
@@ -579,7 +994,9 @@ class RemoteRuntime:
 
     def __init__(self, api: RemoteAPIServer, tick_interval: float = 0.02):
         self.api = api
-        self.clock = Clock()
+        # Host-slaved time (see SyncedClock): lease and TTL arithmetic in
+        # this process compares against timestamps other processes wrote.
+        self.clock = SyncedClock(api)
         self.tick_interval = tick_interval
         self._tickers: List[Callable[[], None]] = []
         self._timers: List[Tuple[float, int, Callable[[], None]]] = []

@@ -43,6 +43,18 @@ class Clock:
         return False
 
 
+class WallClock(Clock):
+    """Wall-clock time (`time.time()`), for the serving-host role: every
+    timestamp the host persists into durable state (pod start times, lease
+    renew times) must stay meaningful across a host process restart, and
+    monotonic epochs die with the process. Remote operators slave to this
+    clock via GET /time (httpapi.SyncedClock), so NTP steps affect all
+    participants together."""
+
+    def now(self) -> float:
+        return _time.time()
+
+
 class VirtualClock(Clock):
     """Manually-advanced clock for deterministic TTL/backoff/deadline tests."""
 
@@ -366,9 +378,29 @@ class SimKubelet:
         backlog, self._backlog = self._backlog, []
         for pod in backlog:
             self._maybe_start(pod)
+            self._maybe_recover(pod)
         for ev in self._watch.drain():
             if ev.type != "Deleted":
                 self._maybe_start(ev.obj)
+
+    def _maybe_recover(self, pod: Pod) -> None:
+        """Re-arm the completion timer of a pod that was already RUNNING when
+        this kubelet came up — the host-restart recovery path: finish timers
+        are process state and die with the crashed host, but the pod objects
+        (with wall-clock start times) come back from the durable store. A
+        pod whose deadline passed during the outage finishes immediately."""
+        if pod.status.phase != PodPhase.RUNNING:
+            return
+        dur = pod.spec.annotations.get(ANNOTATION_SIM_DURATION)
+        if dur is None:
+            return
+        code = int(pod.spec.annotations.get(ANNOTATION_SIM_EXIT_CODE, "0"))
+        now = self.cluster.clock.now()
+        started = pod.status.start_time if pod.status.start_time is not None else now
+        self.cluster.schedule_at(
+            max(now, started + float(dur)),
+            self._make_finisher(pod.metadata.uid, pod.namespace, pod.name, code),
+        )
 
     def _maybe_start(self, pod: Pod) -> None:
         if (

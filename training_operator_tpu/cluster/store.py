@@ -1,0 +1,323 @@
+"""Durable host state: snapshot + write-ahead journal for the serving role.
+
+The reference control plane never worries about apiserver durability because
+etcd is durable: kill the apiserver and every job, lease, and pod record is
+still there when it returns; operators simply relist and resume
+(SURVEY.md §1 substrate row). The `--role host` process is this framework's
+apiserver+etcd collapsed into one process, so it must supply the durability
+itself — otherwise a host crash erases the cluster out from under operators
+whose own retry loops (httpapi.RemoteRuntime.run_forever) survive just fine.
+
+Design: snapshot + generation-numbered journals.
+
+  snapshot.json        full encoded state (objects, resourceVersion counter,
+                       events, pod logs) plus the journal generation it
+                       covers; written atomically (tmp + fsync + rename)
+  journal.<gen>.jsonl  one JSON line per mutation since that generation
+                       began: put/del/event/log records, appended and
+                       flushed inside the store lock so journal order IS
+                       the store's write order
+
+Compaction rotates to a fresh generation FIRST (cheap, under the API lock so
+no record can fall between capture and rotation), then writes the snapshot
+OUTSIDE the lock — a multi-second state encode never stalls the control
+plane — and only then deletes journals the new snapshot covers. Generations
+make every crash window safe:
+
+  crash after rotation, before snapshot lands → old snapshot + both journal
+      generations replay in order; nothing lost, nothing doubled
+  crash after snapshot lands, before old journals are deleted → recovery
+      replays only generations >= the snapshot's; the stale journal is
+      ignored (and cleaned up), so append-only records (events, pod logs)
+      are never applied twice
+
+Recovery replays journals in generation order. A torn final record — the
+crash landed mid-write — is detected by JSON parse failure, dropped, and
+*physically truncated* from the file, so a later process appending to the
+same generation can never produce a merged corrupt line that would swallow
+acknowledged writes behind it.
+
+Durability level: `flush()` per record (survives kill -9 of the host, the
+failure mode HA actually exercises) + fsync on snapshot rotation. Full
+power-loss fsync-per-write is deliberately not the default — it would gate
+every control-plane write on disk latency, and the reference's own etcd
+batches fsyncs too.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from training_operator_tpu.cluster import wire
+from training_operator_tpu.cluster.apiserver import APIServer
+from training_operator_tpu.cluster.objects import Event
+
+log = logging.getLogger(__name__)
+
+SNAPSHOT = "snapshot.json"
+_JOURNAL_RE = re.compile(r"^journal\.(\d+)\.jsonl$")
+
+
+def journal_name(gen: int) -> str:
+    return f"journal.{gen:08d}.jsonl"
+
+
+class HostStore:
+    """Snapshot+journal persistence attached to one APIServer.
+
+    Usage (host boot):
+        store = HostStore(state_dir)
+        store.load_into(api)      # restore prior state (no-op first boot)
+        store.attach(api)         # journal every subsequent mutation
+        ...
+        store.maybe_compact(api)  # called periodically from the host loop
+    """
+
+    def __init__(self, root: str, compact_every: int = 4096):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.compact_every = compact_every
+        self._lock = threading.Lock()
+        self._journal_fh = None
+        self._gen = 0
+        self._records_since_snapshot = 0
+
+    # -- restore -----------------------------------------------------------
+
+    def load_into(self, api: APIServer) -> Tuple[int, int]:
+        """Restore snapshot + journals into `api`; returns (objects,
+        replayed journal records). Must run before `attach` and before any
+        watchers besides the cluster's own SharedInformer exist — restored
+        objects are announced as Added events so informers seeded at
+        cluster construction converge."""
+        objects: Dict[Tuple[str, str, str], Any] = {}
+        events: List[Event] = []
+        pod_logs: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        rv = 0
+        snap_gen = 0
+
+        snap_path = os.path.join(self.root, SNAPSHOT)
+        if os.path.exists(snap_path):
+            with open(snap_path) as f:
+                snap = json.load(f)
+            rv = int(snap.get("rv", 0))
+            snap_gen = int(snap.get("gen", 0))
+            for data in snap.get("objects", []):
+                obj = wire.decode(data)
+                objects[_key(obj)] = obj
+            for data in snap.get("events", []):
+                events.append(wire.decode(data, Event))
+            for entry in snap.get("pod_logs", []):
+                pod_logs[(entry["ns"], entry["name"])] = {
+                    "lines": [(float(ts), ln) for ts, ln in entry["lines"]],
+                    "base": int(entry["base"]),
+                }
+
+        replayed = 0
+        gens = self._journal_gens()
+        for gen in gens:
+            if gen < snap_gen:
+                # The snapshot already covers this generation; the compact
+                # that wrote it crashed before deleting the file. Records
+                # here would double-apply (events/logs append) — skip and
+                # clean up.
+                os.unlink(os.path.join(self.root, journal_name(gen)))
+                continue
+            n, file_rv = self._replay_file(
+                os.path.join(self.root, journal_name(gen)),
+                objects, events, pod_logs,
+            )
+            replayed += n
+            # del records carry the rv counter at delete time precisely so
+            # a deleted-then-recreated name can never re-reach a dead
+            # incarnation's version (a stale pre-crash client write would
+            # then pass check_version and clobber the new object).
+            rv = max(rv, file_rv)
+        self._gen = max([snap_gen] + [g for g in gens if g >= snap_gen] or [0])
+
+        # rv must also end past every restored object's version.
+        for obj in objects.values():
+            rv = max(rv, int(obj.metadata.resource_version or 0))
+
+        api.restore(list(objects.values()), rv, events, pod_logs)
+        if objects or replayed:
+            log.info(
+                "restored %d object(s) at rv=%d (+%d journal records, gen %d) from %s",
+                len(objects), rv, replayed, self._gen, self.root,
+            )
+        return len(objects), replayed
+
+    def _journal_gens(self) -> List[int]:
+        gens = []
+        for name in os.listdir(self.root):
+            m = _JOURNAL_RE.match(name)
+            if m:
+                gens.append(int(m.group(1)))
+        return sorted(gens)
+
+    def _replay_file(self, path, objects, events, pod_logs) -> Tuple[int, int]:
+        """Replay one journal file; returns (records, max rv watermark seen).
+        Truncates a torn trailing record so a future append to the same
+        generation cannot merge with the fragment into one corrupt line
+        that would hide later records."""
+        replayed = 0
+        max_rv = 0
+        valid_end = 0
+        torn = False
+        with open(path, "r+") as f:
+            while True:
+                line = f.readline()
+                if not line:
+                    break
+                stripped = line.strip()
+                if not stripped:
+                    valid_end = f.tell()
+                    continue
+                try:
+                    rec = json.loads(stripped)
+                except ValueError:
+                    torn = True
+                    break
+                if not line.endswith("\n"):
+                    # Parsed, but the newline (written atomically with the
+                    # record) is missing: treat as torn — the flush may not
+                    # have covered the whole record.
+                    torn = True
+                    break
+                valid_end = f.tell()
+                replayed += 1
+                max_rv = max(max_rv, self._apply(rec, objects, events, pod_logs))
+            if torn:
+                f.truncate(valid_end)
+                log.warning(
+                    "%s ended in a torn record; truncated to %d bytes",
+                    path, valid_end,
+                )
+        return replayed, max_rv
+
+    @staticmethod
+    def _apply(rec, objects, events, pod_logs) -> int:
+        """Apply one record; returns the rv watermark it implies (0 = none)."""
+        op = rec.get("op")
+        if op == "put":
+            obj = wire.decode(rec["obj"])
+            objects[_key(obj)] = obj
+            return int(obj.metadata.resource_version or 0)
+        elif op == "del":
+            objects.pop((rec["kind"], rec["ns"], rec["name"]), None)
+            if rec["kind"] == "Pod":
+                pod_logs.pop((rec["ns"], rec["name"]), None)
+            return int(rec.get("rv", 0))
+        elif op == "event":
+            events.append(wire.decode(rec["event"], Event))
+        elif op == "log":
+            buf = pod_logs.setdefault(
+                (rec["ns"], rec["name"]), {"lines": [], "base": 0}
+            )
+            # Same framing as APIServer.append_pod_log: the sink records
+            # the original (possibly multi-line) string.
+            for ln in str(rec["line"]).splitlines() or [""]:
+                buf["lines"].append((float(rec["ts"]), ln))
+        return 0
+
+    # -- journal sink ------------------------------------------------------
+
+    def attach(self, api: APIServer) -> None:
+        """Open the current-generation journal for append and register as
+        the APIServer's journal sink. From here on every mutation lands in
+        the journal before the API call returns (the sink runs inside the
+        store lock)."""
+        self._journal_fh = open(
+            os.path.join(self.root, journal_name(self._gen)), "a"
+        )
+        api.attach_journal(self._sink)
+
+    def _sink(self, op: str, *args: Any) -> None:
+        if op == "put":
+            (obj,) = args
+            rec = {"op": "put", "obj": wire.encode(obj)}
+        elif op == "del":
+            kind, ns, name, rv = args
+            rec = {"op": "del", "kind": kind, "ns": ns, "name": name, "rv": rv}
+        elif op == "event":
+            (event,) = args
+            rec = {"op": "event", "event": wire.encode(event)}
+        elif op == "log":
+            ns, name, line, ts = args
+            rec = {"op": "log", "ns": ns, "name": name, "line": line, "ts": ts}
+        else:  # pragma: no cover - defensive
+            return
+        with self._lock:
+            fh = self._journal_fh
+            if fh is None:
+                return
+            fh.write(json.dumps(rec) + "\n")
+            fh.flush()
+            self._records_since_snapshot += 1
+
+    # -- compaction --------------------------------------------------------
+
+    def maybe_compact(self, api: APIServer) -> bool:
+        """Rotate journal into a fresh snapshot once enough records have
+        accumulated. Called from the host main loop (never a handler
+        thread)."""
+        with self._lock:
+            if self._records_since_snapshot < self.compact_every:
+                return False
+        self.compact(api)
+        return True
+
+    def compact(self, api: APIServer) -> None:
+        """Capture state and rotate the journal generation under the API
+        lock (both cheap), then write the snapshot OUTSIDE it — the
+        multi-second encode+fsync of a large state must not stall every
+        concurrent API request. Crash windows are covered by the
+        generation scheme (see module docstring)."""
+        # Lock order everywhere is api lock -> store lock (mutating writers
+        # hold the api lock when the sink takes the store lock).
+        with api.locked():
+            snap = api.snapshot_state()
+            with self._lock:
+                new_gen = self._gen + 1
+                if self._journal_fh is not None:
+                    self._journal_fh.close()
+                self._journal_fh = open(
+                    os.path.join(self.root, journal_name(new_gen)), "a"
+                )
+                old_gen, self._gen = self._gen, new_gen
+                self._records_since_snapshot = 0
+        snap["gen"] = self._gen  # journals >= this gen are NOT in the snapshot
+
+        tmp = os.path.join(self.root, SNAPSHOT + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.root, SNAPSHOT))
+        # Only after the snapshot durably covers them:
+        for gen in self._journal_gens():
+            if gen <= old_gen:
+                try:
+                    os.unlink(os.path.join(self.root, journal_name(gen)))
+                except OSError:
+                    pass
+        log.info(
+            "compacted state into %s (gen %d)",
+            os.path.join(self.root, SNAPSHOT), self._gen,
+        )
+
+    def close(self) -> None:
+        with self._lock:
+            if self._journal_fh is not None:
+                self._journal_fh.close()
+                self._journal_fh = None
+
+
+def _key(obj: Any) -> Tuple[str, str, str]:
+    ns = getattr(obj.metadata, "namespace", "") or ""
+    return (obj.KIND, ns, obj.metadata.name)

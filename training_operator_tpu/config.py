@@ -40,6 +40,23 @@ class OperatorConfig:
     # Gang solve cadence (GangScheduler knobs).
     resolve_period: float = 15.0
     min_solve_interval: float = 0.0
+    # Tail-latency SLO knobs (TPUPacker; see scheduler/packer.py:158-199
+    # and the README tail-latency sweep for the measured trade-offs):
+    #   drain_reserve_seconds — a whole-slice gang waiting longer than this
+    #       triggers drain reservations (nearly-empty slices withheld from
+    #       backfill so they drain to fully-free). <=0 disables.
+    #   max_drain_fraction — cap on the fraction of slices withheld per
+    #       cycle, protecting the median path's capacity.
+    #   aging_seconds — a gang waiting longer than this is promoted to the
+    #       front in FIFO order, bounding starvation under WSJF.
+    # Defaults are the measured 1k-burst sweet spot (300s/0.08: p99 -1.2%,
+    # util +0.9pp vs drain-off at unchanged p50); the aggressive corner
+    # (150s/0.15) cuts whole-slice p90 ~20% but shifts tail onto sub-slice
+    # gangs — a class-fairness choice a deployment makes HERE, not by
+    # editing source.
+    drain_reserve_seconds: float = 300.0
+    max_drain_fraction: float = 0.08
+    aging_seconds: float = 300.0
     # Probe/metrics HTTP port; 0 disables (reference --health-probe-bind-
     # address / --metrics-bind-address, collapsed to one server here).
     health_port: int = 0
@@ -77,6 +94,10 @@ class OperatorConfig:
             )
         if self.controller_threads < 1:
             raise ValueError("controller_threads must be >= 1")
+        if not 0.0 <= self.max_drain_fraction <= 1.0:
+            raise ValueError("max_drain_fraction must be in [0, 1]")
+        if self.aging_seconds < 0:
+            raise ValueError("aging_seconds must be >= 0")
         if self.leader_lease_duration <= 0:
             # A non-positive lease is permanently expired: leadership would
             # flap between candidates every tick, each transition firing a

@@ -51,19 +51,23 @@ class TrainingClient:
         namespace: str = "default",
         job_kind: str = "JAXJob",
         api_token: Optional[str] = None,
+        ca_file: Optional[str] = None,
     ):
         """`cluster` is either an in-process Cluster or a base URL string
-        ("http://127.0.0.1:8443") of a serving host process — the remote
+        ("https://127.0.0.1:8443") of a serving host process — the remote
         mode mirroring the reference client's REST relationship with the
         kube-apiserver (training_client.py:41). `api_token` is the bearer
-        token for a token-gated host (remote mode only)."""
+        token for a token-gated host; `ca_file` pins the host's CA for an
+        https URL (the host announces it as WIRE_CA=...). Remote mode only."""
         if isinstance(cluster, str):
             from training_operator_tpu.cluster.httpapi import (
                 RemoteAPIServer,
                 RemoteRuntime,
             )
 
-            cluster = RemoteRuntime(RemoteAPIServer(cluster, token=api_token))
+            cluster = RemoteRuntime(
+                RemoteAPIServer(cluster, token=api_token, ca_file=ca_file)
+            )
         self.cluster = cluster
         self.api = cluster.api
         self.namespace = namespace

@@ -1,0 +1,60 @@
+"""Subprocess announcement reading for wire-deployment harnesses.
+
+The host/operator processes announce machine-parsable lines on stdout
+(`WIRE_API=...`, `WIRE_CA=...`, `OPERATOR_UP=...`). Everything that spawns
+them — the e2e tests, the remote-HA example, the wire-overhead bench — needs
+the same careful reader: select()-gated (a silent-but-alive process trips
+the deadline instead of blocking readline() forever), matching only COMPLETE
+lines (a chunk boundary mid-announcement would yield half a port number),
+and KEEPING unmatched complete lines for later reads (consecutive
+announcements often arrive in one pipe chunk; a reader that discards the
+tail would lose WIRE_CA printed right after WIRE_API and hang forever
+waiting for it). One shared implementation so the harnesses cannot drift.
+"""
+
+from __future__ import annotations
+
+import os
+import select
+import time
+
+
+def read_announcement(
+    proc,
+    prefix: str,
+    timeout: float = 45.0,
+    error: type = RuntimeError,
+) -> str:
+    """Scan `proc`'s stdout until a line starting with `prefix` appears;
+    return the text after the first '='. Leftover complete lines persist on
+    the proc (`_pending_lines`) across calls."""
+    pending = getattr(proc, "_pending_lines", None)
+    if pending is None:
+        pending = proc._pending_lines = []
+    deadline = time.monotonic() + timeout
+    buf = ""
+    while time.monotonic() < deadline:
+        while pending:
+            line = pending.pop(0)
+            if line.startswith(prefix):
+                return line.strip().split("=", 1)[1]
+        if proc.poll() is not None:
+            raise error(
+                f"process exited rc={proc.returncode} before announcing {prefix}"
+            )
+        ready, _, _ = select.select([proc.stdout], [], [], 0.2)
+        if not ready:
+            continue
+        chunk = os.read(proc.stdout.fileno(), 4096).decode(errors="replace")
+        if not chunk:
+            if proc.poll() is not None:
+                raise error(
+                    f"process exited rc={proc.returncode} before announcing {prefix}"
+                )
+            time.sleep(0.05)
+            continue
+        buf += chunk
+        lines = buf.split("\n")
+        buf = lines.pop()
+        pending.extend(lines)
+    raise error(f"no {prefix} announcement within {timeout}s")
