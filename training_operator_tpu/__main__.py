@@ -550,9 +550,13 @@ def run_operator(args, cfg) -> int:
 
     token = args.api_token or _os.environ.get("TPU_OPERATOR_API_TOKEN") or None
     ca_file = args.ca_cert or _os.environ.get("TPU_OPERATOR_CA_CERT") or None
-    runtime = RemoteRuntime(
-        RemoteAPIServer(args.api_server, token=token, ca_file=ca_file)
-    )
+    from training_operator_tpu.cluster.httpapi import CachedReadAPI
+
+    remote = RemoteAPIServer(args.api_server, token=token, ca_file=ca_file)
+    runtime = RemoteRuntime(remote)
+    # Reads from the informer mirror, writes direct (client-go listers):
+    # reconciles stop paying wire round trips for every pod/service list.
+    runtime.api = CachedReadAPI(remote)
     mgr = OperatorManager(
         runtime,
         gang_enabled=cfg.gang_scheduler_name != "none",
@@ -561,6 +565,8 @@ def run_operator(args, cfg) -> int:
         leader_elect=cfg.leader_elect,
         identity=cfg.leader_identity,
         lease_duration=cfg.leader_lease_duration,
+        # Real concurrency only where reconciles pay wire latency.
+        parallel_reconciles=min(8, cfg.controller_threads),
     )
     for scheme in cfg.enabled_schemes:
         mgr.register(SCHEME_CONTROLLERS[scheme](runtime.api))

@@ -180,22 +180,30 @@ class APIServer:
         without reaching into `_lock`."""
         return self._lock
 
-    def snapshot_state(self) -> Dict[str, Any]:
-        """Wire-encoded full state for a snapshot file. Caller should hold
-        `locked()` if atomicity with other effects matters."""
-        from training_operator_tpu.cluster import wire
-
+    def snapshot_refs(self) -> Dict[str, Any]:
+        """CHEAP capture of full state under the lock: object REFERENCES
+        (safe — updates replace stored objects, never mutate them in
+        place), a copy of the append-only event list, and copies of the
+        pod-log line lists (those ARE mutated in place). The caller encodes
+        OUTSIDE the lock — on a large store the wire-encode is the
+        expensive part, and doing it under the lock would stall every
+        concurrent API request (see HostStore.compact)."""
         with self._lock:
             return {
                 "rv": self._rv_value,
-                "objects": [wire.encode(o) for o in self._objects.values()],
-                "events": [wire.encode(e) for e in self._events],
+                "objects": list(self._objects.values()),
+                "events": list(self._events),
                 "pod_logs": [
-                    {"ns": ns, "name": name, "base": buf["base"],
-                     "lines": [[ts, ln] for ts, ln in buf["lines"]]}
+                    (ns, name, buf["base"], list(buf["lines"]))
                     for (ns, name), buf in self._pod_logs.items()
                 ],
             }
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        """Wire-encoded full state for a snapshot file (atomic capture,
+        encode included — prefer snapshot_refs + encode_snapshot when the
+        lock must stay cheap)."""
+        return encode_snapshot(self.snapshot_refs())
 
     def restore(
         self,
@@ -477,3 +485,21 @@ class APIServer:
                 if (object_name is None or e.object_name == object_name)
                 and (reason is None or e.reason == reason)
             ]
+
+
+def encode_snapshot(refs: Dict[str, Any]) -> Dict[str, Any]:
+    """Wire-encode a snapshot_refs() capture (no lock needed: the captured
+    references are immutable-by-convention — updates replace stored objects
+    — and the event/log lists are copies)."""
+    from training_operator_tpu.cluster import wire
+
+    return {
+        "rv": refs["rv"],
+        "objects": [wire.encode(o) for o in refs["objects"]],
+        "events": [wire.encode(e) for e in refs["events"]],
+        "pod_logs": [
+            {"ns": ns, "name": name, "base": base,
+             "lines": [[ts, ln] for ts, ln in lines]}
+            for ns, name, base, lines in refs["pod_logs"]
+        ],
+    }

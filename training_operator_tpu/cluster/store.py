@@ -274,14 +274,17 @@ class HostStore:
 
     def compact(self, api: APIServer) -> None:
         """Capture state and rotate the journal generation under the API
-        lock (both cheap), then write the snapshot OUTSIDE it — the
-        multi-second encode+fsync of a large state must not stall every
-        concurrent API request. Crash windows are covered by the
-        generation scheme (see module docstring)."""
+        lock (both cheap: snapshot_refs grabs references, not encodings),
+        then ENCODE and write the snapshot OUTSIDE it — the multi-second
+        wire-encode+fsync of a large state must not stall every concurrent
+        API request. Crash windows are covered by the generation scheme
+        (see module docstring)."""
+        from training_operator_tpu.cluster.apiserver import encode_snapshot
+
         # Lock order everywhere is api lock -> store lock (mutating writers
         # hold the api lock when the sink takes the store lock).
         with api.locked():
-            snap = api.snapshot_state()
+            refs = api.snapshot_refs()
             with self._lock:
                 new_gen = self._gen + 1
                 if self._journal_fh is not None:
@@ -291,6 +294,7 @@ class HostStore:
                 )
                 old_gen, self._gen = self._gen, new_gen
                 self._records_since_snapshot = 0
+        snap = encode_snapshot(refs)
         snap["gen"] = self._gen  # journals >= this gen are NOT in the snapshot
 
         tmp = os.path.join(self.root, SNAPSHOT + ".tmp")

@@ -42,6 +42,7 @@ class OperatorManager:
         identity: Optional[str] = None,
         lease_duration: float = 15.0,
         resync_period: Optional[float] = 300.0,
+        parallel_reconciles: int = 0,
     ):
         self.cluster = cluster
         self.api = cluster.api
@@ -61,6 +62,23 @@ class OperatorManager:
         # resync covers this) would ignore them for a full resync_period.
         self._last_resync: Optional[float] = None
         self.queue = RateLimitingQueue()
+        # Concurrent reconcile workers (reference --controller-threads /
+        # MaxConcurrentReconciles). 0 = sequential, the right choice for
+        # the in-process substrate where an API call is a dict op; the
+        # REMOTE operator sets this, because there each reconcile pays
+        # serialized wire round trips for its writes and N workers overlap
+        # them. Safe for concurrent keys: the queue dedupes, reconciles of
+        # distinct jobs touch distinct expectation keys, and the wire
+        # client keeps per-thread connections.
+        self.parallel_reconciles = parallel_reconciles
+        self._pool = None
+        if parallel_reconciles > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=parallel_reconciles,
+                thread_name_prefix="reconcile",
+            )
         self.controllers: Dict[str, Tuple[object, JobController]] = {}
         self._watch = self.api.watch()
         # Leader election (reference --enable-leader-election): a standby
@@ -103,6 +121,8 @@ class OperatorManager:
         its admission hooks (or each dead generation re-validates every
         submit)."""
         self.cluster.remove_ticker(self.tick)
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
         self.api.unwatch(self._watch)
         for kind in self.controllers:
             self.api.unregister_admission(kind, validate_job)
@@ -181,8 +201,14 @@ class OperatorManager:
             self._resync_all()
         for ev in self._watch.drain():
             self._handle_event(ev)
-        for key in self.queue.drain(limit=self.reconciles_per_tick):
-            self._process(key)
+        keys = self.queue.drain(limit=self.reconciles_per_tick)
+        if self._pool is not None and len(keys) > 1:
+            # Overlap the per-reconcile wire round trips; join before the
+            # tick ends so event handling never races in-flight reconciles.
+            list(self._pool.map(self._process, keys))
+        else:
+            for key in keys:
+                self._process(key)
         metrics.workqueue_depth.set(value=float(len(self.queue)))
 
     def _handle_event(self, ev) -> None:

@@ -32,7 +32,10 @@ def read_announcement(
     if pending is None:
         pending = proc._pending_lines = []
     deadline = time.monotonic() + timeout
-    buf = ""
+    # The partial trailing line persists across CALLS too: a chunk boundary
+    # can split an announcement's head into one call's read and its tail
+    # into the next call's — a local buffer would orphan the head.
+    buf = getattr(proc, "_pending_buf", "")
     while time.monotonic() < deadline:
         while pending:
             line = pending.pop(0)
@@ -55,6 +58,6 @@ def read_announcement(
             continue
         buf += chunk
         lines = buf.split("\n")
-        buf = lines.pop()
+        buf = proc._pending_buf = lines.pop()
         pending.extend(lines)
     raise error(f"no {prefix} announcement within {timeout}s")
