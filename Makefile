@@ -33,6 +33,9 @@ operator-demo:   ## the operator process end-to-end on the example workload
 ha-demo:         ## wire deployment: host + 2 operator processes, leader killed
 	$(PY) examples/remote_ha.py
 
+wire-bench:      ## wire-deployment overhead vs in-process (200-job burst)
+	JAX_PLATFORMS=cpu $(PY) bench.py --wire-overhead-only
+
 native:          ## force-rebuild the C++ data-path core (drops the hash cache)
 	$(PY) -c "from training_operator_tpu import native; import glob, os; \
 	[os.remove(p) for p in glob.glob(str(native._cache_dir() / 'dataio-*.so'))]; \

@@ -34,24 +34,10 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LEASE_SECONDS = 2.0  # short so dead-leader takeover keeps the test fast
 
 
-def _proc_env():
-    return {
-        "PATH": os.environ.get("PATH", ""),
-        "HOME": os.environ.get("HOME", "/tmp"),
-        "PYTHONPATH": REPO_ROOT,
-        "PYTHONUNBUFFERED": "1",
-    }
-
-
 def _spawn(args):
-    return subprocess.Popen(
-        [sys.executable, "-m", "training_operator_tpu", *args],
-        env=_proc_env(),
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-        text=True,
-        cwd=REPO_ROOT,
-    )
+    from training_operator_tpu.utils.procio import spawn_module_process
+
+    return spawn_module_process(args, REPO_ROOT)
 
 
 def _read_line_with_prefix(proc, prefix, timeout=30.0):
@@ -60,15 +46,7 @@ def _read_line_with_prefix(proc, prefix, timeout=30.0):
     return read_announcement(proc, prefix, timeout=timeout, error=AssertionError)
 
 
-def _kill_all(procs):
-    for p in procs:
-        if p.poll() is None:
-            p.kill()
-    for p in procs:
-        try:
-            p.communicate(timeout=10)
-        except subprocess.TimeoutExpired:
-            pass
+from training_operator_tpu.utils.procio import kill_all as _kill_all
 
 
 def _job(name: str, run_seconds: float) -> JAXJob:
@@ -248,5 +226,50 @@ def test_leader_killed_standby_process_converges(tmp_path):
         # came over the wire.
         assert operators[standby].poll() is None
         assert len(client.get_job_pods("ha-job")) == 2
+    finally:
+        _kill_all(procs)
+
+
+def test_token_authed_wire_deployment(tmp_path):
+    """The full wire deployment with BOTH auth layers on: TLS (transport)
+    + bearer token (authn). An operator with the right token converges
+    work; a client with a wrong token is rejected loudly (PermissionError,
+    not a silent retry)."""
+    inv = tmp_path / "cluster.json"
+    inv.write_text('{"cpu_pools": [{"nodes": 2, "cpu_per_node": 8.0}]}')
+
+    host = _spawn([
+        "--role", "host", "--serve-port", "0",
+        "--gang-scheduler-name", "none", "--cluster", str(inv),
+        "--api-token", "wire-secret",
+    ])
+    procs = [host]
+    try:
+        url = _read_line_with_prefix(host, "WIRE_API")
+        ca = _read_line_with_prefix(host, "WIRE_CA")
+        op = _spawn([
+            "--role", "operator", "--api-server", url, "--ca-cert", ca,
+            "--api-token", "wire-secret",
+            "--enable-scheme", "jax", "--gang-scheduler-name", "none",
+        ])
+        procs.append(op)
+        _read_line_with_prefix(op, "OPERATOR_UP")
+
+        client = TrainingClient(url, api_token="wire-secret", ca_file=ca)
+        client.create_job(_job("authed-job", run_seconds=0.5))
+        job = client.wait_for_job_conditions(
+            "authed-job", expected_conditions=(capi.JobConditionType.SUCCEEDED,),
+            timeout=60,
+        )
+        assert capi.is_succeeded(job.status)
+
+        # Wrong token: loud config error on a verified TLS channel.
+        bad = RemoteAPIServer(url, timeout=10.0, token="nope", ca_file=ca)
+        with pytest.raises(PermissionError):
+            bad.list("JAXJob")
+        # Missing token: same.
+        anon = RemoteAPIServer(url, timeout=10.0, ca_file=ca)
+        with pytest.raises(PermissionError):
+            anon.list("JAXJob")
     finally:
         _kill_all(procs)

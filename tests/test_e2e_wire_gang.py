@@ -29,22 +29,12 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _spawn(args):
-    return subprocess.Popen(
-        [sys.executable, "-m", "training_operator_tpu", *args],
-        env={
-            "PATH": os.environ.get("PATH", ""),
-            "HOME": os.environ.get("HOME", "/tmp"),
-            "PYTHONPATH": REPO_ROOT,
-            "PYTHONUNBUFFERED": "1",
-            # conftest scrubbed any site-injected accelerator plugin from
-            # PYTHONPATH, so the host's solver jit-compiles on clean CPU.
-            "JAX_PLATFORMS": "cpu",
-        },
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-        text=True,
-        cwd=REPO_ROOT,
-    )
+    from training_operator_tpu.utils.procio import spawn_module_process
+
+    # conftest scrubbed any site-injected accelerator plugin from
+    # PYTHONPATH, so the host's solver jit-compiles on clean CPU.
+    return spawn_module_process(args, REPO_ROOT,
+                                env_extra={"JAX_PLATFORMS": "cpu"})
 
 
 def _tpu_job(name: str, topology: str, workers: int, run_seconds: float) -> JAXJob:
@@ -140,11 +130,6 @@ def test_tpu_gang_placed_and_converged_over_the_wire(tmp_path):
             )
             assert capi.is_succeeded(job.status), (name, job.status)
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-        for p in procs:
-            try:
-                p.communicate(timeout=10)
-            except subprocess.TimeoutExpired:
-                pass
+        from training_operator_tpu.utils.procio import kill_all
+
+        kill_all(procs)

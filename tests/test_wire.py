@@ -614,3 +614,28 @@ class TestCachedReadAPI:
             assert got.metadata.resource_version >= 1
         finally:
             server.close()
+
+    def test_overflow_reprimes_instead_of_ghosting(self):
+        """A consumer that stops draining (a STANDBY operator never lists)
+        must not accumulate events unboundedly; on overflow the mirror is
+        rebuilt from authoritative lists — correct, not just bounded."""
+        cluster, server, remote, cached = self._stack()
+        try:
+            pump = remote.watch()
+            cached._q.overflow_limit = 8  # tiny, to trip it in-test
+            assert cached.list("Pod") == []  # primes
+            # A burst far past the limit while the cache never drains.
+            for i in range(40):
+                cluster.api.create(
+                    Pod(metadata=ObjectMeta(name=f"b-{i}", namespace="d"),
+                        spec=PodTemplateSpec(containers=[Container(name="c")]))
+                )
+            pump.drain(timeout=1.0)  # distributes; cache queue overflows
+            assert len(cached._q._local) <= 8
+            # Deleting one while the history is already gone must not ghost.
+            cluster.api.delete("Pod", "d", "b-0")
+            pump.drain(timeout=1.0)
+            names = {p.metadata.name for p in cached.list("Pod")}
+            assert len(names) == 39 and "b-0" not in names
+        finally:
+            server.close()

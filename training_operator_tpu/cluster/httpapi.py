@@ -449,6 +449,13 @@ class ApiHTTPServer:
 # re-announces survivors, so ghosts would live in the cache forever.
 RELIST_RESET = object()
 
+# Sentinel left as the sole content of a fanout queue whose consumer stopped
+# draining and let it hit its overflow limit: "your event history is gone —
+# rebuild from authoritative lists". Only mirror-building consumers opt into
+# bounded queues; for them a lost history is recoverable (re-prime), whereas
+# silently dropping individual events would leave permanent ghosts.
+QUEUE_OVERFLOW = object()
+
 
 class RemoteWatchQueue:
     """Fanout handle on the client's ONE shared wire watch session.
@@ -480,7 +487,23 @@ class RemoteWatchQueue:
         # re-enqueues work from authoritative lists) do not, and must not
         # have to know about the sentinel.
         self.reset_on_relist = False
+        # Bound for consumers that may legitimately stop draining for long
+        # stretches (a STANDBY operator never lists, so its lister cache
+        # never drains — without a bound every cluster event would
+        # accumulate in this deque for the whole standby lifetime). 0 = no
+        # bound (tick-driven consumers drain every tick by construction).
+        # On overflow the queue is collapsed to QUEUE_OVERFLOW.
+        self.overflow_limit = 0
         self._local: "deque" = deque()
+
+    def _append(self, item: Any) -> None:
+        if self.overflow_limit and len(self._local) >= self.overflow_limit:
+            if self._local and self._local[-1] is QUEUE_OVERFLOW:
+                return
+            self._local.clear()
+            self._local.append(QUEUE_OVERFLOW)
+            return
+        self._local.append(item)
 
     @property
     def watch_id(self) -> Optional[str]:
@@ -638,7 +661,7 @@ class _SharedWatch:
         # Deleted event is gone forever.
         for q in self._subs:
             if q.reset_on_relist:
-                q._local.append(RELIST_RESET)
+                q._append(RELIST_RESET)
         for ev in events:
             self._distribute(ev)
         return events
@@ -648,7 +671,7 @@ class _SharedWatch:
         # informer contract (apiserver.py module docstring).
         for q in self._subs:
             if q.kinds is None or ev.kind in q.kinds:
-                q._local.append(ev)
+                q._append(ev)
 
 
 class RemoteAPIServer:
@@ -979,6 +1002,7 @@ class CachedReadAPI:
         self._primed: set = set()
         self._q = remote.watch()  # all kinds
         self._q.reset_on_relist = True
+        self._q.overflow_limit = 8192  # standby-safe: see RemoteWatchQueue
         # Parallel reconcile workers (OperatorManager parallel_reconciles)
         # list concurrently; mirror mutation must be atomic.
         self._cache_lock = threading.Lock()
@@ -997,6 +1021,13 @@ class CachedReadAPI:
                 # empty bucket, not by a re-prime).
                 self._mirror.clear()
                 self._primed = set(wire.KIND_REGISTRY)
+                continue
+            if ev is QUEUE_OVERFLOW:
+                # The queue overflowed while nobody was listing (a standby
+                # term): the event history is gone, so the mirror cannot be
+                # patched — rebuild lazily from authoritative lists.
+                self._mirror.clear()
+                self._primed.clear()
                 continue
             ns = getattr(ev.obj.metadata, "namespace", "") or ""
             key = (ns, ev.obj.metadata.name)

@@ -61,3 +61,43 @@ def read_announcement(
         buf = proc._pending_buf = lines.pop()
         pending.extend(lines)
     raise error(f"no {prefix} announcement within {timeout}s")
+
+
+def spawn_module_process(args, repo_root: str, env_extra=None):
+    """Spawn `python -m training_operator_tpu <args>` the way the e2e
+    harnesses do: minimal environment (PATH/HOME/PYTHONPATH only, plus
+    `env_extra`), stdout piped for announcement reading, stderr merged."""
+    import subprocess
+    import sys
+
+    env = {
+        "PATH": os.environ.get("PATH", ""),
+        "HOME": os.environ.get("HOME", "/tmp"),
+        "PYTHONPATH": repo_root,
+        "PYTHONUNBUFFERED": "1",
+    }
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.Popen(
+        [sys.executable, "-m", "training_operator_tpu", *args],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        cwd=repo_root,
+    )
+
+
+def kill_all(procs) -> None:
+    """Teardown for a spawned process fleet: kill survivors, then reap
+    every one (bounded) so no zombie outlives the harness."""
+    import subprocess
+
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        try:
+            p.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
