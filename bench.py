@@ -585,27 +585,22 @@ def _wire_leg(n_jobs: int):
     """host + 1 operator as real OS processes over HTTPS (the shipped
     default: TLS on, cond-var long-poll watches), submission via the SDK."""
     import os as _os
-    import subprocess
     import tempfile
 
     from training_operator_tpu.sdk.client import TrainingClient
+    from training_operator_tpu.utils.procio import spawn_module_process
 
     tmp = tempfile.mkdtemp(prefix="wire-bench-")
     inv = _os.path.join(tmp, "cluster.json")
     with open(inv, "w") as f:
         json.dump({"cpu_pools": [{"nodes": CPU_NODES, "cpu_per_node": CPU_PER_NODE}]}, f)
-    env = {"PATH": _os.environ.get("PATH", ""), "HOME": _os.environ.get("HOME", "/tmp"),
-           "PYTHONPATH": _os.path.dirname(_os.path.abspath(__file__)),
-           "PYTHONUNBUFFERED": "1",
-           # Control-plane processes never touch the accelerator (gang
-           # scheduler off); keep their JAX imports off the TPU plugin,
-           # whose backend init can hang when the tunnel is down.
-           "JAX_PLATFORMS": "cpu"}
+    repo = _os.path.dirname(_os.path.abspath(__file__))
 
     def spawn(*a):
-        return subprocess.Popen([sys.executable, "-m", "training_operator_tpu", *a],
-                                env=env, text=True, stdout=subprocess.PIPE,
-                                stderr=subprocess.DEVNULL)
+        # Control-plane processes never touch the accelerator (gang
+        # scheduler off); keep their JAX imports off the TPU plugin,
+        # whose backend init can hang when the tunnel is down.
+        return spawn_module_process(a, repo, env_extra={"JAX_PLATFORMS": "cpu"})
 
     host = spawn("--role", "host", "--serve-port", "0",
                  "--gang-scheduler-name", "none", "--cluster", inv)

@@ -15,8 +15,6 @@ localhost sockets.
 
 import os
 import signal
-import subprocess
-import sys
 import time
 
 import pytest

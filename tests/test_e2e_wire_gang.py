@@ -10,8 +10,6 @@ boundary, which its e2e suite exercises via kind clusters.
 """
 
 import os
-import subprocess
-import sys
 import time
 
 import pytest

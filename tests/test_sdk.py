@@ -272,3 +272,70 @@ class TestSecretResolution:
             "SECRET_HF_CREDS": "tok-abc",
         })
         assert cfg.access_token == "direct"
+
+
+class TestCreateRetry:
+    """SDK-level resilience for the post-host-restart window: the pooled
+    keep-alive connection targets a dead socket; the wire client refuses to
+    auto-retry non-idempotent calls, so the SDK resolves the ambiguity."""
+
+    class _FlakyAPI:
+        def __init__(self, real, failures, exc):
+            self._real = real
+            self._failures = failures
+            self._exc = exc
+            self.attempts = 0
+
+        def create(self, obj):
+            self.attempts += 1
+            if self.attempts <= self._failures:
+                raise self._exc
+            return self._real.create(obj)
+
+        def __getattr__(self, name):
+            return getattr(self._real, name)
+
+    def _client_with_flaky_api(self, failures, exc):
+        from training_operator_tpu.cluster.runtime import Cluster
+
+        cluster = Cluster()
+        client = TrainingClient(cluster)
+        client.api = self._FlakyAPI(cluster.api, failures, exc)
+        return cluster, client
+
+    def test_transient_unavailable_retried(self):
+        from training_operator_tpu.cluster.httpapi import ApiUnavailableError
+
+        cluster, client = self._client_with_flaky_api(
+            2, ApiUnavailableError("conn reset"))
+        job = JAXJob(metadata=ObjectMeta(name="r"),
+                     replica_specs={"Worker": ReplicaSpec(replicas=1)})
+        client.create_job(job)
+        assert client.api.attempts == 3
+        assert cluster.api.try_get("JAXJob", "default", "r") is not None
+
+    def test_exhausted_retries_raise(self):
+        from training_operator_tpu.cluster.httpapi import ApiUnavailableError
+
+        cluster, client = self._client_with_flaky_api(
+            99, ApiUnavailableError("host gone"))
+        job = JAXJob(metadata=ObjectMeta(name="r2"),
+                     replica_specs={"Worker": ReplicaSpec(replicas=1)})
+        with pytest.raises(ApiUnavailableError):
+            client.create_job(job)
+
+    def test_first_attempt_conflict_is_genuine(self):
+        """AlreadyExists on the FIRST attempt is a real name conflict and
+        must surface — only a retry's echo is treated as success."""
+        from training_operator_tpu.cluster.apiserver import AlreadyExistsError
+        from training_operator_tpu.cluster.runtime import Cluster
+
+        cluster = Cluster()
+        client = TrainingClient(cluster)
+        job = JAXJob(metadata=ObjectMeta(name="dup"),
+                     replica_specs={"Worker": ReplicaSpec(replicas=1)})
+        client.create_job(job)
+        again = JAXJob(metadata=ObjectMeta(name="dup"),
+                       replica_specs={"Worker": ReplicaSpec(replicas=1)})
+        with pytest.raises(AlreadyExistsError):
+            client.create_job(again)

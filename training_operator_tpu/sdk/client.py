@@ -26,7 +26,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import training_operator_tpu.api.common as capi
 from training_operator_tpu.api.common import JobConditionType
 from training_operator_tpu.api.jobs import JOB_KINDS, Job
-from training_operator_tpu.cluster.apiserver import NotFoundError
+from training_operator_tpu.cluster.apiserver import AlreadyExistsError, NotFoundError
 from training_operator_tpu.cluster.runtime import Cluster
 from training_operator_tpu.runtime.api import (
     DatasetConfig,
@@ -92,11 +92,43 @@ class TrainingClient:
         if isinstance(job, TrainJob):
             if job.metadata.creation_time is None:
                 job.metadata.creation_time = self.cluster.clock.now()
-            return self.api.create(job)
-        from training_operator_tpu.api.defaults import default_job
+        else:
+            from training_operator_tpu.api.defaults import default_job
 
-        default_job(job, now=self.cluster.clock.now())
-        return self.api.create(job)
+            default_job(job, now=self.cluster.clock.now())
+        return self._create_with_retry(job)
+
+    def _create_with_retry(self, job, attempts: int = 5):
+        """Remote-mode resilience (no-op in-process: these exception types
+        never fire there). A create can hit a transient transport failure —
+        above all the stale-keep-alive window right after a HOST RESTART,
+        where the pooled connection targets the dead incarnation's socket.
+        The wire client deliberately does NOT auto-retry non-idempotent
+        calls (the request may have landed); the SDK is the right layer to
+        resolve the ambiguity, the way kube clients do: retry, and treat
+        AlreadyExists on a RETRY as our own earlier attempt having landed
+        (returning the stored object)."""
+        import time as _t
+
+        from training_operator_tpu.cluster.httpapi import (
+            ApiServerError,
+            ApiUnavailableError,
+        )
+
+        delay = 0.2
+        for attempt in range(attempts):
+            try:
+                return self.api.create(job)
+            except (ApiUnavailableError, ApiServerError):
+                if attempt == attempts - 1:
+                    raise
+                _t.sleep(delay)
+                delay = min(delay * 2, 2.0)
+            except AlreadyExistsError:
+                if attempt == 0:
+                    raise  # a genuine name conflict, not our retry's echo
+                ns = job.metadata.namespace or ""
+                return self.api.get(job.KIND, ns, job.metadata.name)
 
     def get_job(self, name: str, namespace: Optional[str] = None,
                 job_kind: Optional[str] = None):
