@@ -21,6 +21,10 @@ import time
 
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
+from training_operator_tpu.utils.jaxenv import honor_cpu_platform_request
+
+honor_cpu_platform_request()  # JAX_PLATFORMS=cpu wins over site-injected plugins
+
 import training_operator_tpu.api.common as capi
 from training_operator_tpu.api.common import Container, PodTemplateSpec, ReplicaSpec
 from training_operator_tpu.api.jobs import JAXJob, ObjectMeta

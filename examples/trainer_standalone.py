@@ -15,6 +15,10 @@ import os as _os, sys as _sys
 
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
+from training_operator_tpu.utils.jaxenv import honor_cpu_platform_request
+
+honor_cpu_platform_request()  # JAX_PLATFORMS=cpu wins over site-injected plugins
+
 import argparse
 import os
 

@@ -12,6 +12,10 @@ import os as _os, sys as _sys
 
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
+from training_operator_tpu.utils.jaxenv import honor_cpu_platform_request
+
+honor_cpu_platform_request()  # JAX_PLATFORMS=cpu wins over site-injected plugins
+
 from training_operator_tpu.api.common import Container, PodTemplateSpec
 from training_operator_tpu.api.jobs import ObjectMeta, TPUPolicy
 from training_operator_tpu.cluster.inventory import TPU_RESOURCE, make_tpu_pool

@@ -25,3 +25,4 @@ OPERATOR_NAME = "tpu-training-operator"
 API_GROUP = "training.tpu.dev"
 API_VERSION_V1 = "v1"
 API_VERSION_V2 = "v2alpha1"
+

@@ -1,0 +1,44 @@
+"""JAX platform-selection helper for entry points and harnesses.
+
+One shared implementation of the "honor an explicit JAX_PLATFORMS=cpu
+request" workaround, for ENTRY POINTS to call explicitly (examples, the
+graft entry, benches). Deliberately NOT invoked at package import time:
+the control-plane package must stay importable without jax's startup cost,
+and a library that silently mutates process-global jax config on import
+would surprise every downstream importer.
+
+Background: a site-injected accelerator plugin (a tunnel-attached TPU)
+can import jax at interpreter startup and rewrite the platform list — an
+ambient "cpu" in the env becomes "axon,cpu" in jax.config, and the first
+backend init then dials the plugin's tunnel, hanging every CPU-only run
+whenever the tunnel is down.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+
+def honor_cpu_platform_request() -> None:
+    """Force jax back onto CPU iff the environment explicitly asked for it
+    (`JAX_PLATFORMS=cpu`). No-op otherwise, so real-accelerator runs are
+    untouched. Failures are WARNED, not swallowed silently — if a backend
+    already initialized on another platform, the redirect is impossible
+    and the caller should know why the run may hang."""
+    if os.environ.get("JAX_PLATFORMS", "").strip() != "cpu":
+        return
+    try:
+        import jax
+
+        if jax.config.jax_platforms != "cpu":
+            jax.config.update("jax_platforms", "cpu")
+    except ImportError:
+        pass  # no jax in this interpreter: nothing to redirect
+    except (RuntimeError, AttributeError) as e:
+        # RuntimeError: a backend already initialized (too late to
+        # redirect); AttributeError: a jax API change. Either way the
+        # CPU request may not be honored — say so instead of hanging mute.
+        log.warning("JAX_PLATFORMS=cpu could not be enforced: %s", e)
