@@ -5,13 +5,21 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast bench bench-quick dryrun operator-demo ha-demo native clean
+.PHONY: test test-fast lint bench bench-quick dryrun operator-demo ha-demo native clean
 
 test:            ## full suite (no hardware needed; ~10 min)
 	$(PY) -m pytest tests/ -q
 
 test-fast:       ## everything but the slow trainer-numerics tier
 	$(PY) -m pytest tests/ -q --ignore=tests/test_trainer.py
+
+lint:            ## project code lint: AST discipline rules + ruff (if present)
+	$(PY) -m training_operator_tpu.analysis.codelint training_operator_tpu
+	@if command -v ruff >/dev/null 2>&1; then \
+	  ruff check training_operator_tpu; \
+	else \
+	  echo "ruff not installed; skipping (config pinned in pyproject.toml)"; \
+	fi
 
 bench:           ## headline benchmark (runs the trainer block on TPU if present)
 	$(PY) bench.py

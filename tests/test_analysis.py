@@ -1,0 +1,499 @@
+"""Static-analysis tests: speclint rule table, gang-queue analysis, the
+admission-webhook lint path, codelint, and the CLI.
+
+Table discipline: every bad spec trips EXACTLY its rule at ERROR level
+(warnings may ride along only where noted), and every built-in preset
+lints clean — the analyzer must never cry wolf on the stock catalog.
+"""
+
+import json
+
+import pytest
+
+from training_operator_tpu.analysis import (
+    analyze_gang_queue,
+    analyze_runtime,
+    analyze_trainjob,
+)
+from training_operator_tpu.analysis.codelint import check_paths, check_source
+from training_operator_tpu.analysis.diagnostics import RULES, Severity
+from training_operator_tpu.api.common import Container, PodTemplateSpec
+from training_operator_tpu.api.jobs import ObjectMeta, TPUPolicy
+from training_operator_tpu.api.validation import ValidationError
+from training_operator_tpu.cluster.inventory import TPU_RESOURCE, make_tpu_pool
+from training_operator_tpu.cluster.objects import PodGroup, PodGroupPhase
+from training_operator_tpu.cluster.runtime import Cluster, VirtualClock
+from training_operator_tpu.runtime.api import (
+    ClusterTrainingRuntime,
+    MLPolicy,
+    ReplicatedJobTemplate,
+    RuntimeRef,
+    TorchPolicy,
+    Trainer,
+    TrainingRuntimeSpec,
+    TrainJob,
+    TRAINER_NODE,
+)
+from training_operator_tpu.runtime.controller import TrainJobManager
+from training_operator_tpu.runtime.presets import builtin_runtimes
+from training_operator_tpu.runtime.webhooks import LINT_ANNOTATION
+from training_operator_tpu.utils import metrics
+
+
+def rt(
+    num_nodes=2,
+    topology="2x4",
+    num_slices=1,
+    accelerator="v5e-8",
+    mesh_axes=None,
+    torch=None,
+    tpu=True,
+    template=True,
+    name="rt-under-test",
+):
+    ml = MLPolicy(num_nodes=num_nodes, torch=torch)
+    if tpu:
+        ml.tpu = TPUPolicy(
+            accelerator=accelerator,
+            topology=topology,
+            num_slices=num_slices,
+            mesh_axes=dict(mesh_axes or {}),
+        )
+    trainer_rj = ReplicatedJobTemplate(
+        name=TRAINER_NODE,
+        template=PodTemplateSpec(
+            containers=[Container(name="trainer", image="trainer-img")]
+        ),
+    )
+    return ClusterTrainingRuntime(
+        metadata=ObjectMeta(name=name, namespace=""),
+        spec=TrainingRuntimeSpec(
+            ml_policy=ml,
+            template=[trainer_rj] if template else [],
+        ),
+    )
+
+
+def job(name="lint-me", trainer=None, runtime_name="rt-under-test"):
+    return TrainJob(
+        metadata=ObjectMeta(name=name),
+        runtime_ref=RuntimeRef(name=runtime_name),
+        trainer=trainer,
+    )
+
+
+class TestPresetCatalog:
+    def test_all_builtin_presets_lint_clean(self):
+        for preset in builtin_runtimes():
+            report = analyze_runtime(preset)
+            assert not report.diagnostics, report.render()
+
+    def test_presets_clean_against_matching_inventory(self):
+        nodes = make_tpu_pool(2, slice_topology="2x4", chips_per_host=4)
+        nodes += make_tpu_pool(2, slice_topology="4x4", chips_per_host=4,
+                               slice_prefix="big")
+        for preset in builtin_runtimes():
+            report = analyze_runtime(preset, nodes=nodes)
+            assert report.ok(), report.render()
+
+
+# (case id, job, runtime, rule that must fire, severity)
+RULE_TABLE = [
+    ("tpu001-nodes-cannot-tile",
+     job(), rt(num_nodes=3, topology="2x4"), "TPU001", Severity.ERROR),
+    ("tpu001-proc-disagrees",
+     job(trainer=Trainer(num_proc_per_node=3)), rt(), "TPU001", Severity.ERROR),
+    ("tpu001-override-times-proc-not-whole-slices",
+     job(trainer=Trainer(num_nodes=3, num_proc_per_node=4)), rt(),
+     "TPU001", Severity.ERROR),
+    ("tpu002-hosts-cannot-tile-minor-axis",
+     job(), rt(num_nodes=3, topology="2x6"), "TPU002", Severity.ERROR),
+    ("tpu003-mesh-product-wrong",
+     job(), rt(mesh_axes={"data": 3}), "TPU003", Severity.ERROR),
+    ("tpu004-nodes-not-divisible-by-slices",
+     job(), rt(num_nodes=3, num_slices=2), "TPU004", Severity.ERROR),
+    ("tpu005-accelerator-suffix-wrong",
+     job(), rt(accelerator="v5e-16"), "TPU005", Severity.WARN),
+    ("env001-jax-bootstrap-clash",
+     job(trainer=Trainer(env={"COORDINATOR_ADDRESS": "h", "SAFE": "1"})),
+     rt(), "ENV001", Severity.WARN),
+    ("env001-torch-bootstrap-clash",
+     job(trainer=Trainer(env={"MASTER_ADDR": "h"})),
+     rt(tpu=False, torch=TorchPolicy(num_proc_per_node=1)),
+     "ENV001", Severity.WARN),
+    ("pol001-elastic-range-inverted",
+     job(), rt(tpu=False, torch=TorchPolicy(elastic_min_nodes=4,
+                                            elastic_max_nodes=2)),
+     "POL001", Severity.ERROR),
+    ("pol001-nodes-outside-range",
+     job(trainer=Trainer(num_nodes=9)),
+     rt(tpu=False, torch=TorchPolicy(elastic_min_nodes=1, elastic_max_nodes=4)),
+     "POL001", Severity.ERROR),
+    ("pol002-negative-restarts",
+     job(), rt(tpu=False, torch=TorchPolicy(max_restarts=-1)),
+     "POL002", Severity.ERROR),
+    ("rt001-runtime-missing",
+     job(), None, "RT001", Severity.ERROR),
+    ("rt002-no-trainer-template",
+     job(), rt(template=False), "RT002", Severity.WARN),
+    ("job001-bad-name",
+     job(name="Bad_Name"), rt(), "JOB001", Severity.ERROR),
+    ("node001-override-not-whole-slice",
+     job(trainer=Trainer(num_nodes=3)), rt(), "NODE001", Severity.WARN),
+]
+
+
+class TestRuleTable:
+    @pytest.mark.parametrize(
+        "case,tj,runtime,rule,severity",
+        RULE_TABLE,
+        ids=[c[0] for c in RULE_TABLE],
+    )
+    def test_bad_spec_trips_exactly_its_rule(self, case, tj, runtime, rule, severity):
+        report = analyze_trainjob(tj, runtime)
+        assert report.has(rule), f"{case}: wanted {rule}, got {report.render()}"
+        fired = {d.rule_id for d in report.diagnostics if d.severity == severity}
+        assert fired == {rule}, f"{case}: extra {severity.value}s: {report.render()}"
+        if severity == Severity.ERROR:
+            assert not report.ok()
+        else:
+            assert report.ok(), report.render()
+
+    def test_zero_num_nodes_diagnosed_not_crashed(self):
+        # CLI inline runtimes bypass webhook validation; the analyzer must
+        # emit TPU004, not divide by zero.
+        report = analyze_trainjob(job(), rt(num_nodes=0))
+        assert report.has("TPU004") and not report.ok(), report.render()
+
+    def test_good_spec_with_whole_slice_override_is_clean(self):
+        report = analyze_trainjob(job(trainer=Trainer(num_nodes=4)), rt())
+        assert not report.diagnostics, report.render()
+
+    def test_every_fired_rule_is_documented(self):
+        for _, tj, runtime, rule, _ in RULE_TABLE:
+            assert rule in RULES
+            r = RULES[rule]
+            assert r.catches and r.fix and r.slug
+
+
+class TestInventoryRules:
+    def test_cap001_not_enough_slices(self):
+        nodes = make_tpu_pool(1, slice_topology="2x4")
+        report = analyze_trainjob(
+            job(), rt(num_nodes=4, num_slices=2), nodes=nodes
+        )
+        assert report.has("CAP001") and not report.ok(), report.render()
+
+    def test_cap001_wrong_family(self):
+        nodes = make_tpu_pool(1, slice_topology="2x4", tpu_type="v5p")
+        report = analyze_trainjob(job(), rt(), nodes=nodes)
+        assert report.has("CAP001"), report.render()
+
+    def test_tpu002_no_slice_geometry_fits(self):
+        nodes = make_tpu_pool(2, slice_topology="2x4")
+        report = analyze_trainjob(
+            job(), rt(num_nodes=4, topology="4x4", accelerator="v5e-16"),
+            nodes=nodes,
+        )
+        assert report.has("TPU002"), report.render()
+
+    def test_matching_inventory_is_clean(self):
+        nodes = make_tpu_pool(2, slice_topology="2x4")
+        report = analyze_trainjob(job(), rt(), nodes=nodes, podgroups=[])
+        assert not report.diagnostics, report.render()
+
+
+def pending_gang(name, topology, chips=0.0, num_slices=1):
+    return PodGroup(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        min_member=1,
+        min_resources={TPU_RESOURCE: chips} if chips else {},
+        topology_request=topology,
+        num_slices=num_slices,
+        phase=PodGroupPhase.PENDING,
+    )
+
+
+class TestGangQueue:
+    def test_gang001_never_placeable(self):
+        nodes = make_tpu_pool(2, slice_topology="4x4")
+        report = analyze_gang_queue([pending_gang("g1", "8x8")], nodes)
+        assert report.has("GANG001"), report.render()
+
+    def test_cap002_chip_oversubscription(self):
+        nodes = make_tpu_pool(2, slice_topology="4x4")  # 32 chips
+        report = analyze_gang_queue(
+            [pending_gang("g1", "4x4", chips=16.0)], nodes, extra_chips=32.0
+        )
+        assert report.has("CAP002"), report.render()
+
+    def test_gang002_slice_contention(self):
+        nodes = make_tpu_pool(2, slice_topology="4x4")
+        gangs = [pending_gang(f"g{i}", "4x4") for i in range(3)]
+        report = analyze_gang_queue(gangs, nodes)
+        assert report.has("GANG002"), report.render()
+        assert not report.has("GANG001")
+
+    def test_lint_of_existing_job_excludes_its_own_podgroup(self):
+        # An exactly-fitting queued job must not double-count: its own
+        # pending PodGroup + the extra_chips of the lint pass.
+        nodes = make_tpu_pool(1, slice_topology="2x4")  # 8 chips, 1 slice
+        own = pending_gang("stored", "2x4", chips=8.0)
+        tj = job(name="stored")
+        report = analyze_trainjob(tj, rt(), nodes=nodes, podgroups=[own])
+        assert not report.has("CAP002") and not report.has("GANG002"), report.render()
+
+    def test_cross_family_gangs_do_not_invent_contention(self):
+        # Supply and demand both span all families: queued v5p gangs on a
+        # disjoint v5p pool must not trip GANG002 for a v5e job.
+        nodes = make_tpu_pool(1, slice_topology="2x4", tpu_type="v5e")
+        nodes += make_tpu_pool(4, slice_topology="2x4", tpu_type="v5p",
+                               slice_prefix="p")
+        gangs = [pending_gang(f"p{i}", "2x4") for i in range(2)]
+        report = analyze_trainjob(job(), rt(), nodes=nodes, podgroups=gangs)
+        assert not report.has("GANG002"), report.render()
+
+    def test_malformed_queued_topology_is_gang001_not_a_crash(self):
+        # PodGroups have no admission hook: junk topology_request must be
+        # diagnosed, not allowed to explode every later admission/lint.
+        nodes = make_tpu_pool(1, slice_topology="2x4")
+        report = analyze_gang_queue([pending_gang("junk", "4x")], nodes)
+        assert report.has("GANG001"), report.render()
+
+    def test_junk_node_topology_label_skipped(self):
+        nodes = make_tpu_pool(1, slice_topology="2x4")
+        for n in nodes:
+            n.accelerator.slice_topology = "totally-bogus"
+        report = analyze_trainjob(job(), rt(), nodes=nodes)
+        # The poisoned slice is dropped, leaving no usable inventory.
+        assert report.has("CAP001"), report.render()
+
+    def test_running_gangs_ignored(self):
+        nodes = make_tpu_pool(2, slice_topology="4x4")
+        g = pending_gang("g1", "8x8")
+        g.phase = PodGroupPhase.RUNNING
+        report = analyze_gang_queue([g], nodes)
+        assert not report.diagnostics, report.render()
+
+
+def v2_env(nodes=None):
+    cluster = Cluster(VirtualClock())
+    if nodes:
+        cluster.add_nodes(nodes)
+    mgr = TrainJobManager(cluster)
+    return cluster, mgr
+
+
+class TestAdmissionLint:
+    def test_fatal_rule_rejects_at_admission(self):
+        cluster, mgr = v2_env()
+        mgr.submit(rt(num_nodes=3, topology="2x4"))
+        with pytest.raises(ValidationError) as ei:
+            mgr.submit(job())
+        assert "TPU001" in str(ei.value)
+
+    def test_warn_rule_annotates_not_rejects(self):
+        cluster, mgr = v2_env()
+        mgr.submit(rt(accelerator="v5e-16"))  # TPU005 WARN only
+        before = metrics.lint_diagnostics.value("TPU005", "WARN")
+        mgr.submit(job(name="warned"))
+        stored = cluster.api.get(TrainJob.KIND, "default", "warned")
+        assert "TPU005" in stored.annotations.get(LINT_ANNOTATION, "")
+        assert metrics.lint_diagnostics.value("TPU005", "WARN") == before + 1
+
+    def test_clean_spec_admits_without_annotation(self):
+        cluster, mgr = v2_env(nodes=make_tpu_pool(1, slice_topology="2x4"))
+        mgr.submit(rt())
+        mgr.submit(job(name="clean"))
+        stored = cluster.api.get(TrainJob.KIND, "default", "clean")
+        assert LINT_ANNOTATION not in stored.annotations
+
+    def test_missing_runtime_still_admits(self):
+        # RT001 is advisory at admission: the controller surfaces
+        # RuntimeNotFound as a condition (test_runtime_v2 relies on this).
+        cluster, mgr = v2_env()
+        mgr.submit(job(name="orphan", runtime_name="nope"))
+        stored = cluster.api.get(TrainJob.KIND, "default", "orphan")
+        assert "RT001" in stored.annotations.get(LINT_ANNOTATION, "")
+
+    def test_runtime_name_dns1035_enforced(self):
+        cluster, mgr = v2_env()
+        with pytest.raises(ValidationError):
+            mgr.submit(rt(name="Bad_Runtime_Name"))
+
+
+class TestSDKLint:
+    def test_lint_presubmit_object(self):
+        from training_operator_tpu.sdk.client import TrainingClient
+
+        cluster, _ = v2_env(nodes=make_tpu_pool(2, slice_topology="2x4"))
+        client = TrainingClient(cluster, job_kind="TrainJob")
+        good = job(name="ok", runtime_name="tpu-jax-default")
+        good.runtime_ref.kind = ClusterTrainingRuntime.KIND
+        assert client.lint(good).ok()
+
+        bad = TrainJob(
+            metadata=ObjectMeta(name="bad"),
+            runtime_ref=RuntimeRef(name="tpu-jax-default"),
+            trainer=Trainer(num_proc_per_node=3),
+        )
+        report = client.lint(bad)
+        assert report.has("TPU001") and not report.ok()
+
+    def test_lint_existing_job_by_name(self):
+        from training_operator_tpu.sdk.client import TrainingClient
+
+        cluster, mgr = v2_env()
+        mgr.submit(rt(accelerator="v5e-16"))
+        mgr.submit(job(name="stored"))
+        client = TrainingClient(cluster, job_kind="TrainJob")
+        report = client.lint("stored")
+        assert report.has("TPU005")
+
+
+class TestCodelint:
+    def test_tree_is_clean(self):
+        import training_operator_tpu
+
+        pkg_root = training_operator_tpu.__path__[0]
+        findings = check_paths([pkg_root])
+        assert not findings, "\n".join(f.render() for f in findings)
+
+    def test_scoped_rules_survive_subpath_invocation(self, tmp_path):
+        # check_paths on a single file / subdirectory must anchor the scope
+        # at the package root, or CL001/CL002 silently turn off.
+        import training_operator_tpu
+
+        pkg_root = training_operator_tpu.__path__[0]
+        bad_dir = tmp_path / "training_operator_tpu" / "engine"
+        bad_dir.mkdir(parents=True)
+        bad = bad_dir / "bad.py"
+        bad.write_text("import time\ndef tick():\n    time.sleep(1)\n")
+        assert [f.rule_id for f in check_paths([str(bad)])] == ["CL001"]
+        # And a legal scheduler-side commit stays legal when checked singly.
+        sched = check_paths([f"{pkg_root}/scheduler/gang.py"])
+        assert not [f for f in sched if f.rule_id == "CL002"], sched
+
+    def test_cl001_sleep_in_control_loop(self):
+        src = "import time\ndef tick():\n    time.sleep(1)\n"
+        found = check_source("x.py", src, package_rel="engine/x.py")
+        assert [f.rule_id for f in found] == ["CL001"]
+        # Same code outside a control-loop package is fine (entry points
+        # may wall-block).
+        assert not check_source("x.py", src, package_rel="cluster/x.py")
+
+    def test_cl002_snapshot_mutation(self):
+        src = "def f(snapshot):\n    snapshot.free['n'] = {}\n"
+        found = check_source("x.py", src, package_rel="runtime/x.py")
+        assert [f.rule_id for f in found] == ["CL002"]
+        assert not check_source("x.py", src, package_rel="scheduler/x.py")
+
+    def test_cl002_commit_outside_scheduler(self):
+        src = "def f(snap, req):\n    snap.commit(req, 'node')\n"
+        found = check_source("x.py", src, package_rel="engine/x.py")
+        assert [f.rule_id for f in found] == ["CL002"]
+
+    def test_cl003_naked_thread(self):
+        src = ("import threading\n"
+               "def f():\n    t = threading.Thread(target=f)\n    t.start()\n")
+        found = check_source("x.py", src, package_rel="utils/x.py")
+        assert [f.rule_id for f in found] == ["CL003"]
+
+    def test_cl003_nested_function_reports_once(self):
+        src = ("import threading\n"
+               "def outer():\n"
+               "    def inner():\n"
+               "        threading.Thread(target=outer).start()\n"
+               "    inner()\n")
+        found = check_source("x.py", src, package_rel="utils/x.py")
+        assert [f.rule_id for f in found] == ["CL003"], found
+
+    def test_cl003_module_level_thread_flagged(self):
+        src = "import threading\nthreading.Thread(target=print).start()\n"
+        found = check_source("x.py", src, package_rel="utils/x.py")
+        assert [f.rule_id for f in found] == ["CL003"], found
+
+    def test_cl003_daemon_or_join_ok(self):
+        daemon = ("import threading\n"
+                  "def f():\n    threading.Thread(target=f, daemon=True).start()\n")
+        joined = ("import threading\n"
+                  "def f():\n    t = threading.Thread(target=f)\n"
+                  "    t.start()\n    t.join()\n")
+        assert not check_source("x.py", daemon, package_rel="utils/x.py")
+        assert not check_source("x.py", joined, package_rel="utils/x.py")
+
+
+class TestCLI:
+    def test_all_presets_exit_zero(self, capsys):
+        from training_operator_tpu.analysis.cli import run
+
+        assert run(["--all-presets"]) == 0
+        assert "0 error(s)" in capsys.readouterr().out
+
+    def test_bad_spec_exits_nonzero_with_rule_id(self, tmp_path, capsys):
+        from training_operator_tpu.analysis.cli import run
+
+        spec = tmp_path / "bad.json"
+        spec.write_text(json.dumps({
+            "name": "bad",
+            "runtime": {"numNodes": 3,
+                        "tpu": {"accelerator": "v5e-8", "topology": "2x4"}},
+        }))
+        assert run([str(spec)]) == 1
+        assert "TPU001" in capsys.readouterr().out
+
+    def test_unknown_preset_exits_nonzero(self, capsys):
+        from training_operator_tpu.analysis.cli import run
+
+        assert run(["--preset", "nope"]) == 1
+        assert "RT001" in capsys.readouterr().out
+
+    def test_inventory_capacity(self, tmp_path, capsys):
+        from training_operator_tpu.analysis.cli import run
+
+        inv = tmp_path / "inv.json"
+        inv.write_text(json.dumps(
+            {"tpu_pools": [{"slices": 1, "topology": "2x4"}]}
+        ))
+        spec = tmp_path / "big.json"
+        spec.write_text(json.dumps({
+            "name": "big",
+            "runtime": {"numNodes": 4,
+                        "tpu": {"accelerator": "v5e-8", "topology": "2x4",
+                                "numSlices": 2}},
+        }))
+        assert run(["--inventory", str(inv), str(spec)]) == 1
+        assert "CAP001" in capsys.readouterr().out
+
+    def test_malformed_yaml_is_a_load_error(self, tmp_path, capsys):
+        from training_operator_tpu.analysis.cli import run
+
+        spec = tmp_path / "broken.yaml"
+        spec.write_text("name: [unclosed\n")
+        assert run([str(spec)]) == 2
+        assert "cannot load" in capsys.readouterr().err
+
+    def test_zero_nodes_spec_diagnosed_not_crashed(self, tmp_path, capsys):
+        from training_operator_tpu.analysis.cli import run
+
+        spec = tmp_path / "zero.json"
+        spec.write_text(json.dumps({
+            "name": "zero",
+            "runtime": {"numNodes": 0,
+                        "tpu": {"accelerator": "v5e-8", "topology": "2x4"}},
+        }))
+        assert run([str(spec)]) == 1
+        assert "TPU004" in capsys.readouterr().out
+
+    def test_rules_listing(self, capsys):
+        from training_operator_tpu.analysis.cli import run
+
+        assert run(["--rules"]) == 0
+        out = capsys.readouterr().out
+        for rule_id in RULES:
+            assert rule_id in out
+
+    def test_main_module_dispatch(self, capsys):
+        from training_operator_tpu.__main__ import main
+
+        assert main(["lint", "--all-presets"]) == 0

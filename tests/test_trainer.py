@@ -25,7 +25,18 @@ from training_operator_tpu.trainer.train import (
     make_train_step,
 )
 
-CPU = jax.devices("cpu")
+_CPU = None
+
+
+def cpu_devices():
+    """jax.devices("cpu"), resolved lazily: calling it at module level would
+    initialize the JAX backend during pytest COLLECTION — and when the axon
+    TPU plugin's tunnel is unreachable, backend init blocks, hanging
+    `pytest --collect-only` for minutes before a single test runs."""
+    global _CPU
+    if _CPU is None:
+        _CPU = jax.devices("cpu")
+    return _CPU
 
 
 @pytest.fixture(autouse=True)
@@ -33,12 +44,12 @@ def _pin_cpu():
     """All trainer tests compute on the CPU platform: the axon TPU plugin
     hijacks the default backend, and mixing TPU-resident arrays into
     CPU-mesh shard_maps corrupts data (see attention.ring_attention)."""
-    with jax.default_device(CPU[0]):
+    with jax.default_device(cpu_devices()[0]):
         yield
 
 
 def cpu_mesh(**axes):
-    return build_mesh(MeshSpec(axes), CPU)
+    return build_mesh(MeshSpec(axes), cpu_devices())
 
 
 def tiny_config(**kw):
@@ -82,7 +93,7 @@ class TestRingAttention:
         k = jax.random.normal(kk, shape, jnp.float32)
         v = jax.random.normal(kv, shape, jnp.float32)
         expected = plain_attention(q, k, v, causal=causal)
-        with jax.default_device(CPU[0]):
+        with jax.default_device(cpu_devices()[0]):
             got = ring_attention(q, k, v, mesh, causal=causal)
         np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
 

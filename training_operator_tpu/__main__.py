@@ -447,20 +447,11 @@ def run_host(args, cfg) -> int:
     for kind_cls, _ in JOB_KINDS.values():
         cluster.api.register_admission(kind_cls.KIND, admit)
     # v2 admission lives with the API server too (reference webhook.v2 is
-    # apiserver-invoked regardless of which operator replicas exist).
-    from training_operator_tpu.runtime.api import (
-        ClusterTrainingRuntime,
-        TrainingRuntime,
-        TrainJob,
-    )
-    from training_operator_tpu.runtime.webhooks import (
-        validate_training_runtime,
-        validate_trainjob,
-    )
+    # apiserver-invoked regardless of which operator replicas exist):
+    # field validation + the static spec lint, in one chain.
+    from training_operator_tpu.runtime.webhooks import register_v2_admission
 
-    cluster.api.register_admission(TrainJob.KIND, validate_trainjob)
-    cluster.api.register_admission(TrainingRuntime.KIND, validate_training_runtime)
-    cluster.api.register_admission(ClusterTrainingRuntime.KIND, validate_training_runtime)
+    register_v2_admission(cluster.api)
     from training_operator_tpu.runtime.presets import install_presets
 
     install_presets(cluster.api)
@@ -603,6 +594,13 @@ def run_operator(args, cfg) -> int:
 
 
 def main(argv=None) -> int:
+    raw = list(sys.argv[1:] if argv is None else argv)
+    if raw and raw[0] == "lint":
+        # Static dry-run analysis: no cluster, no controllers — dispatch
+        # before the operator flag surface (see analysis/cli.py).
+        from training_operator_tpu.analysis.cli import run as lint_run
+
+        return lint_run(raw[1:])
     args = parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,

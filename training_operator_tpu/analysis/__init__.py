@@ -1,0 +1,38 @@
+"""Static analysis: spec dry-run lint (`speclint`) + project code lint.
+
+Two halves:
+
+- `speclint`: a pure, side-effect-free analyzer that takes a TrainJob + its
+  resolved TrainingRuntime (+ optional inventory / queued-PodGroup snapshot)
+  and emits structured diagnostics — placement feasibility decided statically,
+  before anything touches the cluster. Surfaced as `python -m
+  training_operator_tpu lint`, `TrainingClient.lint(...)`, and non-fatal WARN
+  annotations in the admission webhook path.
+- `codelint`: an AST-based checker enforcing project-specific control-plane
+  discipline (no `time.sleep` in reconcile/ticker loops, no ClusterSnapshot
+  mutation outside the scheduler, no naked threads). Run via `make lint`.
+"""
+
+from training_operator_tpu.analysis.diagnostics import (
+    Diagnostic,
+    LintReport,
+    RULES,
+    Severity,
+)
+from training_operator_tpu.analysis.speclint import (
+    analyze_gang_queue,
+    analyze_runtime,
+    analyze_trainjob,
+    slice_classes_from_nodes,
+)
+
+__all__ = [
+    "Diagnostic",
+    "LintReport",
+    "RULES",
+    "Severity",
+    "analyze_gang_queue",
+    "analyze_runtime",
+    "analyze_trainjob",
+    "slice_classes_from_nodes",
+]

@@ -1,0 +1,256 @@
+"""`python -m training_operator_tpu lint` — the speclint front-end.
+
+Targets:
+  - spec files (YAML or JSON TrainJob specs, schema below)
+  - `--preset NAME` / `--all-presets`: the built-in runtime catalog
+  - `--inventory FILE`: a cluster inventory JSON (same schema as the
+    operator's `--cluster` file) enabling the capacity rules
+
+Spec file schema (all keys optional except one of runtimeRef/runtime):
+  name: my-job
+  namespace: default
+  runtimeRef: {name: tpu-jax-default, kind: ClusterTrainingRuntime}
+  trainer: {numNodes: 2, numProcPerNode: 4, image: ..., env: {K: V}}
+  runtime:                 # inline runtime instead of a catalog ref
+    numNodes: 2
+    tpu: {accelerator: v5e-8, topology: 2x4, numSlices: 1,
+          meshAxes: {data: 2, fsdp: 4}}
+    torch: {numProcPerNode: 1, elasticMinNodes: 1, elasticMaxNodes: 4,
+            maxRestarts: 3}
+
+Exit status: 0 when no ERROR diagnostics, 1 otherwise, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional, Tuple
+
+from training_operator_tpu.analysis.diagnostics import RULES, LintReport
+from training_operator_tpu.analysis.speclint import analyze_runtime, analyze_trainjob
+from training_operator_tpu.api.jobs import ObjectMeta, TPUPolicy
+from training_operator_tpu.runtime.api import (
+    ClusterTrainingRuntime,
+    MLPolicy,
+    ReplicatedJobTemplate,
+    RuntimeRef,
+    TorchPolicy,
+    Trainer,
+    TrainingRuntimeSpec,
+    TrainJob,
+    TRAINER_NODE,
+)
+
+
+def _load_doc(path: str) -> dict:
+    with open(path) as f:
+        text = f.read()
+    try:
+        import yaml
+    except ImportError:
+        doc = json.loads(text)
+    else:
+        try:
+            doc = yaml.safe_load(text)
+        except yaml.YAMLError as e:
+            # Normalize to the load-error path (exit 2), not a traceback.
+            raise ValueError(f"invalid YAML: {e}") from e
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: top level must be a mapping")
+    return doc
+
+
+def _runtime_from_doc(doc: dict, name: str = "inline") -> ClusterTrainingRuntime:
+    tpu = None
+    if "tpu" in doc:
+        t = doc["tpu"] or {}
+        tpu = TPUPolicy(
+            accelerator=t.get("accelerator", "v5e-8"),
+            topology=t.get("topology"),
+            num_slices=int(t.get("numSlices", t.get("num_slices", 1))),
+            mesh_axes={k: int(v) for k, v in (t.get("meshAxes") or t.get("mesh_axes") or {}).items()},
+        )
+    torch = None
+    if "torch" in doc:
+        t = doc["torch"] or {}
+        torch = TorchPolicy(
+            num_proc_per_node=t.get("numProcPerNode", t.get("num_proc_per_node")),
+            elastic_min_nodes=t.get("elasticMinNodes", t.get("elastic_min_nodes")),
+            elastic_max_nodes=t.get("elasticMaxNodes", t.get("elastic_max_nodes")),
+            max_restarts=t.get("maxRestarts", t.get("max_restarts")),
+        )
+    return ClusterTrainingRuntime(
+        metadata=ObjectMeta(name=name, namespace=""),
+        spec=TrainingRuntimeSpec(
+            ml_policy=MLPolicy(
+                num_nodes=int(doc.get("numNodes", doc.get("num_nodes", 1))),
+                tpu=tpu,
+                torch=torch,
+            ),
+            template=[ReplicatedJobTemplate(name=TRAINER_NODE)],
+        ),
+    )
+
+
+def load_spec(path: str) -> Tuple[TrainJob, Optional[ClusterTrainingRuntime]]:
+    """Parse a spec file into (TrainJob, resolved-or-None runtime)."""
+    doc = _load_doc(path)
+    ref = doc.get("runtimeRef") or {}
+    trainer_doc = doc.get("trainer")
+    trainer = None
+    if trainer_doc:
+        trainer = Trainer(
+            image=trainer_doc.get("image"),
+            command=list(trainer_doc.get("command", [])),
+            args=list(trainer_doc.get("args", [])),
+            env={k: str(v) for k, v in (trainer_doc.get("env") or {}).items()},
+            num_nodes=trainer_doc.get("numNodes", trainer_doc.get("num_nodes")),
+            num_proc_per_node=trainer_doc.get(
+                "numProcPerNode", trainer_doc.get("num_proc_per_node")
+            ),
+            resources_per_node=dict(trainer_doc.get("resourcesPerNode", {})),
+        )
+    job = TrainJob(
+        metadata=ObjectMeta(
+            name=doc.get("name", "lint-target"),
+            namespace=doc.get("namespace", "default"),
+        ),
+        runtime_ref=RuntimeRef(
+            name=ref.get("name", ""),
+            kind=ref.get("kind", ClusterTrainingRuntime.KIND),
+        ),
+        trainer=trainer,
+    )
+    if "runtime" in doc:
+        return job, _runtime_from_doc(doc["runtime"] or {})
+    if ref.get("name"):
+        from training_operator_tpu.runtime.presets import builtin_runtimes
+
+        for rt in builtin_runtimes():
+            if rt.metadata.name == ref["name"]:
+                return job, rt
+    return job, None
+
+
+def load_inventory(path: str) -> list:
+    """Build a fake node inventory from the operator's cluster-file schema."""
+    from training_operator_tpu.cluster.inventory import (
+        make_cpu_pool,
+        make_gpu_pool,
+        make_tpu_pool,
+    )
+
+    with open(path) as f:
+        inv = json.load(f)
+    nodes: list = []
+    for i, pool in enumerate(inv.get("tpu_pools", [])):
+        nodes.extend(make_tpu_pool(
+            pool.get("slices", 1),
+            slice_topology=pool.get("topology", "4x4"),
+            chips_per_host=pool.get("chips_per_host", 4),
+            tpu_type=pool.get("tpu_type", "v5e"),
+            slice_prefix=f"pool{i}-slice",
+        ))
+    for pool in inv.get("gpu_pools", []):
+        nodes.extend(make_gpu_pool(
+            pool.get("nodes", 1),
+            gpus_per_node=pool.get("gpus_per_node", 8),
+        ))
+    for pool in inv.get("cpu_pools", []):
+        nodes.extend(make_cpu_pool(pool.get("nodes", 1)))
+    return nodes
+
+
+def _print_rules() -> None:
+    wid = max(len(r.slug) for r in RULES.values())
+    for r in RULES.values():
+        print(f"{r.rule_id}  {r.slug:<{wid}}  {r.severity.value:<5}  {r.catches}")
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m training_operator_tpu lint",
+        description="static dry-run analysis of TrainJob specs",
+    )
+    ap.add_argument("specs", nargs="*", help="TrainJob spec files (YAML/JSON)")
+    ap.add_argument("--preset", action="append", default=[],
+                    help="lint a built-in runtime preset by name (repeatable)")
+    ap.add_argument("--all-presets", action="store_true",
+                    help="lint every built-in preset")
+    ap.add_argument("--inventory", metavar="FILE",
+                    help="cluster inventory JSON enabling capacity rules")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--json", dest="as_json", action="store_true",
+                    help="emit diagnostics as JSON")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="only print targets with diagnostics")
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        _print_rules()
+        return 0
+
+    nodes = load_inventory(args.inventory) if args.inventory else None
+
+    from training_operator_tpu.runtime.presets import builtin_runtimes
+
+    catalog = {rt.metadata.name: rt for rt in builtin_runtimes()}
+    preset_names = list(catalog) if args.all_presets else list(args.preset)
+
+    if not args.specs and not preset_names:
+        ap.print_usage(sys.stderr)
+        print("error: nothing to lint (give spec files, --preset, or "
+              "--all-presets)", file=sys.stderr)
+        return 2
+
+    reports: List[LintReport] = []
+    for name in preset_names:
+        rt = catalog.get(name)
+        if rt is None:
+            bad = LintReport(target=name)
+            bad.add("RT001", f"no built-in preset named {name!r} "
+                    f"(have: {', '.join(sorted(catalog))})", "preset")
+            reports.append(bad)
+            continue
+        reports.append(analyze_runtime(rt, nodes=nodes, target=name))
+    for path in args.specs:
+        try:
+            job, runtime = load_spec(path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: cannot load {path}: {e}", file=sys.stderr)
+            return 2
+        reports.append(
+            analyze_trainjob(job, runtime, nodes=nodes, target=path)
+        )
+
+    n_errors = sum(len(r.errors()) for r in reports)
+    if args.as_json:
+        print(json.dumps([
+            {
+                "target": r.target,
+                "diagnostics": [
+                    {"rule": d.rule_id, "slug": d.slug,
+                     "severity": d.severity.value, "path": d.path,
+                     "message": d.message}
+                    for d in r.diagnostics
+                ],
+            }
+            for r in reports
+        ], indent=2))
+    else:
+        for r in reports:
+            if args.quiet and not r.diagnostics:
+                continue
+            print(r.render())
+        total = sum(len(r.diagnostics) for r in reports)
+        n_warn = sum(len(r.warnings()) for r in reports)
+        print(f"lint: {len(reports)} target(s), {n_errors} error(s), "
+              f"{n_warn} warning(s), {total} diagnostic(s)")
+    return 1 if n_errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

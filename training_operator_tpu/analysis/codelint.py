@@ -1,0 +1,219 @@
+"""Project-specific AST lint for control-plane discipline.
+
+Rules (all ERROR; the tree must stay green — `make lint` runs this):
+
+  CL001 sleep-in-control-loop    `time.sleep` inside the reconcile/ticker
+        packages (controllers/, engine/, runtime/, scheduler/). Control
+        loops must advance via the cluster clock (VirtualClock scheduling /
+        schedule_after), or simulation and virtual-clock tests silently
+        stall on real wall time.
+  CL002 snapshot-mutation-outside-scheduler    mutating a ClusterSnapshot
+        (`snap.commit(...)`, writes to `.free`/`.nodes`/`.slices`) outside
+        scheduler/ — the snapshot is the solver's immutable view; outside
+        writers corrupt reservation accounting.
+  CL003 naked-thread    `threading.Thread(...)` without `daemon=True` and
+        with no `.join(...)` in the same function: such a thread outlives
+        shutdown and hangs interpreter exit.
+
+Run: `python -m training_operator_tpu.analysis.codelint [paths...]`
+(defaults to the `training_operator_tpu` package). Exit 1 on findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence
+
+# Packages whose loops must use the cluster clock, never the wall clock.
+CONTROL_LOOP_PACKAGES = ("controllers", "engine", "runtime", "scheduler")
+
+# Attributes whose assignment counts as snapshot mutation.
+SNAPSHOT_MUTABLE_ATTRS = ("free", "nodes", "slices")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule_id: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule_id} {self.message}"
+
+
+def _is_time_sleep(call: ast.Call) -> bool:
+    f = call.func
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr == "sleep"
+        and isinstance(f.value, ast.Name)
+        and f.value.id in ("time", "_time", "_t")
+    )
+
+
+def _looks_like_snapshot(node: ast.AST) -> bool:
+    """Name heuristic: the receiver is (or holds) a ClusterSnapshot."""
+    if isinstance(node, ast.Name):
+        return "snapshot" in node.id.lower() or node.id.lower() in ("snap", "snp")
+    if isinstance(node, ast.Attribute):
+        return "snapshot" in node.attr.lower() or node.attr.lower() == "snap"
+    return False
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "Thread":
+        return isinstance(f.value, ast.Name) and f.value.id == "threading"
+    return isinstance(f, ast.Name) and f.id == "Thread"
+
+
+_SCOPE_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _scope_walk(body) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function scopes (each
+    function is its own CL003 scope — a Thread belongs to exactly one)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, _SCOPE_TYPES):
+            continue  # a nested def is its own scope; don't descend
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _scopes(tree: ast.Module) -> Iterator[list]:
+    """Scope bodies: the module top level, then every (nested) function."""
+    yield tree.body
+    for node in ast.walk(tree):
+        if isinstance(node, _SCOPE_TYPES):
+            yield node.body
+
+
+def check_source(path: str, source: str, package_rel: Optional[str] = None) -> List[Finding]:
+    """Lint one file. `package_rel` is the path relative to the package root
+    (decides which package-scoped rules apply); defaults to `path`."""
+    rel = (package_rel if package_rel is not None else path).replace(os.sep, "/")
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "CL000", f"syntax error: {e.msg}")]
+    findings: List[Finding] = []
+
+    in_control_pkg = any(f"{pkg}/" in rel for pkg in CONTROL_LOOP_PACKAGES)
+    in_scheduler = "scheduler/" in rel
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_time_sleep(node) and in_control_pkg:
+            findings.append(Finding(
+                path, node.lineno, "CL001",
+                "time.sleep in a control-loop package; use the cluster "
+                "clock (schedule_after / VirtualClock) instead",
+            ))
+        if not in_scheduler:
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "commit"
+                and _looks_like_snapshot(node.func.value)
+            ):
+                findings.append(Finding(
+                    path, node.lineno, "CL002",
+                    "ClusterSnapshot.commit() outside scheduler/ — the "
+                    "snapshot is the solver's immutable view",
+                ))
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    base = t
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    if (
+                        isinstance(base, ast.Attribute)
+                        and base.attr in SNAPSHOT_MUTABLE_ATTRS
+                        and _looks_like_snapshot(base.value)
+                    ):
+                        findings.append(Finding(
+                            path, node.lineno, "CL002",
+                            f"write to snapshot .{base.attr} outside scheduler/",
+                        ))
+
+    for body in _scopes(tree):
+        scope_nodes = list(_scope_walk(body))
+        # A `.join(...)` anywhere in the same scope counts as discipline
+        # (the common start-then-join pattern).
+        has_join = any(
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "join"
+            for n in scope_nodes
+        )
+        for node in scope_nodes:
+            if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+                continue
+            has_daemon = any(k.arg == "daemon" for k in node.keywords)
+            if not has_daemon and not has_join:
+                findings.append(Finding(
+                    path, node.lineno, "CL003",
+                    "threading.Thread without daemon= or a join() in the "
+                    "same scope will outlive shutdown",
+                ))
+    return findings
+
+
+def _package_rel(path: str, base: str) -> str:
+    """Path relative to the training_operator_tpu package root, however the
+    file was reached. Scoped rules key off directory names under the
+    package (`runtime/...`); computing relative to an arbitrary argument
+    (a single file, a subdirectory) would silently strip that prefix and
+    turn CL001/CL002 off — or invert CL002 inside scheduler/."""
+    abspath = os.path.abspath(path).replace(os.sep, "/")
+    marker = "/training_operator_tpu/"
+    if marker in abspath:
+        return abspath.rsplit(marker, 1)[1]
+    return os.path.relpath(path, base)
+
+
+def check_paths(paths: Sequence[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for root in paths:
+        if os.path.isfile(root):
+            files = [root]
+            base = os.path.dirname(root)
+        else:
+            base = root
+            files = []
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                files.extend(
+                    os.path.join(dirpath, f) for f in sorted(filenames)
+                    if f.endswith(".py")
+                )
+        for f in sorted(files):
+            with open(f, encoding="utf-8") as fh:
+                src = fh.read()
+            findings.extend(check_source(f, src, package_rel=_package_rel(f, base)))
+    return findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = list(argv if argv is not None else sys.argv[1:])
+    if not args:
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        args = [pkg_root]
+    findings = check_paths(args)
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"codelint: {len(findings)} finding(s)")
+        return 1
+    print("codelint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,211 @@
+"""Diagnostic model + the rule catalog.
+
+Every rule has a stable id (`TPU001`), a short slug, a default severity, and
+remediation text. The catalog is the single source of truth: the CLI's
+`--rules` listing and the README reference table are generated from it, and
+`Diagnostic` construction validates ids against it so a rule can't fire
+without being documented.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+class Severity(str, enum.Enum):
+    ERROR = "ERROR"
+    WARN = "WARN"
+    INFO = "INFO"
+
+
+@dataclass(frozen=True)
+class Rule:
+    rule_id: str
+    slug: str
+    severity: Severity
+    catches: str
+    fix: str
+
+
+# The rule catalog. Ids are append-only: retired rules keep their id reserved
+# so historical annotations/metrics stay interpretable.
+RULES: Dict[str, Rule] = {
+    r.rule_id: r
+    for r in [
+        Rule(
+            "TPU001", "topology-chip-mismatch", Severity.ERROR,
+            "num_nodes x proc_per_node cannot tile the requested slice "
+            "topology's chip count (nodes don't divide the chip grid, or an "
+            "explicit procPerNode disagrees with chips-per-host)",
+            "make numNodes x numProcPerNode equal topology chips x numSlices, "
+            "or drop numProcPerNode and let the runtime derive it",
+        ),
+        Rule(
+            "TPU002", "ici-contiguity-infeasible", Severity.ERROR,
+            "the requested topology can never form a contiguous axis-aligned "
+            "ICI sub-mesh: hosts don't tile the grid's minor axis, or no "
+            "slice geometry in the inventory admits a single candidate "
+            "placement",
+            "request a topology whose minor axis is a multiple of "
+            "chips-per-host, or match an inventory slice geometry",
+        ),
+        Rule(
+            "TPU003", "mesh-axes-mismatch", Severity.ERROR,
+            "the product of mlPolicy.tpu.mesh_axes does not equal total chips "
+            "(topology chips x numSlices) — the trainer cannot build its mesh",
+            "adjust mesh_axes so their product equals total chips",
+        ),
+        Rule(
+            "TPU004", "nodes-slices-mismatch", Severity.ERROR,
+            "numNodes is not divisible by numSlices (or numSlices < 1): "
+            "slices cannot have equal worker counts",
+            "set numNodes to a whole multiple of numSlices",
+        ),
+        Rule(
+            "TPU005", "accelerator-topology-mismatch", Severity.WARN,
+            "the accelerator name's chip-count suffix (e.g. v5e-8) disagrees "
+            "with the declared topology's chip count",
+            "rename the accelerator or fix the topology; the topology wins "
+            "at placement time",
+        ),
+        Rule(
+            "CAP001", "insufficient-inventory", Severity.ERROR,
+            "the inventory snapshot cannot ever satisfy the request: fewer "
+            "matching slices than numSlices, or no TPU slices at all",
+            "shrink numSlices / pick a smaller topology, or grow the pool",
+        ),
+        Rule(
+            "CAP002", "queue-oversubscribed", Severity.WARN,
+            "total chip demand of queued gangs plus this job exceeds total "
+            "inventory chips — the gang will queue behind others",
+            "expect queueing; consider a smaller ask or more slices",
+        ),
+        Rule(
+            "GANG001", "gang-never-placeable", Severity.ERROR,
+            "a queued PodGroup's topology request fits no slice geometry in "
+            "the inventory — it will sit Unschedulable forever",
+            "delete or resize the stuck gang; it can never admit",
+        ),
+        Rule(
+            "GANG002", "gang-capacity-deadlock", Severity.WARN,
+            "queued whole-slice gangs collectively demand more slices than "
+            "exist while each is individually placeable — admission order "
+            "determines who starves",
+            "rely on aging/drain reservations, or reduce concurrent gangs",
+        ),
+        Rule(
+            "ENV001", "env-bootstrap-conflict", Severity.WARN,
+            "user trainer env collides with operator-injected bootstrap "
+            "variables (jax.distributed / PET_* / MASTER_* contract); the "
+            "user value wins and can break coordinator discovery",
+            "remove the colliding keys or rename your variables",
+        ),
+        Rule(
+            "POL001", "elastic-range-invalid", Severity.ERROR,
+            "torch elastic policy is unsatisfiable: min > max, min < 1, or "
+            "the resolved node count falls outside [min, max]",
+            "fix elastic_min_nodes/elastic_max_nodes to bracket numNodes",
+        ),
+        Rule(
+            "POL002", "restart-policy-invalid", Severity.ERROR,
+            "failure policy is malformed (negative max_restarts)",
+            "set max_restarts >= 0",
+        ),
+        Rule(
+            "RT001", "runtime-not-found", Severity.ERROR,
+            "runtimeRef names a TrainingRuntime that does not exist in the "
+            "catalog / cluster",
+            "create the runtime or reference a built-in preset",
+        ),
+        Rule(
+            "RT002", "no-trainer-template", Severity.WARN,
+            "the runtime has no trainer-node replicated job; the default "
+            "trainer template will be synthesized",
+            "declare a trainer-node template in the runtime",
+        ),
+        Rule(
+            "JOB001", "invalid-name", Severity.ERROR,
+            "job name is not a valid DNS-1035 label (pod/service DNS names "
+            "would be invalid)",
+            "use lowercase alphanumerics and '-', start with a letter, "
+            "<= 63 chars",
+        ),
+        Rule(
+            "NODE001", "num-nodes-override-clamped", Severity.WARN,
+            "trainer.numNodes override is not a whole multiple of the "
+            "runtime's workers-per-slice; the workload builder will clamp it "
+            "down to a whole slice count",
+            "override in whole-slice steps (multiples of numNodes/numSlices)",
+        ),
+    ]
+}
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    rule_id: str
+    severity: Severity
+    message: str
+    path: str = ""  # spec path, e.g. "trainer.numNodes"
+
+    def __post_init__(self):
+        if self.rule_id not in RULES:
+            raise ValueError(f"undocumented rule id {self.rule_id!r}")
+
+    @property
+    def slug(self) -> str:
+        return RULES[self.rule_id].slug
+
+    def render(self) -> str:
+        loc = f" [{self.path}]" if self.path else ""
+        return f"{self.severity.value} {self.rule_id} {self.slug}{loc}: {self.message}"
+
+
+@dataclass
+class LintReport:
+    """Ordered diagnostics for one lint target."""
+
+    target: str = ""
+    diagnostics: List[Diagnostic] = field(default_factory=list)
+
+    def add(
+        self,
+        rule_id: str,
+        message: str,
+        path: str = "",
+        severity: Optional[Severity] = None,
+    ) -> None:
+        self.diagnostics.append(
+            Diagnostic(
+                rule_id=rule_id,
+                severity=severity or RULES[rule_id].severity,
+                message=message,
+                path=path,
+            )
+        )
+
+    def extend(self, other: "LintReport") -> None:
+        self.diagnostics.extend(other.diagnostics)
+
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == Severity.ERROR]
+
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == Severity.WARN]
+
+    def ok(self) -> bool:
+        return not self.errors()
+
+    def rule_ids(self) -> List[str]:
+        return [d.rule_id for d in self.diagnostics]
+
+    def has(self, rule_id: str) -> bool:
+        return rule_id in self.rule_ids()
+
+    def render(self) -> str:
+        head = f"{self.target}: " if self.target else ""
+        if not self.diagnostics:
+            return f"{head}clean"
+        return "\n".join(f"{head}{d.render()}" for d in self.diagnostics)

@@ -1,0 +1,428 @@
+"""Spec dry-run analysis: decide placement feasibility statically.
+
+Pure functions over (TrainJob, resolved TrainingRuntime, optional inventory
+snapshot, optional queued PodGroups) — no API writes, no clocks, no side
+effects. The shape resolution mirrors the v2 plugin chain exactly
+(runtime/plugins.py: TrainJob overrides win, workers-per-slice is fixed by
+the runtime's base shape, non-divisible overrides clamp down to whole
+slices), so what the analyzer accepts is what the reconciler would build.
+
+ICI-contiguity feasibility reuses the packer's own candidate generation
+(scheduler/candidates.py): a topology is placeable on a slice geometry iff
+`enumerate_candidates` yields at least one host mask for it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Tuple
+
+from training_operator_tpu.analysis.diagnostics import LintReport
+from training_operator_tpu.api.validation import is_dns1035_label
+from training_operator_tpu.cluster.inventory import (
+    TPU_RESOURCE,
+    accel_family,
+    topology_chips,
+    try_parse_topology,
+)
+from training_operator_tpu.cluster.objects import PodGroupPhase
+from training_operator_tpu.runtime.api import TRAINER_NODE, TrainingRuntime, TrainJob
+from training_operator_tpu.scheduler.candidates import CandidateCache, host_grid_dims
+
+# Shared across lint invocations: geometry classes are few, and webhook-path
+# lint runs per TrainJob create — re-enumerating per admission would be the
+# only non-O(1) cost on that path. Enumerations are immutable, so sharing
+# with concurrent readers is safe.
+_candidates = CandidateCache()
+
+# Operator-injected bootstrap env per policy family (controllers/jax.py,
+# controllers/pytorch.py, runtime/plugins.py). A user key colliding with one
+# of these silently wins (controllers use env.setdefault) and can break
+# coordinator discovery — exactly the footgun ENV001 exists for.
+JAX_INJECTED_ENV = frozenset({
+    "PYTHONUNBUFFERED", "COORDINATOR_ADDRESS", "COORDINATOR_PORT",
+    "NUM_PROCESSES", "PROCESS_ID", "TPU_ACCELERATOR", "TPU_NUM_SLICES",
+    "TPU_SLICE_TOPOLOGY", "TPU_MESH_AXES", "TPU_SLICE_ID",
+    "TPU_WORKER_ID_IN_SLICE", "TPU_WORKERS_PER_SLICE",
+    "TPU_SLICE_COORDINATOR_ADDRESS", "TPU_SLICE_COORDINATOR_PORT",
+    "MEGASCALE_COORDINATOR_ADDRESS", "MEGASCALE_PORT",
+    "MEGASCALE_NUM_SLICES", "MEGASCALE_SLICE_ID",
+})
+TORCH_INJECTED_ENV = frozenset({
+    "PYTHONUNBUFFERED", "MASTER_ADDR", "MASTER_PORT", "WORLD_SIZE", "RANK",
+    "PET_NODE_RANK", "PET_NNODES", "PET_NPROC_PER_NODE", "PET_RDZV_ENDPOINT",
+    "PET_RDZV_BACKEND", "PET_RDZV_ID", "PET_RDZV_CONF", "PET_STANDALONE",
+    "PET_MAX_RESTARTS",
+})
+
+# (tpu_type, slice_topology, chips_per_host) -> number of such slices
+SliceClasses = Dict[Tuple[str, str, int], int]
+
+
+def slice_classes_from_nodes(nodes: Iterable) -> SliceClasses:
+    """Geometry classes of the TPU slices in a node inventory (equal
+    geometries share one candidate enumeration, snapshot.SliceInfo-style).
+    Slices with unparseable topology labels are dropped — the analyzer runs
+    against live label data and must not crash admission on a junk node."""
+    slices: Dict[str, Tuple[str, str, int]] = {}
+    for node in nodes:
+        acc = getattr(node, "accelerator", None)
+        if acc is None or acc.kind != "tpu" or not acc.tpu_slice:
+            continue
+        if try_parse_topology(acc.slice_topology) is None or acc.chips < 1:
+            continue
+        slices[acc.tpu_slice] = (acc.tpu_type, acc.slice_topology, acc.chips)
+    classes: SliceClasses = {}
+    for geom in slices.values():
+        classes[geom] = classes.get(geom, 0) + 1
+    return classes
+
+
+def _accel_chip_suffix(accelerator: str) -> Optional[int]:
+    tail = accelerator.rsplit("-", 1)[-1]
+    return int(tail) if tail.isdigit() else None
+
+
+def _safe_chips(topology: str) -> Optional[int]:
+    dims = try_parse_topology(topology)
+    if dims is None:
+        return None
+    return topology_chips(topology)
+
+
+def analyze_trainjob(
+    job: Optional[TrainJob],
+    runtime: Optional[TrainingRuntime],
+    nodes: Optional[Iterable] = None,
+    podgroups: Optional[Iterable] = None,
+    target: str = "",
+) -> LintReport:
+    """The full static dry-run for one TrainJob against its resolved runtime.
+
+    `nodes` (any iterable of cluster Node objects, fake or live) enables the
+    inventory-dependent rules (TPU002-vs-inventory, CAP001/CAP002);
+    `podgroups` enables the queue analysis (GANG001/GANG002, CAP002).
+    Either may be None — rules that need them are skipped, never guessed.
+    """
+    report = LintReport(target=target or (job.name if job is not None else ""))
+    trainer = job.trainer if job is not None else None
+
+    if job is not None and job.metadata.name:
+        name = job.metadata.name
+        if not is_dns1035_label(name):
+            report.add("JOB001", f"{name!r} is not a DNS-1035 label", "metadata.name")
+
+    if runtime is None:
+        ref = job.runtime_ref if job is not None else None
+        report.add(
+            "RT001",
+            f"runtime {ref.kind}/{ref.name} not found" if ref else "no runtime resolved",
+            "runtimeRef",
+        )
+        return report
+
+    ml = runtime.spec.ml_policy
+    if runtime.spec.replicated_job(TRAINER_NODE) is None:
+        report.add(
+            "RT002",
+            f"runtime {runtime.name!r} declares no {TRAINER_NODE!r} template",
+            "spec.template",
+        )
+
+    # -- failure-policy / elastic-range sanity ------------------------------
+    torch = ml.torch
+    if torch is not None:
+        lo, hi = torch.elastic_min_nodes, torch.elastic_max_nodes
+        resolved_nodes = ml.num_nodes
+        if trainer is not None and trainer.num_nodes is not None:
+            resolved_nodes = trainer.num_nodes
+        if (lo is None) != (hi is None):
+            report.add("POL001", "elastic_min_nodes and elastic_max_nodes must "
+                       "be set together", "mlPolicy.torch")
+        elif lo is not None and hi is not None:
+            if lo < 1 or lo > hi:
+                report.add("POL001", f"elastic range [{lo}, {hi}] is invalid",
+                           "mlPolicy.torch")
+            elif not (lo <= resolved_nodes <= hi):
+                report.add("POL001",
+                           f"numNodes={resolved_nodes} outside elastic range [{lo}, {hi}]",
+                           "mlPolicy.torch")
+        if torch.max_restarts is not None and torch.max_restarts < 0:
+            report.add("POL002", f"max_restarts={torch.max_restarts} is negative",
+                       "mlPolicy.torch.maxRestarts")
+
+    # -- env-bootstrap conflicts --------------------------------------------
+    if trainer is not None and trainer.env:
+        injected = JAX_INJECTED_ENV if ml.tpu is not None else (
+            TORCH_INJECTED_ENV if torch is not None else frozenset()
+        )
+        clashes = sorted(set(trainer.env) & injected)
+        if clashes:
+            report.add(
+                "ENV001",
+                "user env overrides operator bootstrap vars: " + ", ".join(clashes),
+                "trainer.env",
+            )
+
+    # -- TPU topology feasibility -------------------------------------------
+    tpu = ml.tpu
+    if tpu is None or not tpu.topology:
+        return report
+
+    chips_per_slice = _safe_chips(tpu.topology)
+    if chips_per_slice is None:
+        report.add("TPU001", f"unparseable topology {tpu.topology!r}",
+                   "mlPolicy.tpu.topology")
+        return report
+
+    num_slices = tpu.num_slices
+    base_nodes = ml.num_nodes
+    if base_nodes < 1:
+        # The webhook rejects this on stored runtimes, but CLI inline
+        # runtimes reach the analyzer unvalidated — never divide by it.
+        report.add("TPU004", f"numNodes={base_nodes} must be >= 1",
+                   "mlPolicy.numNodes")
+        return report
+    if num_slices < 1 or base_nodes % num_slices:
+        report.add(
+            "TPU004",
+            f"numNodes={base_nodes} not divisible into numSlices={num_slices}",
+            "mlPolicy.numNodes",
+        )
+        return report
+    per_slice = base_nodes // num_slices
+    total_chips = chips_per_slice * num_slices
+
+    if chips_per_slice % per_slice:
+        report.add(
+            "TPU001",
+            f"{per_slice} node(s) per slice cannot tile {tpu.topology} "
+            f"({chips_per_slice} chips): chips-per-host would be "
+            f"{chips_per_slice / per_slice:g}",
+            "mlPolicy.numNodes",
+        )
+        return report
+    chips_per_host = chips_per_slice // per_slice
+
+    # Explicit procPerNode must agree with the derived chips-per-host, and
+    # the job-resolved node count x proc must tile whole slices (the
+    # workload always places whole `chips_per_slice` blocks).
+    n_resolved = base_nodes
+    if trainer is not None and trainer.num_nodes is not None:
+        n_resolved = trainer.num_nodes
+    proc = None
+    if trainer is not None and trainer.num_proc_per_node is not None:
+        proc = trainer.num_proc_per_node
+    if proc is not None:
+        if proc != chips_per_host:
+            report.add(
+                "TPU001",
+                f"numProcPerNode={proc} != chips-per-host {chips_per_host} "
+                f"({tpu.topology} over {per_slice} node(s) per slice)",
+                "trainer.numProcPerNode",
+            )
+        elif (n_resolved * proc) % chips_per_slice:
+            report.add(
+                "TPU001",
+                f"numNodes={n_resolved} x numProcPerNode={proc} = "
+                f"{n_resolved * proc} chips cannot tile whole {tpu.topology} "
+                f"slices ({chips_per_slice} chips each)",
+                "trainer.numProcPerNode",
+            )
+
+    # Contiguity: the request must admit at least one axis-aligned candidate
+    # on its own slice grid — hosts owning `chips_per_host` consecutive
+    # minor-axis chips must tile the grid (packer precondition).
+    if host_grid_dims(tpu.topology, chips_per_host) is None or (
+        not _candidates.feasible(tpu.topology, chips_per_host, tpu.topology)
+    ):
+        report.add(
+            "TPU002",
+            f"{chips_per_host}-chip hosts cannot tile {tpu.topology}: no "
+            "contiguous ICI sub-mesh placement exists",
+            "mlPolicy.tpu.topology",
+        )
+
+    if tpu.mesh_axes:
+        prod = 1
+        for v in tpu.mesh_axes.values():
+            prod *= v
+        if prod != total_chips:
+            report.add(
+                "TPU003",
+                f"mesh_axes product {prod} != total chips {total_chips}",
+                "mlPolicy.tpu.meshAxes",
+            )
+
+    suffix = _accel_chip_suffix(tpu.accelerator)
+    if suffix is not None and suffix != chips_per_slice:
+        report.add(
+            "TPU005",
+            f"accelerator {tpu.accelerator!r} names {suffix} chips but "
+            f"topology {tpu.topology} has {chips_per_slice}",
+            "mlPolicy.tpu.accelerator",
+        )
+
+    # Whole-slice override discipline (plugins.WorkloadBuilderPlugin clamps).
+    if trainer is not None and trainer.num_nodes is not None:
+        n = trainer.num_nodes
+        if n % per_slice:
+            clamped = max(per_slice, (n // per_slice) * per_slice)
+            report.add(
+                "NODE001",
+                f"numNodes override {n} is not a multiple of workers-per-slice "
+                f"{per_slice}; the workload builder will clamp it to {clamped}",
+                "trainer.numNodes",
+            )
+
+    # -- inventory-dependent rules ------------------------------------------
+    if nodes is not None:
+        classes = slice_classes_from_nodes(nodes)
+        family = accel_family(tpu.accelerator)
+        # The job's own PodGroup (when linting an already-created job) must
+        # not count as competing demand on top of extra_chips/extra_slices.
+        own = (job.namespace, job.name) if job is not None else None
+        queued = None
+        if podgroups is not None:
+            queued = [
+                pg for pg in podgroups
+                if (pg.namespace, pg.name) != own
+            ]
+        _check_inventory(report, classes, family, tpu.topology, num_slices,
+                         total_chips, nodes, queued)
+    return report
+
+
+def _check_inventory(
+    report: LintReport,
+    classes: SliceClasses,
+    family: str,
+    topology: str,
+    num_slices: int,
+    total_chips: int,
+    nodes: Iterable,
+    podgroups: Optional[Iterable],
+) -> None:
+    if not classes:
+        report.add("CAP001", "inventory has no TPU slices at all",
+                   "mlPolicy.tpu")
+        return
+    matching = {g: n for g, n in classes.items() if not family or g[0] == family}
+    if not matching:
+        have = sorted({g[0] for g in classes})
+        report.add("CAP001",
+                   f"no {family!r} slices in inventory (have: {', '.join(have)})",
+                   "mlPolicy.tpu.accelerator")
+        return
+    feasible = sum(
+        count for (t, slice_topo, cph), count in matching.items()
+        if _candidates.feasible(slice_topo, cph, topology)
+    )
+    if feasible == 0:
+        geoms = sorted({f"{g[1]}/{g[2]}chip-hosts" for g in matching})
+        report.add(
+            "TPU002",
+            f"topology {topology} fits no slice geometry in the inventory "
+            f"({', '.join(geoms)})",
+            "mlPolicy.tpu.topology",
+        )
+        return
+    if feasible < num_slices:
+        report.add(
+            "CAP001",
+            f"request needs {num_slices} slice(s) but only {feasible} "
+            f"matching slice(s) exist",
+            "mlPolicy.tpu.numSlices",
+        )
+    if podgroups is not None:
+        queue = analyze_gang_queue(
+            podgroups, nodes,
+            extra_chips=float(total_chips),
+            extra_slices=num_slices,
+        )
+        report.extend(queue)
+
+
+def analyze_gang_queue(
+    podgroups: Iterable,
+    nodes: Iterable,
+    extra_chips: float = 0.0,
+    extra_slices: int = 0,
+    target: str = "",
+) -> LintReport:
+    """Capacity/deadlock analysis across queued PodGroups.
+
+    - GANG001: a queued gang whose ICI topology fits no slice geometry will
+      sit Unschedulable forever (statically decidable — flag it now).
+    - GANG002: individually-placeable whole-slice gangs collectively demand
+      more slices than exist; admission order decides who waits.
+    - CAP002: total queued chip demand (plus `extra_chips` for a job being
+      linted pre-submit) exceeds the pool's total chips.
+
+    Both sides of the capacity comparisons span ALL accelerator families:
+    PodGroups don't carry a tpu_type, so demand can't be family-filtered —
+    filtering only the supply side would invent contention between disjoint
+    pools. Cross-family totals under-warn at worst; never over-warn.
+    """
+    report = LintReport(target=target)
+    classes = slice_classes_from_nodes(nodes)
+    total_slices = sum(classes.values())
+    total_chips = sum(
+        topology_chips(topo) * n for (t, topo, _), n in classes.items()
+    )
+    demanded_chips = extra_chips
+    demanded_slices = extra_slices
+    for pg in podgroups:
+        if pg.phase not in (PodGroupPhase.PENDING, PodGroupPhase.UNSCHEDULABLE):
+            continue
+        demanded_chips += pg.min_resources.get(TPU_RESOURCE, 0.0)
+        topo = pg.topology_request
+        if topo is None:
+            continue
+        demanded_slices += max(1, pg.num_slices)
+        # topology_request is untrusted live data (PodGroups have no
+        # admission hook): a malformed value is itself a never-placeable
+        # gang, not an excuse to crash every subsequent lint/admission.
+        if try_parse_topology(topo) is None:
+            report.add(
+                "GANG001",
+                f"queued gang {pg.namespace}/{pg.name} requests unparseable "
+                f"topology {topo!r} — it can never admit",
+                f"podgroup/{pg.name}",
+            )
+            continue
+        placeable = any(
+            _candidates.feasible(slice_topo, cph, topo)
+            for (_, slice_topo, cph) in classes
+        )
+        if not placeable:
+            report.add(
+                "GANG001",
+                f"queued gang {pg.namespace}/{pg.name} requests {topo} which "
+                "fits no slice geometry — it can never admit",
+                f"podgroup/{pg.name}",
+            )
+    if total_chips and demanded_chips > total_chips:
+        report.add(
+            "CAP002",
+            f"queued demand {demanded_chips:g} chips exceeds pool total "
+            f"{total_chips:g}",
+        )
+    if total_slices and demanded_slices > total_slices:
+        report.add(
+            "GANG002",
+            f"queued gangs want {demanded_slices} slice(s), pool has "
+            f"{total_slices} — admission order decides who waits",
+        )
+    return report
+
+
+def analyze_runtime(
+    runtime: TrainingRuntime,
+    nodes: Optional[Iterable] = None,
+    target: str = "",
+) -> LintReport:
+    """Lint a runtime on its own base shape (no TrainJob overrides) — what
+    `lint --preset` and the runtime-admission WARN path run."""
+    return analyze_trainjob(
+        None, runtime, nodes=nodes, target=target or runtime.name
+    )

@@ -28,6 +28,12 @@ _DNS1035 = re.compile(r"^[a-z]([-a-z0-9]*[a-z0-9])?$")
 _MAX_NAME_LEN = 63
 
 
+def is_dns1035_label(name: str) -> bool:
+    """The one copy of the name rule (webhooks and the spec analyzer must
+    agree with v1 admission about what a legal name is)."""
+    return bool(_DNS1035.match(name)) and len(name) <= _MAX_NAME_LEN
+
+
 class ValidationError(ValueError):
     def __init__(self, errors: List[str]):
         self.errors = errors
@@ -40,7 +46,7 @@ def validate_job(job: Job) -> None:
 
     if not job.metadata.name:
         errs.append("metadata.name: required")
-    elif not _DNS1035.match(job.metadata.name) or len(job.metadata.name) > _MAX_NAME_LEN:
+    elif not is_dns1035_label(job.metadata.name):
         errs.append(
             f"metadata.name: {job.metadata.name!r} must be a valid RFC1035 label "
             f"(lowercase alphanumeric/'-', start with a letter, <={_MAX_NAME_LEN} chars)"

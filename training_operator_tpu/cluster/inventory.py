@@ -34,6 +34,25 @@ def parse_topology(topology: str) -> List[int]:
     return [int(x) for x in topology.lower().split("x")]
 
 
+def accel_family(accelerator: str) -> str:
+    """"v5e-8" -> "v5e": the family the packer matches slices on. One copy
+    (scheduler/snapshot.py and the spec analyzer both consume it), so lint
+    and placement can never disagree about what "matching" means."""
+    return accelerator.rsplit("-", 1)[0] if "-" in accelerator else accelerator
+
+
+def try_parse_topology(topology: str) -> Optional[List[int]]:
+    """parse_topology for untrusted input (lint/admission paths): None on
+    malformed or non-positive dims instead of ValueError."""
+    try:
+        dims = parse_topology(topology)
+    except (ValueError, AttributeError):
+        return None
+    if not dims or any(d < 1 for d in dims):
+        return None
+    return dims
+
+
 def topology_chips(topology: str) -> int:
     n = 1
     for d in parse_topology(topology):

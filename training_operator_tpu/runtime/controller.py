@@ -194,11 +194,9 @@ class TrainJobManager:
         self._last_resync = cluster.clock.now()
         self._watch = self.api.watch()
         cluster.add_ticker(self.tick)
-        from training_operator_tpu.runtime.webhooks import validate_trainjob, validate_training_runtime
+        from training_operator_tpu.runtime.webhooks import register_v2_admission
 
-        self.api.register_admission(TrainJob.KIND, validate_trainjob)
-        self.api.register_admission(TrainingRuntime.KIND, validate_training_runtime)
-        self.api.register_admission(ClusterTrainingRuntime.KIND, validate_training_runtime)
+        register_v2_admission(self.api)
         # Built-in runtime catalog (reference manifests/v2/base/runtimes):
         # a fresh cluster can run `client.train(...)` with the default
         # runtime_ref without anyone hand-building a runtime first.

@@ -3,14 +3,21 @@
 Parity target: reference pkg/webhook.v2/trainjob_webhook.go:44-56 and
 trainingruntime_webhook.go:56-68 (exactly one trainer container in the
 trainer-node replicated job).
+
+On top of the reference's shallow field checks, the admission path runs the
+static dry-run analyzer (analysis/speclint.py): statically-certain
+never-placeable specs (wrong chip count for the slice topology, broken mesh
+axes, unsatisfiable elastic range) are rejected with their rule ids, while
+heuristic/inventory-dependent findings surface as a non-fatal WARN
+annotation on the stored object — the reference discovers all of this only
+after reconcile leaves a gang Unschedulable.
 """
 
 from __future__ import annotations
 
-import re
 from typing import List
 
-from training_operator_tpu.api.validation import ValidationError
+from training_operator_tpu.api.validation import ValidationError, is_dns1035_label
 from training_operator_tpu.runtime.api import (
     ClusterTrainingRuntime,
     TRAINER_NODE,
@@ -18,14 +25,23 @@ from training_operator_tpu.runtime.api import (
     TrainJob,
 )
 
-_DNS1035 = re.compile(r"^[a-z]([-a-z0-9]*[a-z0-9])?$")
+# Where webhook-path lint warnings land on the admitted object.
+LINT_ANNOTATION = "lint.tpu.dev/warnings"
+
+# Analyzer rules that are statically certain from (spec, runtime) alone and
+# therefore fatal at admission. Inventory/queue-dependent rules (CAP*/GANG*)
+# and heuristics (ENV001, TPU005, NODE001, RT00x) stay advisory: cluster
+# state changes, admission decisions must not.
+ADMISSION_FATAL_RULES = frozenset(
+    {"TPU001", "TPU002", "TPU003", "TPU004", "POL001", "POL002"}
+)
 
 
 def validate_trainjob(job: TrainJob) -> None:
     errs: List[str] = []
     if not job.metadata.name:
         errs.append("metadata.name: required")
-    elif not _DNS1035.match(job.metadata.name) or len(job.metadata.name) > 63:
+    elif not is_dns1035_label(job.metadata.name):
         errs.append(f"metadata.name: {job.metadata.name!r} is not a valid DNS-1035 label")
     if not job.runtime_ref.name:
         errs.append("runtimeRef.name: required")
@@ -45,6 +61,10 @@ def validate_training_runtime(rt: TrainingRuntime) -> None:
     errs: List[str] = []
     if not rt.metadata.name:
         errs.append("metadata.name: required")
+    elif not is_dns1035_label(rt.metadata.name):
+        # Runtime names flow into generated object names the same way
+        # TrainJob names do; the reference checks both webhook kinds.
+        errs.append(f"metadata.name: {rt.metadata.name!r} is not a valid DNS-1035 label")
     policies = [p for p in (rt.spec.ml_policy.torch, rt.spec.ml_policy.mpi,
                             rt.spec.ml_policy.tpu) if p is not None]
     if len(policies) > 1:
@@ -61,3 +81,54 @@ def validate_training_runtime(rt: TrainingRuntime) -> None:
         )
     if errs:
         raise ValidationError(errs)
+
+
+def lint_trainjob_admission(api, job: TrainJob) -> None:
+    """Dry-run analysis at admission: reject statically-certain
+    never-placeable specs; annotate everything else as warnings. This also
+    closes the webhook gap around trainer.num_nodes overrides — the
+    cross-check against the runtime's mlPolicy.numNodes / TPU topology is
+    the analyzer's TPU001/NODE001 pair, not a re-implementation here."""
+    from training_operator_tpu.analysis.speclint import analyze_trainjob
+    from training_operator_tpu.utils import metrics
+
+    ref = job.runtime_ref
+    if ref.kind == TrainingRuntime.KIND:
+        runtime = api.try_get(TrainingRuntime.KIND, job.namespace, ref.name)
+    else:
+        runtime = api.try_get(ClusterTrainingRuntime.KIND, "", ref.name)
+    # Admission hooks run under the API server's store lock: the O(nodes +
+    # podgroups) inventory/queue scan is only worth that hold time when the
+    # job actually asks for TPU placement; everything else gets the O(1)
+    # spec-only rules.
+    tpu = runtime.spec.ml_policy.tpu if runtime is not None else None
+    nodes = api.list("Node") if tpu is not None and tpu.topology else None
+    report = analyze_trainjob(
+        job, runtime,
+        nodes=nodes if nodes else None,
+        podgroups=api.list("PodGroup") if nodes else None,
+    )
+    for d in report.diagnostics:
+        metrics.lint_diagnostics.inc(d.rule_id, d.severity.value)
+    fatal = [d for d in report.errors() if d.rule_id in ADMISSION_FATAL_RULES]
+    if fatal:
+        raise ValidationError([f"{d.rule_id} {d.slug}: {d.message}" for d in fatal])
+    advisory = [d for d in report.diagnostics if d.rule_id not in ADMISSION_FATAL_RULES]
+    if advisory:
+        job.annotations[LINT_ANNOTATION] = "; ".join(
+            f"{d.rule_id}: {d.message}" for d in advisory
+        )
+
+
+def register_v2_admission(api) -> None:
+    """The full v2 admission chain: field validation + spec lint. One
+    registration helper shared by the in-process TrainJobManager and the
+    serving host role, so the two deployment shapes can't drift."""
+
+    def admit_trainjob(job: TrainJob) -> None:
+        validate_trainjob(job)
+        lint_trainjob_admission(api, job)
+
+    api.register_admission(TrainJob.KIND, admit_trainjob)
+    api.register_admission(TrainingRuntime.KIND, validate_training_runtime)
+    api.register_admission(ClusterTrainingRuntime.KIND, validate_training_runtime)

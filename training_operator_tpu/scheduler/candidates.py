@@ -159,3 +159,12 @@ class CandidateCache:
         if key not in self._cache:
             self._cache[key] = enumerate_candidates(*key)
         return self._cache[key]
+
+    def feasible(
+        self, slice_topology: str, chips_per_host: int, request_topology: str
+    ) -> bool:
+        """At least one contiguous placement exists for this geometry pair.
+        The static analyzer's question (speclint TPU002/GANG001) — answered
+        from the same enumeration the packer solves over, so lint and
+        placement can never disagree about feasibility."""
+        return self.get(slice_topology, chips_per_host, request_topology) is not None

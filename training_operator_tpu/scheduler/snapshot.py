@@ -372,8 +372,9 @@ def build_gang_request(api: APIServer, pg: PodGroup) -> Optional[GangRequest]:
 
 
 def _accel_family(accelerator: str) -> str:
-    """"v5e-8" -> "v5e"."""
-    return accelerator.rsplit("-", 1)[0] if "-" in accelerator else accelerator
+    from training_operator_tpu.cluster.inventory import accel_family
+
+    return accel_family(accelerator)
 
 
 def request_hosts_per_slice(req: GangRequest, chips_per_host: int) -> int:

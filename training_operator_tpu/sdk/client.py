@@ -363,6 +363,49 @@ class TrainingClient:
             self.cluster.run_for(poll)
             waited += poll
 
+    # -- static analysis ---------------------------------------------------
+
+    def lint(self, job: Union[TrainJob, str], namespace: Optional[str] = None):
+        """Static dry-run of a TrainJob against the live cluster: the spec
+        analyzer (analysis/speclint.py) run with the resolved runtime, the
+        cluster's node inventory, and the queued PodGroups — the same pass
+        the admission webhook applies, but client-side and fully advisory.
+        `job` may be a TrainJob object (not yet created) or the name of an
+        existing one. Returns a LintReport."""
+        from training_operator_tpu.analysis.speclint import analyze_trainjob
+        from training_operator_tpu.runtime.api import (
+            ClusterTrainingRuntime,
+            TrainingRuntime,
+        )
+
+        ns = namespace or self.namespace
+        if isinstance(job, str):
+            job = self.api.get(TrainJob.KIND, ns, job)
+        ref = job.runtime_ref
+        if ref.kind == TrainingRuntime.KIND:
+            runtime = self.api.try_get(
+                TrainingRuntime.KIND, job.metadata.namespace or ns, ref.name
+            )
+        else:
+            runtime = self.api.try_get(ClusterTrainingRuntime.KIND, "", ref.name)
+        if runtime is None and ref.kind == ClusterTrainingRuntime.KIND:
+            # Pre-install lint (fresh cluster, presets not yet installed):
+            # fall back to the built-in catalog the manager would install.
+            from training_operator_tpu.runtime.presets import builtin_runtimes
+
+            for rt in builtin_runtimes():
+                if rt.metadata.name == ref.name:
+                    runtime = rt
+                    break
+        nodes = self.api.list("Node")
+        return analyze_trainjob(
+            job,
+            runtime,
+            nodes=nodes if nodes else None,
+            podgroups=self.api.list("PodGroup"),
+            target=job.metadata.name,
+        )
+
     # -- high-level fine-tune ---------------------------------------------
 
     def train(

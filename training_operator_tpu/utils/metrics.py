@@ -188,6 +188,11 @@ reconcile_total = registry.counter(
     "Reconcile passes by kind and result",
     ("kind", "result"),  # result: success | error
 )
+lint_diagnostics = registry.counter(
+    "training_lint_diagnostics_total",
+    "Spec-lint diagnostics emitted by admission-path dry-run analysis",
+    ("rule", "severity"),
+)
 workqueue_depth = registry.gauge(
     "training_operator_workqueue_depth",
     "Keys pending in the manager workqueue after the current tick",
