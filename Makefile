@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast lint bench bench-quick dryrun operator-demo ha-demo native clean
+.PHONY: test test-fast lint bench bench-quick bench-wire dryrun operator-demo ha-demo native clean
 
 test:            ## full suite (no hardware needed; ~10 min)
 	$(PY) -m pytest tests/ -q
@@ -41,8 +41,12 @@ operator-demo:   ## the operator process end-to-end on the example workload
 ha-demo:         ## wire deployment: host + 2 operator processes, leader killed
 	$(PY) examples/remote_ha.py
 
-wire-bench:      ## wire-deployment overhead vs in-process (200-job burst)
-	JAX_PLATFORMS=cpu $(PY) bench.py --wire-overhead-only
+# Quick-sized (100-job) wire-vs-inproc overhead + cache hit rates, printed
+# as one JSON line — wire perf is reproducible without the full 1k-job sim.
+bench-wire:      ## wire fast-path block standalone (quick-sized, one JSON line)
+	JAX_PLATFORMS=cpu $(PY) bench.py --wire-overhead-only --wire-jobs 100
+
+wire-bench: bench-wire  ## back-compat alias for bench-wire
 
 native:          ## force-rebuild the C++ data-path core (drops the hash cache)
 	$(PY) -c "from training_operator_tpu import native; import glob, os; \

@@ -581,9 +581,22 @@ def _submit_to_running_percentiles(jobs_live, pods):
     }
 
 
+def _tls_available() -> bool:
+    """The host role mints its CA via the `cryptography` package; a build
+    container without it can still measure the wire path over cleartext
+    loopback HTTP (the transport field records which mode ran)."""
+    try:
+        import cryptography  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
 def _wire_leg(n_jobs: int):
     """host + 1 operator as real OS processes over HTTPS (the shipped
-    default: TLS on, cond-var long-poll watches), submission via the SDK."""
+    default: TLS on, cond-var long-poll watches), submission via the SDK.
+    Falls back to loopback HTTP where the TLS dependency is absent."""
     import os as _os
     import tempfile
 
@@ -595,6 +608,7 @@ def _wire_leg(n_jobs: int):
     with open(inv, "w") as f:
         json.dump({"cpu_pools": [{"nodes": CPU_NODES, "cpu_per_node": CPU_PER_NODE}]}, f)
     repo = _os.path.dirname(_os.path.abspath(__file__))
+    tls = _tls_available()
 
     def spawn(*a):
         # Control-plane processes never touch the accelerator (gang
@@ -602,14 +616,20 @@ def _wire_leg(n_jobs: int):
         # whose backend init can hang when the tunnel is down.
         return spawn_module_process(a, repo, env_extra={"JAX_PLATFORMS": "cpu"})
 
-    host = spawn("--role", "host", "--serve-port", "0",
-                 "--gang-scheduler-name", "none", "--cluster", inv)
+    host_args = ["--role", "host", "--serve-port", "0",
+                 "--gang-scheduler-name", "none", "--cluster", inv]
+    if not tls:
+        host_args.append("--insecure")
+    host = spawn(*host_args)
     procs = [host]
     try:
         url = _read_announcement(host, "WIRE_API=")
-        ca = _read_announcement(host, "WIRE_CA=")
-        op = spawn("--role", "operator", "--api-server", url, "--ca-cert", ca,
-                   "--enable-scheme", "jax", "--gang-scheduler-name", "none")
+        ca = _read_announcement(host, "WIRE_CA=") if tls else None
+        op_args = ["--role", "operator", "--api-server", url,
+                   "--enable-scheme", "jax", "--gang-scheduler-name", "none"]
+        if ca:
+            op_args += ["--ca-cert", ca]
+        op = spawn(*op_args)
         procs.append(op)
         _read_announcement(op, "OPERATOR_UP=")
 
@@ -671,6 +691,28 @@ def _wire_leg(n_jobs: int):
         deltas.sort()
         out["watch_delivery_p50_ms"] = round(1000 * _pct(deltas, 0.50), 1)
         out["watch_delivery_p95_ms"] = round(1000 * _pct(deltas, 0.95), 1)
+
+        # Wire-cache hit rates from the HOST's registry (GET /metrics) — the
+        # direct evidence for the serialize-once/body-cache claims, readable
+        # by the driver instead of trusted from a self-run.
+        try:
+            snap = api.metrics_snapshot()
+            hits = snap.get("training_wire_body_cache_hits_total", 0.0)
+            misses = snap.get("training_wire_body_cache_misses_total", 0.0)
+            enc = snap.get("training_wire_event_encodes_total", 0.0)
+            reuse = snap.get("training_wire_event_cache_hits_total", 0.0)
+            out["wire_cache"] = {
+                "codec_cache_hits": snap.get("training_wire_codec_cache_hits_total", 0.0),
+                "codec_compiles": snap.get("training_wire_codec_compiles_total", 0.0),
+                "body_cache_hits": hits,
+                "body_cache_misses": misses,
+                "body_cache_hit_rate": round(hits / (hits + misses), 3)
+                if hits + misses else None,
+                "event_encodes": enc,
+                "event_cache_hits": reuse,
+            }
+        except Exception:  # noqa: BLE001 — bench must survive an old host
+            out["wire_cache"] = None
         return out
     finally:
         for p in procs:
@@ -738,7 +780,10 @@ def run_wire_overhead(n_jobs: int = 200):
         )
     return {
         "jobs": n_jobs,
-        "transport": "https (TLS default, CA-pinned client)",
+        "transport": (
+            "https (TLS default, CA-pinned client)" if _tls_available()
+            else "http (loopback; TLS dep unavailable on this machine)"
+        ),
         "inproc": inproc,
         "wire": wire,
         "overhead_ratio_p50": ratio,

@@ -16,7 +16,12 @@ from training_operator_tpu.api.common import Container, PodTemplateSpec, Replica
 from training_operator_tpu.api.jobs import JAXJob, ObjectMeta
 from training_operator_tpu.cluster.apiserver import APIServer
 from training_operator_tpu.cluster.objects import Event, Lease, Pod
-from training_operator_tpu.cluster.store import SNAPSHOT, HostStore, journal_name
+from training_operator_tpu.cluster.store import (
+    SNAPSHOT,
+    HostStore,
+    JournalWriteError,
+    journal_name,
+)
 
 
 def _job(name: str) -> JAXJob:
@@ -264,3 +269,59 @@ class TestCompaction:
         assert not os.path.exists(tmp_path / (SNAPSHOT + ".tmp"))
         snap = json.load(open(tmp_path / SNAPSHOT))
         assert snap["rv"] >= 1 and len(snap["objects"]) == 1
+
+
+class _BoomFH:
+    """A journal file handle whose writes fail (disk full / revoked fd)."""
+
+    def write(self, s):
+        raise OSError(28, "No space left on device")
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class TestJournalWriteFailure:
+    """ADVICE r5: a failed journal append must be FATAL-loud (etcd-style)
+    and latched — never a silent memory/disk divergence that a later
+    restart converts into lost writes. The journal is write-ahead, so the
+    failing write aborts cleanly: no watcher ever observed it."""
+
+    def test_failure_raises_latches_and_keeps_disk_honest(self, tmp_path):
+        api = APIServer()
+        store = HostStore(str(tmp_path))
+        store.load_into(api)
+        store.attach(api)
+        api.create(_pod("durable"))
+        assert store.degraded is False
+
+        store._journal_fh = _BoomFH()
+        with pytest.raises(JournalWriteError):
+            api.create(_pod("diverged"))
+        assert store.degraded is True
+        # Write-ahead: the aborted write never reached memory (and so was
+        # never broadcast to watchers) — memory and disk agree.
+        assert api.try_get("Pod", "default", "diverged") is None
+
+        # Latched: every subsequent mutation fails loudly too, even though
+        # the broken fh is gone — a degraded store never quietly resumes.
+        store._journal_fh = None
+        with pytest.raises(JournalWriteError):
+            api.create(_pod("after-latch"))
+
+        # A compaction attempt while degraded must REFUSE: snapshotting the
+        # diverged in-memory state would durably resurrect the write whose
+        # journal append failed (its client saw an error).
+        store._records_since_snapshot = 10**6
+        assert store.maybe_compact(api) is False
+        store.compact(api)  # direct call refuses too
+        assert not os.path.exists(tmp_path / SNAPSHOT)
+
+        # Disk stays honest: recovery sees exactly the acknowledged-and-
+        # journaled prefix, not the diverged write.
+        api2 = _recover(tmp_path)
+        names = {p.metadata.name for p in api2.list("Pod")}
+        assert names == {"durable"}

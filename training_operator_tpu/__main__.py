@@ -515,6 +515,14 @@ def run_host(args, cfg) -> int:
         while not stop.is_set():
             cluster.step()
             if store is not None:
+                if store.degraded:
+                    # A write hit a journal append failure (its client saw
+                    # the error; write-ahead ordering means the write never
+                    # landed in memory either). The journal device is in an
+                    # unknown state — exit etcd-style so supervision
+                    # restarts us from the last durable state.
+                    log.critical("host store DEGRADED (journal write failed); exiting")
+                    return 1
                 store.maybe_compact(cluster.api)
             if deadline is not None and cluster.clock.now() >= deadline:
                 break

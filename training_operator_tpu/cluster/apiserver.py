@@ -323,12 +323,16 @@ class APIServer:
             obj.metadata.ensure_uid(obj.KIND)
             obj.metadata.resource_version = self._next_rv()
             stored = self._clone(obj)
+            # Write-ahead: journal BEFORE the in-memory apply and the watch
+            # notify. A failed append (disk full) then aborts the write
+            # cleanly — no watcher ever observes an object that won't
+            # survive the restart the failure forces (see JournalWriteError).
+            if self._journal is not None:
+                self._journal("put", stored)
             self._objects[key] = stored
             self._by_kind.setdefault(key[0], {})[key[1:]] = stored
             self._index_labels(key, stored)
             self._notify("Added", self._clone(stored))
-            if self._journal is not None:
-                self._journal("put", stored)
             return obj
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
@@ -342,6 +346,19 @@ class APIServer:
         with self._lock:
             obj = self._objects.get((kind, namespace or "", name))
             return self._clone(obj) if obj is not None else None
+
+    def get_ref(self, kind: str, namespace: str, name: str) -> Any:
+        """The STORED object, no copy — the wire encode fast path (a deep
+        clone per GET would cost more than the serialization it feeds).
+        Safe under the same invariant snapshot_refs leans on: updates
+        replace stored objects, never mutate them in place, so a returned
+        reference is a consistent frozen version forever. Callers must
+        treat it as read-only."""
+        with self._lock:
+            try:
+                return self._objects[(kind, namespace or "", name)]
+            except KeyError:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found") from None
 
     def resource_version(self, kind: str, namespace: str, name: str) -> Optional[int]:
         """Version probe without the read copy — cache-validation fast path
@@ -365,28 +382,29 @@ class APIServer:
                 )
             obj.metadata.resource_version = self._next_rv()
             stored = self._clone(obj)
+            if self._journal is not None:  # write-ahead, see create()
+                self._journal("put", stored)
             self._unindex_labels(key, current)
             self._objects[key] = stored
             self._by_kind.setdefault(key[0], {})[key[1:]] = stored
             self._index_labels(key, stored)
             self._notify("Modified", self._clone(stored), status_only=status_only)
-            if self._journal is not None:
-                self._journal("put", stored)
             return obj
 
     def delete(self, kind: str, namespace: str, name: str) -> Any:
         with self._lock:
             key = (kind, namespace or "", name)
-            obj = self._objects.pop(key, None)
+            obj = self._objects.get(key)
             if obj is None:
                 raise NotFoundError(f"{key} not found")
+            if self._journal is not None:  # write-ahead, see create()
+                self._journal("del", kind, namespace or "", name, self._rv_value)
+            del self._objects[key]
             self._by_kind.get(kind, {}).pop(key[1:], None)
             self._unindex_labels(key, obj)
             if kind == "Pod":
                 self._pod_logs.pop(key[1:], None)
             self._notify("Deleted", obj)  # orphaned: safe to hand out as-is
-            if self._journal is not None:
-                self._journal("del", kind, namespace or "", name, self._rv_value)
             return obj
 
     def try_delete(self, kind: str, namespace: str, name: str) -> Optional[Any]:
@@ -401,6 +419,22 @@ class APIServer:
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
     ) -> List[Any]:
+        # Clone OUTSIDE the lock: the refs are frozen versions (updates
+        # replace, never mutate), and the deep copies are the expensive
+        # part — holding the store lock across them would stall every
+        # concurrent API request at burst scale.
+        return [self._clone(obj) for obj in self.list_refs(kind, namespace, label_selector)]
+
+    def list_refs(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Any]:
+        """list() without the copies — STORED references, read-only by the
+        same contract as get_ref. The wire layer encodes these directly
+        (and caches the bytes by resourceVersion), skipping one full deep
+        copy per object per LIST."""
         with self._lock:
             by_kind = self._by_kind.get(kind, {})
             if label_selector:
@@ -420,10 +454,10 @@ class APIServer:
                         continue
                     labels = obj.metadata.labels
                     if all(labels.get(lk) == lv for lk, lv in label_selector.items()):
-                        out.append(self._clone(obj))
+                        out.append(obj)
                 return out
             return [
-                self._clone(obj)
+                obj
                 for (ns, _), obj in by_kind.items()
                 if namespace is None or ns == namespace
             ]
@@ -434,6 +468,8 @@ class APIServer:
         """Kubelet-side write of one log line (lifecycle event or a line of
         container stdout) for pod namespace/name."""
         with self._lock:
+            if self._journal is not None:  # write-ahead, see create()
+                self._journal("log", namespace or "", name, str(line), ts)
             buf = self._pod_logs.setdefault(
                 (namespace or "", name), {"lines": [], "base": 0}
             )
@@ -443,8 +479,6 @@ class APIServer:
             if overflow > 0:
                 del buf["lines"][:overflow]
                 buf["base"] += overflow
-            if self._journal is not None:
-                self._journal("log", namespace or "", name, str(line), ts)
 
     def read_pod_log(
         self,
@@ -471,9 +505,9 @@ class APIServer:
 
     def record_event(self, event: Event) -> None:
         with self._lock:
-            self._events.append(event)
-            if self._journal is not None:
+            if self._journal is not None:  # write-ahead, see create()
                 self._journal("event", event)
+            self._events.append(event)
 
     def events(
         self, object_name: Optional[str] = None, reason: Optional[str] = None

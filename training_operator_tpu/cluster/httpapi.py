@@ -28,13 +28,17 @@ doesn't leak an ever-growing event queue.
 from __future__ import annotations
 
 import heapq
+import http.client
 import itertools
 import json
 import logging
+import socket
+import ssl as _ssl
 import threading
 import time as _time
 import urllib.parse
 import uuid
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -48,6 +52,7 @@ from training_operator_tpu.cluster.apiserver import (
 )
 from training_operator_tpu.cluster.objects import Event
 from training_operator_tpu.cluster.runtime import Clock
+from training_operator_tpu.utils import metrics
 
 log = logging.getLogger(__name__)
 
@@ -140,6 +145,21 @@ class ApiHTTPServer:
         # watch_id -> (WatchQueue, last_access_monotonic)
         self._sessions: Dict[str, List[Any]] = {}
         self._sessions_lock = threading.Lock()
+        # Version-keyed body cache: (kind, ns, name, resourceVersion) ->
+        # encoded JSON bytes. Objects are immutable between resourceVersions
+        # (copy-on-read store), so cached bytes can never be stale — an
+        # update bumps the rv and misses. GET serves straight from bytes;
+        # LIST responses are assembled by byte concatenation. LRU-bounded:
+        # dead versions age out, no invalidation hooks needed.
+        self._body_cache: "OrderedDict[Tuple[str, str, str, int], bytes]" = OrderedDict()
+        self._body_cache_max = 16384
+        self._body_lock = threading.Lock()
+        # Parsed-route memo keyed by the raw request target: watch polls and
+        # burst-time LISTs repeat identical paths thousands of times, and
+        # urlsplit+unquote+parse_qsl per request shows up at that scale.
+        # Handlers never mutate the parts/query they are handed. Unlocked by
+        # design: a lost race costs one re-parse, nothing else.
+        self._route_cache: Dict[str, Tuple[List[str], Dict[str, str]]] = {}
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -153,7 +173,9 @@ class ApiHTTPServer:
                 pass
 
             def _send(self, code: int, payload: Any) -> None:
-                body = json.dumps(payload).encode()
+                self._send_bytes(code, json.dumps(payload).encode())
+
+            def _send_bytes(self, code: int, body: bytes) -> None:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -167,16 +189,24 @@ class ApiHTTPServer:
 
             def _route(self, method: str) -> None:
                 try:
-                    parsed = urllib.parse.urlsplit(self.path)
-                    # Unquote AFTER splitting: a %2F inside an object name
-                    # must not become a path separator.
-                    parts = [
-                        urllib.parse.unquote(p)
-                        for p in parsed.path.split("/")
-                        if p
-                    ]
-                    q = dict(urllib.parse.parse_qsl(parsed.query))
-                    outer._dispatch(self, method, parts, q)
+                    cached = outer._route_cache.get(self.path)
+                    if cached is None:
+                        parsed = urllib.parse.urlsplit(self.path)
+                        # Unquote AFTER splitting: a %2F inside an object
+                        # name must not become a path separator.
+                        parts = [
+                            urllib.parse.unquote(p)
+                            for p in parsed.path.split("/")
+                            if p
+                        ]
+                        q = dict(urllib.parse.parse_qsl(parsed.query))
+                        # Inserted by _dispatch only AFTER auth passes —
+                        # unauthenticated traffic must not evict hot routes
+                        # or pin attacker-chosen keys.
+                        outer._dispatch(self, method, parts, q, memo_key=self.path)
+                    else:
+                        parts, q = cached
+                        outer._dispatch(self, method, parts, q)
                 except NotFoundError as e:
                     self._send(404, {"error": "NotFound", "message": str(e)})
                 except ConflictError as e:
@@ -266,7 +296,14 @@ class ApiHTTPServer:
 
     # -- dispatch ----------------------------------------------------------
 
-    def _dispatch(self, h, method: str, parts: List[str], q: Dict[str, str]) -> None:
+    def _dispatch(
+        self,
+        h,
+        method: str,
+        parts: List[str],
+        q: Dict[str, str],
+        memo_key: Optional[str] = None,
+    ) -> None:
         if not parts:
             h._send(404, {"error": "NotFound", "message": "no route"})
             return
@@ -309,6 +346,13 @@ class ApiHTTPServer:
             ):
                 h._send(401, {"error": "Unauthorized", "message": "bad or missing bearer token"})
                 return
+        if memo_key is not None and len(memo_key) <= 512:
+            # Authenticated (or open-deployment) request on a fresh path:
+            # memoize the parse. Bounded; clear-all on overflow is fine —
+            # the hot keys (watch polls, burst LISTs) repopulate instantly.
+            if len(self._route_cache) >= 4096:
+                self._route_cache.clear()
+            self._route_cache[memo_key] = (parts, q)
         if head == "objects":
             self._objects(h, method, parts[1:], q)
         elif head == "watches":
@@ -317,27 +361,72 @@ class ApiHTTPServer:
             self._logs(h, method, parts[1:], q)
         elif head == "events":
             self._events(h, method, q)
+        elif head == "metrics":
+            # JSON snapshot of the serving process's metrics registry —
+            # how a remote bench/test reads the wire-cache hit rates
+            # (codec/body/event counters) instead of trusting a self-run.
+            h._send(200, metrics.registry.snapshot())
         elif head == "version" and len(parts) == 4:
             rv = self.api.resource_version(parts[1], _seg_ns(parts[2]), parts[3])
             h._send(200, {"resourceVersion": rv})
         else:
             h._send(404, {"error": "NotFound", "message": f"no route {head}"})
 
+    def _object_bytes(self, obj) -> bytes:
+        """Encoded JSON bytes for one STORED object reference, via the
+        version-keyed cache. The ref is a frozen version (updates replace,
+        never mutate), so encoding outside any lock is safe and the cached
+        bytes are valid for that (name, resourceVersion) forever."""
+        md = obj.metadata
+        key = (
+            obj.KIND,
+            getattr(md, "namespace", "") or "",
+            md.name,
+            md.resource_version,
+        )
+        with self._body_lock:
+            body = self._body_cache.get(key)
+            if body is not None:
+                self._body_cache.move_to_end(key)
+        if body is not None:
+            metrics.wire_body_cache_hits.inc()
+            return body
+        body = json.dumps(wire.encode(obj), separators=(",", ":")).encode()
+        metrics.wire_body_cache_misses.inc()
+        with self._body_lock:
+            self._body_cache[key] = body
+            while len(self._body_cache) > self._body_cache_max:
+                self._body_cache.popitem(last=False)
+        return body
+
     def _objects(self, h, method: str, parts: List[str], q: Dict[str, str]) -> None:
         if method == "POST" and not parts:
             obj = wire.decode(h._body())
             created = self.api.create(obj)
-            h._send(201, wire.encode(created))
+            # Respond through the body cache: `created` carries the assigned
+            # uid/resourceVersion and is content-identical to the stored
+            # clone, so this both serves the response and SEEDS the cache —
+            # the operator's next LIST of this version is a hit.
+            h._send_bytes(201, self._object_bytes(created))
         elif method == "GET" and len(parts) == 1:
             selector = None
             if q.get("labelSelector"):
                 selector = dict(
                     pair.split("=", 1) for pair in q["labelSelector"].split(",") if "=" in pair
                 )
-            objs = self.api.list(parts[0], q.get("namespace") or None, selector)
-            h._send(200, {"items": [wire.encode(o) for o in objs]})
+            refs = self.api.list_refs(parts[0], q.get("namespace") or None, selector)
+            # Byte concatenation, not re-encoding: each element's bytes come
+            # from the version-keyed cache, so a burst of identical LISTs
+            # costs one serialization per changed object, total.
+            h._send_bytes(
+                200,
+                b'{"items":[' + b",".join(self._object_bytes(o) for o in refs) + b"]}",
+            )
         elif method == "GET" and len(parts) == 3:
-            h._send(200, wire.encode(self.api.get(parts[0], _seg_ns(parts[1]), parts[2])))
+            h._send_bytes(
+                200,
+                self._object_bytes(self.api.get_ref(parts[0], _seg_ns(parts[1]), parts[2])),
+            )
         elif method == "PUT" and len(parts) == 3:
             obj = wire.decode(h._body())
             updated = self.api.update(
@@ -345,10 +434,12 @@ class ApiHTTPServer:
                 check_version=q.get("check_version", "1") != "0",
                 status_only=q.get("status_only") == "1",
             )
-            h._send(200, wire.encode(updated))
+            # Seeds the cache with the fresh version (see POST above).
+            h._send_bytes(200, self._object_bytes(updated))
         elif method == "DELETE" and len(parts) == 3:
             gone = self.api.delete(parts[0], _seg_ns(parts[1]), parts[2])
-            h._send(200, wire.encode(gone))
+            # The deleted object's final version is usually already cached.
+            h._send_bytes(200, self._object_bytes(gone))
         else:
             h._send(404, {"error": "NotFound", "message": "bad objects route"})
 
@@ -382,7 +473,15 @@ class ApiHTTPServer:
                 session = self._sessions.get(parts[0])
                 if session is not None:
                     session[1] = _time.monotonic()  # poll completion counts as activity
-            h._send(200, {"events": [wire.encode_watch_event(ev) for ev in events]})
+            # Serialize-once fanout: each event's bytes are encoded exactly
+            # once (cached on the shared event object) and reused by every
+            # session's drain — N subscribers no longer cost N encodes.
+            h._send_bytes(
+                200,
+                b'{"events":['
+                + b",".join(wire.encode_watch_event_bytes(ev) for ev in events)
+                + b"]}",
+            )
         elif method == "DELETE" and len(parts) == 1:
             with self._sessions_lock:
                 session = self._sessions.pop(parts[0], None)
@@ -624,8 +723,17 @@ class _SharedWatch:
         try:
             payload = self._remote._request(
                 "GET", f"/watches/{self.watch_id}", query={"timeout": str(t)},
-                channel="watch",
+                channel="watch", idempotent=False,
             )
+        except ApiUnavailableError:
+            # The drain died mid-flight on a transport failure. The server
+            # may already have emptied the queue into the lost response —
+            # those events are unrecoverable via the session, so the ONLY
+            # safe recovery is a relist (marked now, run on the next drain).
+            # A transparent GET retry here (the pre-fix behavior) would
+            # return an empty drain and silently drop them instead.
+            self._needs_relist = True
+            raise
         except NotFoundError:
             # Session reaped server-side (idle past session_ttl, host
             # restart, injected chaos). Re-subscribe, then RELIST and
@@ -700,9 +808,20 @@ class RemoteAPIServer:
         self._shared_watch: Optional[_SharedWatch] = None
         self._local = threading.local()
         self._ssl_context = None
-        if self.base_url.startswith("https"):
+        # Request-path trims: the URL is parsed once and the header dict is
+        # built once — a reconcile makes ~8 wire calls and a 1k-job burst
+        # makes tens of thousands, so per-request urlsplit + dict rebuilds
+        # are measurable. http.client copies headers into its send buffer
+        # and never mutates the dict, so sharing one instance is safe.
+        parsed = urllib.parse.urlsplit(self.base_url)
+        self._host = parsed.hostname
+        self._port = parsed.port
+        self._scheme = parsed.scheme
+        self._headers: Dict[str, str] = {"Content-Type": "application/json"}
+        if token is not None:
+            self._headers["Authorization"] = f"Bearer {token}"
+        if self._scheme == "https":
             from training_operator_tpu.cluster import certs as _certs
-            import ssl as _ssl
 
             self._ssl_context = (
                 _certs.client_context(ca_file) if ca_file
@@ -725,28 +844,25 @@ class RemoteAPIServer:
         `channel` exists because requests on one connection are strictly
         sequential: the watch long-poll BLOCKS its connection for up to the
         poll timeout, and CRUD calls queued behind it would eat that wait on
-        every reconcile. Watch traffic therefore rides its own connection.
+        every reconcile. Watch traffic therefore rides its own connection,
+        and connections stay warm for the client's lifetime — they are only
+        dropped on a transport error (and then rebuilt on the next call).
         """
-        import http.client
-
         conn = getattr(self._local, "conn_" + channel, None)
         if conn is None:
-            parsed = urllib.parse.urlsplit(self.base_url)
-            if parsed.scheme == "https":
+            if self._scheme == "https":
                 conn = http.client.HTTPSConnection(
-                    parsed.hostname, parsed.port, timeout=self.timeout,
+                    self._host, self._port, timeout=self.timeout,
                     context=self._ssl_context,
                 )
             else:
                 conn = http.client.HTTPConnection(
-                    parsed.hostname, parsed.port, timeout=self.timeout
+                    self._host, self._port, timeout=self.timeout
                 )
             conn.connect()
             # Same delayed-ACK tax in the other direction: the request line/
             # headers and the JSON body are separate send()s too.
-            import socket as _socket
-
-            conn.sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             setattr(self._local, "conn_" + channel, conn)
         return conn
 
@@ -766,18 +882,20 @@ class RemoteAPIServer:
         body: Optional[Dict[str, Any]] = None,
         query: Optional[Dict[str, str]] = None,
         channel: str = "main",
+        idempotent: bool = True,
     ) -> Any:
-        import http.client
-        import socket
-        import ssl as _ssl
-
+        """`idempotent=False` marks a request whose GET is NOT safe to
+        replay transparently — the watch-session drain, a DESTRUCTIVE read:
+        the server empties the queue when it serves the response, so if the
+        response is lost on a stale keep-alive connection, a silent retry
+        returns a fresh (empty) drain and the lost events are gone forever.
+        Such calls surface ApiUnavailableError instead and the caller heals
+        by relist."""
         target = path
         if query:
             target += "?" + urllib.parse.urlencode(query)
         data = json.dumps(body).encode() if body is not None else None
-        headers = {"Content-Type": "application/json"}
-        if self.token is not None:
-            headers["Authorization"] = f"Bearer {self.token}"
+        headers = self._headers
 
         for attempt in (0, 1):
             try:
@@ -798,7 +916,7 @@ class RemoteAPIServer:
                     raise PermissionError(
                         f"{method} {path}: TLS verification failed: {e}"
                     ) from None
-                if attempt == 0 and method == "GET" and isinstance(
+                if attempt == 0 and method == "GET" and idempotent and isinstance(
                     e,
                     (
                         http.client.RemoteDisconnected,
@@ -810,11 +928,14 @@ class RemoteAPIServer:
                     # A stale keep-alive connection the server closed while
                     # we were idle dies exactly this way on the next use;
                     # one transparent retry on a FRESH connection is standard
-                    # (urllib3 does the same) — but only for GET: replaying
-                    # a POST whose response was lost could double-apply a
-                    # create/log-append server-side. Non-idempotent calls
-                    # surface ApiUnavailableError and the caller's retry arm
-                    # (reconcile requeue) absorbs it.
+                    # (urllib3 does the same) — but only for an IDEMPOTENT
+                    # GET: replaying a POST whose response was lost could
+                    # double-apply a create/log-append server-side, and
+                    # replaying a watch drain (a destructive read) would
+                    # silently drop the events the lost response carried.
+                    # Non-idempotent calls surface ApiUnavailableError and
+                    # the caller's retry arm (reconcile requeue, watch
+                    # relist) absorbs it.
                     continue
                 raise ApiUnavailableError(f"{method} {path}: {e}") from None
 
@@ -912,6 +1033,12 @@ class RemoteAPIServer:
     def server_time(self) -> float:
         """The serving host's cluster-clock reading (GET /time)."""
         return float(self._request("GET", "/time")["now"])
+
+    def metrics_snapshot(self) -> Dict[str, float]:
+        """The SERVING process's metrics registry as a flat JSON dict
+        (GET /metrics) — how benchmarks and tests verify the wire-cache
+        hit-rate claims against the host instead of a self-run."""
+        return self._request("GET", "/metrics")
 
     # -- watch -------------------------------------------------------------
 
@@ -1164,6 +1291,13 @@ class RemoteRuntime:
         self._tickers: List[Callable[[], None]] = []
         self._timers: List[Tuple[float, int, Callable[[], None]]] = []
         self._timer_seq = itertools.count()
+        # schedule_after is called from reconcile WORKER threads (requeue
+        # backoff) while the main loop pops due timers in step(); heapq on
+        # a shared list is not thread-safe, and a corrupted heap silently
+        # delays or drops requeue timers. All heap mutation goes through
+        # this lock; timer callbacks run OUTSIDE it (a callback that
+        # schedules again must not deadlock).
+        self._timers_lock = threading.Lock()
 
     def add_ticker(self, fn: Callable[[], None]) -> None:
         self._tickers.append(fn)
@@ -1175,7 +1309,8 @@ class RemoteRuntime:
             pass
 
     def schedule_at(self, t: float, fn: Callable[[], None]) -> None:
-        heapq.heappush(self._timers, (t, next(self._timer_seq), fn))
+        with self._timers_lock:
+            heapq.heappush(self._timers, (t, next(self._timer_seq), fn))
 
     def schedule_after(self, dt: float, fn: Callable[[], None]) -> None:
         self.schedule_at(self.clock.now() + dt, fn)
@@ -1186,8 +1321,11 @@ class RemoteRuntime:
 
     def step(self) -> None:
         now = self.clock.now()
-        while self._timers and self._timers[0][0] <= now:
-            _, _, fn = heapq.heappop(self._timers)
+        while True:
+            with self._timers_lock:
+                if not self._timers or self._timers[0][0] > now:
+                    break
+                _, _, fn = heapq.heappop(self._timers)
             fn()
         for fn in list(self._tickers):
             fn()

@@ -63,6 +63,20 @@ SNAPSHOT = "snapshot.json"
 _JOURNAL_RE = re.compile(r"^journal\.(\d+)\.jsonl$")
 
 
+class JournalWriteError(RuntimeError):
+    """A journal append failed (disk full, fd revoked, I/O error). The
+    journal is WRITE-AHEAD (the sink runs before the in-memory apply and
+    the watch notify), so the triggering API mutation aborts cleanly —
+    no watcher ever observed it — but the journal file may now end in a
+    torn record and the device is in an unknown state. etcd treats this as
+    fatal and panics; this store does the analogue: the error propagates
+    to the caller, the store latches DEGRADED (every subsequent mutation
+    fails loudly, compaction refuses), and the host process exits so
+    supervision restarts it from the last durable state (recovery truncates
+    the torn tail). The one thing that can never happen is an acknowledged
+    write silently missing from the journal."""
+
+
 def journal_name(gen: int) -> str:
     return f"journal.{gen:08d}.jsonl"
 
@@ -86,6 +100,10 @@ class HostStore:
         self._journal_fh = None
         self._gen = 0
         self._records_since_snapshot = 0
+        # Latched on the first journal write failure; read by the host main
+        # loop, which exits rather than keep serving writes whose journal
+        # records are silently missing (see JournalWriteError).
+        self.degraded = False
 
     # -- restore -----------------------------------------------------------
 
@@ -253,11 +271,30 @@ class HostStore:
         else:  # pragma: no cover - defensive
             return
         with self._lock:
+            if self.degraded:
+                raise JournalWriteError(
+                    "journal is degraded after an earlier write failure; "
+                    "restart the host to recover from durable state"
+                )
             fh = self._journal_fh
             if fh is None:
                 return
-            fh.write(json.dumps(rec) + "\n")
-            fh.flush()
+            try:
+                fh.write(json.dumps(rec) + "\n")
+                fh.flush()
+            except (OSError, ValueError) as e:
+                # ValueError: write on a closed fd. The sink is write-ahead,
+                # so the caller aborts the in-memory apply — but the journal
+                # may hold a torn record and the device state is unknown.
+                # Latch degraded and crash loudly rather than keep accepting
+                # writes the journal can't durably order.
+                self.degraded = True
+                log.critical(
+                    "journal write failed (%s): store is DEGRADED — "
+                    "failing all writes until restart recovers from "
+                    "durable state", e,
+                )
+                raise JournalWriteError(f"journal write failed: {e}") from e
             self._records_since_snapshot += 1
 
     # -- compaction --------------------------------------------------------
@@ -267,7 +304,7 @@ class HostStore:
         accumulated. Called from the host main loop (never a handler
         thread)."""
         with self._lock:
-            if self._records_since_snapshot < self.compact_every:
+            if self.degraded or self._records_since_snapshot < self.compact_every:
                 return False
         self.compact(api)
         return True
@@ -286,9 +323,26 @@ class HostStore:
         with api.locked():
             refs = api.snapshot_refs()
             with self._lock:
+                if self.degraded:
+                    # The journal device is in an unknown state (the failed
+                    # append may sit as a torn record); rotating generations
+                    # and fsyncing a snapshot on it is exactly the wrong
+                    # moment. Recovery after restart handles the torn tail.
+                    # Holding both locks makes this check race-free against
+                    # a concurrent sink failure.
+                    log.error("store degraded: refusing to compact")
+                    return
                 new_gen = self._gen + 1
                 if self._journal_fh is not None:
-                    self._journal_fh.close()
+                    try:
+                        self._journal_fh.close()
+                    except OSError:
+                        # Every record was flush()ed at append time, so the
+                        # close has nothing buffered — a failure here is
+                        # inert for data, and must not crash the host
+                        # outside the curated degraded path (see close()).
+                        log.error("journal close failed during compaction",
+                                  exc_info=True)
                 self._journal_fh = open(
                     os.path.join(self.root, journal_name(new_gen)), "a"
                 )
@@ -318,7 +372,13 @@ class HostStore:
     def close(self) -> None:
         with self._lock:
             if self._journal_fh is not None:
-                self._journal_fh.close()
+                try:
+                    self._journal_fh.close()
+                except OSError:
+                    # Closing flushes; on a degraded store (ENOSPC) that can
+                    # fail again — the clean degraded exit must not turn
+                    # into an unhandled traceback in the shutdown path.
+                    log.error("journal close failed (store degraded?)", exc_info=True)
                 self._journal_fh = None
 
 

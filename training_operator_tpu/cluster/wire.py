@@ -9,14 +9,25 @@ codec over the dataclass object model, so the HTTP API server
 (cluster/httpapi.py) and remote clients exchange exactly the objects the
 in-process APIServer stores.
 
-Design: instead of hand-written to_dict/from_dict per class (the reference's
-generated zz_generated deepcopy/openapi machinery), one recursive codec walks
-`dataclasses.fields` + `typing.get_type_hints`:
+Design: the codec is COMPILED, not interpreted. The first encode/decode of a
+dataclass walks `dataclasses.fields` + `typing.get_type_hints` once and
+builds a field table of closures — one encoder/decoder per field, specialized
+to the declared type (Optional[X], List[X], Dict[str, X], nested dataclasses,
+Enums; `Any` falls back to a value-driven walk). Every later call runs the
+table: no typing-module reflection on the hot path. The wire path is the
+dominant per-job control-plane cost at 1k-job-burst scale, and profile showed
+the per-field hint walks were most of it.
 
-  encode: dataclass -> {field: encode(value)}, Enum -> .value,
-          list/tuple -> list, dict -> {key: encode(value)}
-  decode: driven by the declared field type — Optional[X], List[X],
-          Dict[str, X], nested dataclasses, Enums; `Any` passes through.
+The original reflection codec survives as `reflect_encode`/`reflect_decode`:
+it is the executable spec the compiled codec is property-tested against
+(tests/test_wire_fastpath.py), and the fallback for non-dataclass values.
+
+`encode_watch_event_bytes` serializes a watch event to JSON bytes ONCE and
+caches them on the (shared, immutable) event object, so N watch sessions
+draining the same event reuse one serialization — the serialize-once fanout
+half of the wire fast path. Cache traffic is observable via the
+`training_wire_*` counters (utils/metrics.py) so benchmarks and tests can
+assert hit rates instead of trusting the implementation.
 
 Top-level objects carry a `"kind"` discriminator resolved via KIND_REGISTRY.
 """
@@ -25,12 +36,15 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import json
+import threading
 import typing
-from typing import Any, Dict, List, Optional, Type
+from typing import Any, Callable, Dict, Optional
 
 from training_operator_tpu.api import jobs as jobs_api
 from training_operator_tpu.cluster import objects as cluster_objects
 from training_operator_tpu.runtime import api as runtime_api
+from training_operator_tpu.utils import metrics
 
 # kind string -> class, for every kind the APIServer can store (plus Event,
 # which travels via the events subresource).
@@ -57,6 +71,13 @@ KIND_REGISTRY: Dict[str, type] = {
     )
 }
 
+# Compiled codec tables: dataclass -> closure. Reads are lock-free dict
+# lookups; compilation (rare: once per class per process) is serialized so
+# the compile counter stays exact.
+_ENCODERS: Dict[type, Callable[[Any], Dict[str, Any]]] = {}
+_DECODERS: Dict[type, Callable[[Dict[str, Any]], Any]] = {}
+_codec_lock = threading.Lock()
+
 # Resolved type hints are cached per class: get_type_hints re-evaluates the
 # stringified `from __future__ import annotations` annotations on every call.
 _HINTS_CACHE: Dict[type, Dict[str, Any]] = {}
@@ -70,21 +91,205 @@ def _hints(cls: type) -> Dict[str, Any]:
     return cached
 
 
-def encode(obj: Any) -> Any:
-    """Recursively encode a model value to JSON-compatible data."""
+# ---------------------------------------------------------------------------
+# Compiled encoder
+# ---------------------------------------------------------------------------
+
+
+def _encode_value(obj: Any) -> Any:
+    """Value-driven encode for `Any`-typed fields and non-dataclass input:
+    the shape of the data, not a declared hint, decides. Dataclasses still
+    route through their compiled encoders."""
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
-        out = {f.name: encode(getattr(obj, f.name)) for f in dataclasses.fields(obj)}
-        kind = getattr(type(obj), "KIND", None)
-        if kind in KIND_REGISTRY:
-            out["kind"] = kind
-        return out
+        return _encoder_for(type(obj))(obj)
     if isinstance(obj, enum.Enum):
         return obj.value
     if isinstance(obj, (list, tuple)):
-        return [encode(v) for v in obj]
+        return [_encode_value(v) for v in obj]
     if isinstance(obj, dict):
-        return {str(k): encode(v) for k, v in obj.items()}
+        return {str(k): _encode_value(v) for k, v in obj.items()}
     return obj  # str/int/float/bool/None
+
+
+def _enc_scalar(v: Any) -> Any:
+    # Declared-primitive fields occasionally hold richer values (a str-Enum
+    # assigned to a str field); the type check keeps those lossless while
+    # staying a single dict-free probe on the fast path.
+    if v is None or type(v) in (str, int, float, bool):
+        return v
+    return _encode_value(v)
+
+
+def _enc_dataclass_field(v: Any) -> Any:
+    """Encoder for a field declared as a dataclass: dispatch on the VALUE's
+    class (subclasses carry their own fields) via the compiled table. One
+    shared function — the declared hint carries no extra information here."""
+    if v is None:
+        return None
+    cls = type(v)
+    if not dataclasses.is_dataclass(cls):
+        return _encode_value(v)
+    e = _ENCODERS.get(cls)
+    if e is None:
+        e = _encoder_for(cls)
+    return e(v)
+
+
+def _value_encoder(hint: Any) -> Callable[[Any], Any]:
+    origin = typing.get_origin(hint)
+    if origin is typing.Union:
+        arms = [a for a in typing.get_args(hint) if a is not type(None)]
+        inner = _value_encoder(arms[0]) if len(arms) == 1 else _encode_value
+        return lambda v: None if v is None else inner(v)
+    if origin in (list, tuple):
+        args = typing.get_args(hint)
+        inner = _value_encoder(args[0]) if args else _encode_value
+        return lambda v: None if v is None else [inner(x) for x in v]
+    if origin is dict:
+        args = typing.get_args(hint)
+        inner = _value_encoder(args[1]) if len(args) == 2 else _encode_value
+        return (
+            lambda v: None
+            if v is None
+            else {str(k): inner(x) for k, x in v.items()}
+        )
+    if isinstance(hint, type):
+        if dataclasses.is_dataclass(hint):
+            return _enc_dataclass_field
+        if issubclass(hint, enum.Enum):
+            return lambda v: v.value if isinstance(v, enum.Enum) else v
+        if hint in (str, int, float, bool):
+            return _enc_scalar
+    return _encode_value
+
+
+def _compile_encoder(cls: type) -> Callable[[Any], Dict[str, Any]]:
+    hints = _hints(cls)
+    steps = tuple(
+        (f.name, _value_encoder(hints.get(f.name, Any)))
+        for f in dataclasses.fields(cls)
+    )
+    kind = getattr(cls, "KIND", None)
+    if kind in KIND_REGISTRY:
+
+        def enc(obj: Any, _steps=steps, _kind=kind) -> Dict[str, Any]:
+            out = {name: fe(getattr(obj, name)) for name, fe in _steps}
+            out["kind"] = _kind
+            return out
+
+    else:
+
+        def enc(obj: Any, _steps=steps) -> Dict[str, Any]:
+            return {name: fe(getattr(obj, name)) for name, fe in _steps}
+
+    return enc
+
+
+def _encoder_for(cls: type) -> Callable[[Any], Dict[str, Any]]:
+    enc = _ENCODERS.get(cls)
+    if enc is None:
+        with _codec_lock:
+            enc = _ENCODERS.get(cls)
+            if enc is None:
+                enc = _compile_encoder(cls)
+                _ENCODERS[cls] = enc
+                metrics.wire_codec_compiles.inc()
+    return enc
+
+
+def encode(obj: Any) -> Any:
+    """Encode a model value to JSON-compatible data (compiled fast path)."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        cls = type(obj)
+        enc = _ENCODERS.get(cls)
+        if enc is None:
+            enc = _encoder_for(cls)
+        else:
+            metrics.wire_codec_cache_hits.inc()
+        return enc(obj)
+    return _encode_value(obj)
+
+
+# ---------------------------------------------------------------------------
+# Compiled decoder
+# ---------------------------------------------------------------------------
+
+
+def _identity(v: Any) -> Any:
+    return v
+
+
+def _dc_field_decoder(declared: type) -> Callable[[Any], Any]:
+    def dec(v: Any, _cls=declared) -> Any:
+        if v is None:
+            return None
+        if not isinstance(v, dict):
+            return v
+        d = _DECODERS.get(_cls)
+        if d is None:
+            d = _decoder_for(_cls)
+        return d(v)
+
+    return dec
+
+
+def _value_decoder(hint: Any) -> Callable[[Any], Any]:
+    origin = typing.get_origin(hint)
+    if origin is typing.Union:
+        # Optional[X] and small unions: decode to the first non-None arm
+        # that is a structured type; primitives pass through.
+        arms = [a for a in typing.get_args(hint) if a is not type(None)]
+        if not arms:
+            return _identity
+        inner = _value_decoder(arms[0])
+        return lambda v: None if v is None else inner(v)
+    if origin in (list, tuple):
+        args = typing.get_args(hint)
+        inner = _value_decoder(args[0] if args else Any)
+        return lambda v: None if v is None else [inner(x) for x in v]
+    if origin is dict:
+        args = typing.get_args(hint)
+        inner = _value_decoder(args[1] if len(args) == 2 else Any)
+        return (
+            lambda v: None if v is None else {k: inner(x) for k, x in v.items()}
+        )
+    if isinstance(hint, type):
+        if dataclasses.is_dataclass(hint):
+            return _dc_field_decoder(hint)
+        if issubclass(hint, enum.Enum):
+            return lambda v, _e=hint: None if v is None else _e(v)
+        if hint is float:
+            return lambda v: float(v) if isinstance(v, int) else v
+    return _identity
+
+
+def _compile_decoder(cls: type) -> Callable[[Dict[str, Any]], Any]:
+    hints = _hints(cls)
+    steps = tuple(
+        (f.name, _value_decoder(hints.get(f.name, Any)))
+        for f in dataclasses.fields(cls)
+    )
+
+    def dec(data: Dict[str, Any], _steps=steps, _cls=cls) -> Any:
+        kwargs = {}
+        for name, fd in _steps:
+            if name in data:
+                kwargs[name] = fd(data[name])
+        return _cls(**kwargs)
+
+    return dec
+
+
+def _decoder_for(cls: type) -> Callable[[Dict[str, Any]], Any]:
+    dec = _DECODERS.get(cls)
+    if dec is None:
+        with _codec_lock:
+            dec = _DECODERS.get(cls)
+            if dec is None:
+                dec = _compile_decoder(cls)
+                _DECODERS[cls] = dec
+                metrics.wire_codec_compiles.inc()
+    return dec
 
 
 def decode(data: Dict[str, Any], cls: Optional[type] = None) -> Any:
@@ -98,46 +303,90 @@ def decode(data: Dict[str, Any], cls: Optional[type] = None) -> Any:
         cls = KIND_REGISTRY.get(kind or "")
         if cls is None:
             raise ValueError(f"unknown wire kind {kind!r}")
-    return _decode_dataclass(data, cls)
+    dec = _DECODERS.get(cls)
+    if dec is None:
+        dec = _decoder_for(cls)
+    else:
+        metrics.wire_codec_cache_hits.inc()
+    return dec(data)
 
 
-def _decode_dataclass(data: Dict[str, Any], cls: type) -> Any:
+# ---------------------------------------------------------------------------
+# Reflection reference codec (the executable spec; NOT the hot path)
+# ---------------------------------------------------------------------------
+
+
+def reflect_encode(obj: Any) -> Any:
+    """Original reflection codec: recursive, value-driven, one hint walk per
+    field per call. Kept as the reference the compiled codec is
+    property-tested against — any divergence is a compiled-codec bug."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {
+            f.name: reflect_encode(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+        kind = getattr(type(obj), "KIND", None)
+        if kind in KIND_REGISTRY:
+            out["kind"] = kind
+        return out
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, (list, tuple)):
+        return [reflect_encode(v) for v in obj]
+    if isinstance(obj, dict):
+        return {str(k): reflect_encode(v) for k, v in obj.items()}
+    return obj  # str/int/float/bool/None
+
+
+def reflect_decode(data: Dict[str, Any], cls: Optional[type] = None) -> Any:
+    if cls is None:
+        kind = data.get("kind")
+        cls = KIND_REGISTRY.get(kind or "")
+        if cls is None:
+            raise ValueError(f"unknown wire kind {kind!r}")
+    return _reflect_decode_dataclass(data, cls)
+
+
+def _reflect_decode_dataclass(data: Dict[str, Any], cls: type) -> Any:
     hints = _hints(cls)
     kwargs = {}
     for f in dataclasses.fields(cls):
         if f.name in data:
-            kwargs[f.name] = _decode_value(data[f.name], hints.get(f.name, Any))
+            kwargs[f.name] = _reflect_decode_value(data[f.name], hints.get(f.name, Any))
     return cls(**kwargs)
 
 
-def _decode_value(value: Any, hint: Any) -> Any:
+def _reflect_decode_value(value: Any, hint: Any) -> Any:
     if value is None:
         return None
     origin = typing.get_origin(hint)
     if origin is typing.Union:
-        # Optional[X] and small unions: decode to the first non-None arm
-        # that is a structured type; primitives pass through.
         for arm in typing.get_args(hint):
             if arm is type(None):
                 continue
-            return _decode_value(value, arm)
+            return _reflect_decode_value(value, arm)
         return value
     if origin in (list, tuple):
         args = typing.get_args(hint)
         elem = args[0] if args else Any
-        return [_decode_value(v, elem) for v in value]
+        return [_reflect_decode_value(v, elem) for v in value]
     if origin is dict:
         args = typing.get_args(hint)
         val_t = args[1] if len(args) == 2 else Any
-        return {k: _decode_value(v, val_t) for k, v in value.items()}
+        return {k: _reflect_decode_value(v, val_t) for k, v in value.items()}
     if isinstance(hint, type):
         if dataclasses.is_dataclass(hint):
-            return _decode_dataclass(value, hint)
+            return _reflect_decode_dataclass(value, hint)
         if issubclass(hint, enum.Enum):
             return hint(value)
         if hint is float and isinstance(value, int):
             return float(value)
     return value
+
+
+# ---------------------------------------------------------------------------
+# Watch events
+# ---------------------------------------------------------------------------
 
 
 def encode_watch_event(ev) -> Dict[str, Any]:
@@ -147,6 +396,37 @@ def encode_watch_event(ev) -> Dict[str, Any]:
         "status_only": ev.status_only,
         "object": encode(ev.obj),
     }
+
+
+_event_bytes_lock = threading.Lock()
+
+
+def encode_watch_event_bytes(ev) -> bytes:
+    """JSON bytes of one watch event, serialized EXACTLY ONCE per event.
+
+    The APIServer pushes one shared WatchEvent instance to every watcher
+    (apiserver._notify), and the carried object is immutable by the informer
+    contract — so the first wire drain to reach an event encodes it and
+    caches the bytes on the event; every other session's drain reuses them.
+    Before this, each of N watch sessions re-encoded every event on every
+    poll: N-1 wasted serializations per event, pure host CPU on the
+    1k-job-burst hot path. The double-checked lock keeps the miss counter
+    honest (exactly one serialization even when two drains race)."""
+    cached = ev.__dict__.get("_wire_bytes")
+    if cached is not None:
+        metrics.wire_event_cache_hits.inc()
+        return cached
+    with _event_bytes_lock:
+        cached = ev.__dict__.get("_wire_bytes")
+        if cached is not None:
+            metrics.wire_event_cache_hits.inc()
+            return cached
+        body = json.dumps(
+            encode_watch_event(ev), separators=(",", ":")
+        ).encode()
+        ev._wire_bytes = body
+        metrics.wire_event_encodes.inc()
+        return body
 
 
 def decode_watch_event(d: Dict[str, Any]):

@@ -16,6 +16,12 @@ from collections import defaultdict
 from typing import Dict, List, Tuple
 
 
+def _label_str(label_names: Tuple[str, ...], labels: Tuple[str, ...]) -> str:
+    """THE label rendering — render() and MetricsRegistry.snapshot() must
+    agree on it or scrape text and the /metrics JSON silently diverge."""
+    return ",".join(f'{n}="{val}"' for n, val in zip(label_names, labels))
+
+
 class Counter:
     def __init__(self, name: str, help_text: str, label_names: Tuple[str, ...]):
         self.name = name
@@ -36,13 +42,17 @@ class Counter:
     def total(self) -> float:
         return sum(self._values.values())
 
+    def items(self) -> List[Tuple[Tuple[str, ...], float]]:
+        """Stable copy for iteration: a concurrent inc() inserting a
+        first-seen label tuple would otherwise blow up a reader mid-walk
+        (render/snapshot run on scrape/network threads)."""
+        with self._lock:
+            return list(self._values.items())
+
     def render(self) -> List[str]:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
-        for labels, v in sorted(self._values.items()):
-            label_str = ",".join(
-                f'{n}="{val}"' for n, val in zip(self.label_names, labels)
-            )
-            lines.append(f"{self.name}{{{label_str}}} {v}")
+        for labels, v in sorted(self.items()):
+            lines.append(f"{self.name}{{{_label_str(self.label_names, labels)}}} {v}")
         return lines
 
 
@@ -53,11 +63,8 @@ class Gauge(Counter):
 
     def render(self) -> List[str]:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
-        for labels, v in sorted(self._values.items()):
-            label_str = ",".join(
-                f'{n}="{val}"' for n, val in zip(self.label_names, labels)
-            )
-            lines.append(f"{self.name}{{{label_str}}} {v}")
+        for labels, v in sorted(self.items()):
+            lines.append(f"{self.name}{{{_label_str(self.label_names, labels)}}} {v}")
         return lines
 
 
@@ -113,6 +120,24 @@ class MetricsRegistry:
         for m in self._metrics.values():
             out.extend(m.render())
         return "\n".join(out) + "\n"
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat {name or name{labels}: value} view of every metric — the
+        JSON analogue of render(), for the wire API's GET /metrics (a remote
+        bench/test can assert counter deltas without text parsing)."""
+        out: Dict[str, float] = {}
+        for m in self._metrics.values():
+            if isinstance(m, Histogram):
+                with m._lock:
+                    out[f"{m.name}_count"] = m.count
+                    out[f"{m.name}_sum"] = m.sum
+                continue
+            for labels, v in m.items():
+                if labels:
+                    out[f"{m.name}{{{_label_str(m.label_names, labels)}}}"] = v
+                else:
+                    out[m.name] = v
+        return out
 
 
 # Global registry + the reference's counter families.
@@ -192,6 +217,34 @@ lint_diagnostics = registry.counter(
     "training_lint_diagnostics_total",
     "Spec-lint diagnostics emitted by admission-path dry-run analysis",
     ("rule", "severity"),
+)
+# Wire fast-path caches (cluster/wire.py + cluster/httpapi.py). Hit rates
+# are the evidence behind the wire_overhead bench claims: exactly one
+# serialization per watch event regardless of subscriber count, and GET/LIST
+# bodies reused across requests until the object's resourceVersion moves.
+wire_codec_cache_hits = registry.counter(
+    "training_wire_codec_cache_hits_total",
+    "encode/decode calls served by an already-compiled dataclass codec", (),
+)
+wire_codec_compiles = registry.counter(
+    "training_wire_codec_compiles_total",
+    "dataclass codec compilations (once per class per process)", (),
+)
+wire_body_cache_hits = registry.counter(
+    "training_wire_body_cache_hits_total",
+    "GET/LIST object bodies served from the version-keyed byte cache", (),
+)
+wire_body_cache_misses = registry.counter(
+    "training_wire_body_cache_misses_total",
+    "GET/LIST object bodies encoded fresh (new object or new resourceVersion)", (),
+)
+wire_event_encodes = registry.counter(
+    "training_wire_event_encodes_total",
+    "watch events serialized to wire bytes (once per event, all sessions)", (),
+)
+wire_event_cache_hits = registry.counter(
+    "training_wire_event_cache_hits_total",
+    "watch event drains served from the serialize-once byte cache", (),
 )
 workqueue_depth = registry.gauge(
     "training_operator_workqueue_depth",
