@@ -1,1368 +1,65 @@
-"""HTTP/JSON wire boundary for the substrate API server.
+"""HTTP/JSON wire boundary for the substrate API server — public facade.
 
 Gives the in-process `APIServer` the same kind of process boundary the
 reference control plane has everywhere: the SDK talks REST to a kube-apiserver
 (reference training_client.py:41), the operator consumes watch streams across
 a socket, and leader election is an apiserver-mediated lease race between real
-processes (cmd/training-operator.v1/main.go:134-166). Three pieces:
+processes (cmd/training-operator.v1/main.go:134-166). The pieces:
 
   ApiHTTPServer    — serves an existing APIServer over localhost HTTP
                      (CRUD + watch subscriptions + pod logs + events).
+                     [wire_server.py]
   RemoteAPIServer  — client with the same duck-typed surface the engine and
                      SDK consume (create/get/try_get/list/update/delete/
                      try_delete/watch/unwatch/record_event/events/
                      read_pod_log/append_pod_log/resource_version).
+                     [wire_transport.py]
+  RemoteWatchQueue / CachedReadAPI
+                   — client-side watch fanout over ONE shared wire session,
+                     and the watch-fed lister cache. [wire_watch.py]
   RemoteRuntime    — the operator-side run loop (clock + tickers + timers),
                      shape-compatible with `Cluster` for OperatorManager and
                      TrainingClient, but backed by a RemoteAPIServer.
+                     [wire_runtime.py]
+
+This module carried all four concerns in one 1,300-line file until round 6;
+it is now the import surface only. Everything the rest of the tree (and
+tests, examples, the SDK) needs is re-exported here — import from
+`cluster.httpapi`, never from the wire_* modules' underscore internals
+(codelint rule CL004 enforces the seam).
 
 Errors round-trip as HTTP statuses: 404 NotFound, 409 Conflict (stale
 resourceVersion) / AlreadyExists (create), 422 admission rejection.
-
-Watch sessions are server-side WatchQueues keyed by a token; clients poll
-`GET /watches/<id>` (optionally long-polling via ?timeout=). Sessions idle
-longer than `session_ttl` are garbage-collected so a kill -9'd operator
-doesn't leak an ever-growing event queue.
 """
 
 from __future__ import annotations
 
-import heapq
-import http.client
-import itertools
-import json
-import logging
-import socket
-import ssl as _ssl
-import threading
-import time as _time
-import urllib.parse
-import uuid
-from collections import OrderedDict
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Dict, List, Optional, Tuple
-
-from training_operator_tpu.cluster import wire
-from training_operator_tpu.cluster.apiserver import (
-    AlreadyExistsError,
-    APIServer,
-    ConflictError,
-    NotFoundError,
-    WatchQueue,
+from training_operator_tpu.cluster.wire_runtime import (
+    RemoteRuntime,
+    SyncedClock,
 )
-from training_operator_tpu.cluster.objects import Event
-from training_operator_tpu.cluster.runtime import Clock
-from training_operator_tpu.utils import metrics
-
-log = logging.getLogger(__name__)
-
-
-class ApiUnavailableError(Exception):
-    """Transport-level failure reaching the serving host (connection refused/
-    reset, socket timeout). Distinct from the API-semantic errors so callers
-    can retry instead of dying — a transient host hiccup must not take down
-    both the leader AND the standby operator."""
-
-
-class ApiServerError(Exception):
-    """The host answered 5xx (handler exception, overload). Retryable like
-    a transport failure — but a DISTINCT type from RuntimeError so the
-    operator loop's retry arm cannot swallow genuine local bugs."""
-
-
-# Empty namespace (cluster-scoped objects: Node, ClusterTrainingRuntime,
-# leases in "" if anyone does that) can't travel as an empty URL path
-# segment; "-" is the on-the-wire placeholder ("-" can never be a real
-# namespace: RFC1035 labels must start with a letter).
-def _ns_seg(namespace: str) -> str:
-    return _quote_seg(namespace or "-")
-
-
-# Names are never validated against RFC1123, so a '/', '?', '#', space, or
-# non-ASCII in a name must ride as percent-encoding — otherwise the object
-# routes wrongly (create succeeds, get/update/delete 404).
-def _quote_seg(segment: str) -> str:
-    return urllib.parse.quote(str(segment), safe="")
-
-
-def _seg_ns(segment: str) -> str:
-    return "" if segment == "-" else segment
-
-
-# ---------------------------------------------------------------------------
-# Server
-# ---------------------------------------------------------------------------
-
-
-class ApiHTTPServer:
-    """Serve one APIServer over HTTP on a background thread.
-
-    The owning process keeps driving its Cluster loop; handler threads only
-    touch the APIServer, whose RLock makes every call atomic. Watch events
-    pushed by handler-thread writes are drained by local tickers on the next
-    step, identical to any other writer.
-    """
-
-    def __init__(
-        self,
-        api: APIServer,
-        port: int = 0,
-        bind: str = "127.0.0.1",
-        session_ttl: float = 120.0,
-        token: Optional[str] = None,
-        now_fn: Optional[Callable[[], float]] = None,
-        tls: Optional[Tuple[str, str]] = None,
-        chaos: Optional[object] = None,
-    ):
-        """`token`: require `Authorization: Bearer <token>` on every route
-        except /healthz and /readyz (probes stay open, like kubelet probes)
-        — the authn half of the reference's cert-gated apiserver connection
-        (pkg/cert/cert.go:45); the transport half is TLS (see `certs.py`).
-
-        `now_fn`: the serving process's cluster clock, exposed at GET /time
-        so remote operators can run their lease/TTL arithmetic on HOST time
-        (SyncedClock). Leases written by operators on different machines
-        would otherwise compare renew_time against incomparable per-machine
-        monotonic epochs — takeover permanently blocked, or split-brain.
-
-        `tls`: (cert_path, key_path) pair (see certs.mint_server_cert) —
-        serve HTTPS; the cert can be hot-rotated via rotate_cert().
-
-        `chaos`: a cluster.chaos.WireChaos policy — per-request transport
-        fault injection (5xx, connection reset, watch-session reap) for
-        adversarial testing of the client retry/resubscribe arms."""
-        self.api = api
-        self.session_ttl = session_ttl
-        self.token = token
-        self.chaos = chaos
-        self.now_fn = now_fn or _time.time
-        if token and tls is None and bind not in ("127.0.0.1", "::1", "localhost"):
-            log.warning(
-                "bearer token configured on a non-loopback cleartext bind "
-                "(%s): the token and all API traffic are sniffable; serve "
-                "TLS (--tls) for non-local deployments", bind,
-            )
-        # watch_id -> (WatchQueue, last_access_monotonic)
-        self._sessions: Dict[str, List[Any]] = {}
-        self._sessions_lock = threading.Lock()
-        # Version-keyed body cache: (kind, ns, name, resourceVersion) ->
-        # encoded JSON bytes. Objects are immutable between resourceVersions
-        # (copy-on-read store), so cached bytes can never be stale — an
-        # update bumps the rv and misses. GET serves straight from bytes;
-        # LIST responses are assembled by byte concatenation. LRU-bounded:
-        # dead versions age out, no invalidation hooks needed.
-        self._body_cache: "OrderedDict[Tuple[str, str, str, int], bytes]" = OrderedDict()
-        self._body_cache_max = 16384
-        self._body_lock = threading.Lock()
-        # Parsed-route memo keyed by the raw request target: watch polls and
-        # burst-time LISTs repeat identical paths thousands of times, and
-        # urlsplit+unquote+parse_qsl per request shows up at that scale.
-        # Handlers never mutate the parts/query they are handed. Unlocked by
-        # design: a lost race costs one re-parse, nothing else.
-        self._route_cache: Dict[str, Tuple[List[str], Dict[str, str]]] = {}
-        outer = self
-
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-            # Response headers and body go out as separate send()s; with
-            # Nagle on a keep-alive connection the second segment waits on
-            # the client's delayed ACK — a flat ~40ms tax on EVERY request.
-            disable_nagle_algorithm = True
-
-            def log_message(self, *a):  # quiet
-                pass
-
-            def _send(self, code: int, payload: Any) -> None:
-                self._send_bytes(code, json.dumps(payload).encode())
-
-            def _send_bytes(self, code: int, body: bytes) -> None:
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def _body(self) -> Any:
-                n = int(self.headers.get("Content-Length") or 0)
-                raw = self.rfile.read(n) if n else b"{}"
-                return json.loads(raw or b"{}")
-
-            def _route(self, method: str) -> None:
-                try:
-                    cached = outer._route_cache.get(self.path)
-                    if cached is None:
-                        parsed = urllib.parse.urlsplit(self.path)
-                        # Unquote AFTER splitting: a %2F inside an object
-                        # name must not become a path separator.
-                        parts = [
-                            urllib.parse.unquote(p)
-                            for p in parsed.path.split("/")
-                            if p
-                        ]
-                        q = dict(urllib.parse.parse_qsl(parsed.query))
-                        # Inserted by _dispatch only AFTER auth passes —
-                        # unauthenticated traffic must not evict hot routes
-                        # or pin attacker-chosen keys.
-                        outer._dispatch(self, method, parts, q, memo_key=self.path)
-                    else:
-                        parts, q = cached
-                        outer._dispatch(self, method, parts, q)
-                except NotFoundError as e:
-                    self._send(404, {"error": "NotFound", "message": str(e)})
-                except ConflictError as e:
-                    self._send(409, {"error": "Conflict", "message": str(e)})
-                except AlreadyExistsError as e:
-                    self._send(409, {"error": "AlreadyExists", "message": str(e)})
-                except ValueError as e:
-                    self._send(422, {"error": "Invalid", "message": str(e)})
-                except BrokenPipeError:
-                    pass
-                except Exception as e:  # noqa: BLE001 — wire boundary
-                    log.exception("httpapi handler error")
-                    self._send(500, {"error": "Internal", "message": str(e)})
-
-            def do_GET(self):
-                self._route("GET")
-
-            def do_POST(self):
-                self._route("POST")
-
-            def do_PUT(self):
-                self._route("PUT")
-
-            def do_DELETE(self):
-                self._route("DELETE")
-
-        class _Server(ThreadingHTTPServer):
-            # Default listen backlog (5) is too small for several clients
-            # opening a fresh connection per request. Subclass, not a class-
-            # attribute mutation on the stdlib type, so unrelated servers in
-            # this process keep their own backlog.
-            request_queue_size = 64
-            daemon_threads = True
-
-            def handle_error(self, request, client_address):
-                # TLS handshake failures (plain-HTTP probe against the HTTPS
-                # port, cert rejected by a mis-pinned client) arrive here per
-                # connection; stdlib prints a full traceback to stderr.
-                log.debug("connection error from %s", client_address, exc_info=True)
-
-        self._httpd = _Server((bind, port), Handler)
-        self._ssl_context = None
-        scheme = "http"
-        if tls is not None:
-            from training_operator_tpu.cluster import certs as _certs
-
-            self._ssl_context = _certs.server_context(*tls)
-            # Handshake deferred to the handler thread (first read), so a
-            # slow client's handshake can't stall the accept loop.
-            self._httpd.socket = self._ssl_context.wrap_socket(
-                self._httpd.socket, server_side=True,
-                do_handshake_on_connect=False,
-            )
-            scheme = "https"
-        self.port = self._httpd.server_address[1]
-        self.url = f"{scheme}://{bind}:{self.port}"
-        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
-        self._thread.start()
-        # Background session GC: route-handler GC alone never runs once the
-        # last watch client dies (kill -9 both operators), and the dead
-        # sessions' queues would then accumulate every write's event until
-        # OOM. A daemon timer sweeps regardless of request traffic.
-        self._gc_stop = threading.Event()
-
-        def _gc_loop():
-            while not self._gc_stop.wait(min(30.0, max(1.0, session_ttl / 4))):
-                self._gc_sessions()
-
-        self._gc_thread = threading.Thread(target=_gc_loop, daemon=True)
-        self._gc_thread.start()
-
-    def close(self) -> None:
-        self._gc_stop.set()
-        self._httpd.shutdown()
-        self._httpd.server_close()
-
-    def rotate_cert(self, cert_path: str, key_path: str) -> None:
-        """Hot-rotate the serving cert: reload into the LIVE ssl context so
-        new handshakes present the fresh cert while established connections
-        finish on the old one. Clients pin the CA, not the serving cert, so
-        rotation is invisible to them — the reference's rotated webhook
-        serving certs behave the same way (pkg/cert/cert.go:45)."""
-        if self._ssl_context is None:
-            raise RuntimeError("server is not serving TLS")
-        self._ssl_context.load_cert_chain(cert_path, key_path)
-        log.info("rotated serving certificate from %s", cert_path)
-
-    # -- dispatch ----------------------------------------------------------
-
-    def _dispatch(
-        self,
-        h,
-        method: str,
-        parts: List[str],
-        q: Dict[str, str],
-        memo_key: Optional[str] = None,
-    ) -> None:
-        if not parts:
-            h._send(404, {"error": "NotFound", "message": "no route"})
-            return
-        head = parts[0]
-        if head in ("healthz", "readyz"):
-            h._send(200, {"ok": True})
-            return
-        if head == "time":
-            # Open like the probes: clock sync must work before a client
-            # has its token plumbed, and the value is not sensitive.
-            h._send(200, {"now": self.now_fn()})
-            return
-        if self.chaos is not None:
-            action = self.chaos.sample()
-            if action == "error":
-                h._send(500, {"error": "Internal", "message": "chaos: injected"})
-                return
-            if action == "reset":
-                # No response at all — the client sees a connection reset
-                # (transport failure, not an API status).
-                import socket as _socket
-
-                try:
-                    h.connection.shutdown(_socket.SHUT_RDWR)
-                except OSError:
-                    pass
-                h.close_connection = True
-                return
-            if action == "reap":
-                # Session loss (failover / memory pressure): every watch
-                # client must resubscribe and heal by resync. The request
-                # itself is then served normally.
-                self._reap_all_sessions()
-        if self.token is not None:
-            import hmac
-
-            supplied = h.headers.get("Authorization", "")
-            if not hmac.compare_digest(
-                supplied.encode(), f"Bearer {self.token}".encode()
-            ):
-                h._send(401, {"error": "Unauthorized", "message": "bad or missing bearer token"})
-                return
-        if memo_key is not None and len(memo_key) <= 512:
-            # Authenticated (or open-deployment) request on a fresh path:
-            # memoize the parse. Bounded; clear-all on overflow is fine —
-            # the hot keys (watch polls, burst LISTs) repopulate instantly.
-            if len(self._route_cache) >= 4096:
-                self._route_cache.clear()
-            self._route_cache[memo_key] = (parts, q)
-        if head == "objects":
-            self._objects(h, method, parts[1:], q)
-        elif head == "watches":
-            self._watches(h, method, parts[1:], q)
-        elif head == "logs":
-            self._logs(h, method, parts[1:], q)
-        elif head == "events":
-            self._events(h, method, q)
-        elif head == "metrics":
-            # JSON snapshot of the serving process's metrics registry —
-            # how a remote bench/test reads the wire-cache hit rates
-            # (codec/body/event counters) instead of trusting a self-run.
-            h._send(200, metrics.registry.snapshot())
-        elif head == "version" and len(parts) == 4:
-            rv = self.api.resource_version(parts[1], _seg_ns(parts[2]), parts[3])
-            h._send(200, {"resourceVersion": rv})
-        else:
-            h._send(404, {"error": "NotFound", "message": f"no route {head}"})
-
-    def _object_bytes(self, obj) -> bytes:
-        """Encoded JSON bytes for one STORED object reference, via the
-        version-keyed cache. The ref is a frozen version (updates replace,
-        never mutate), so encoding outside any lock is safe and the cached
-        bytes are valid for that (name, resourceVersion) forever."""
-        md = obj.metadata
-        key = (
-            obj.KIND,
-            getattr(md, "namespace", "") or "",
-            md.name,
-            md.resource_version,
-        )
-        with self._body_lock:
-            body = self._body_cache.get(key)
-            if body is not None:
-                self._body_cache.move_to_end(key)
-        if body is not None:
-            metrics.wire_body_cache_hits.inc()
-            return body
-        body = json.dumps(wire.encode(obj), separators=(",", ":")).encode()
-        metrics.wire_body_cache_misses.inc()
-        with self._body_lock:
-            self._body_cache[key] = body
-            while len(self._body_cache) > self._body_cache_max:
-                self._body_cache.popitem(last=False)
-        return body
-
-    def _objects(self, h, method: str, parts: List[str], q: Dict[str, str]) -> None:
-        if method == "POST" and not parts:
-            obj = wire.decode(h._body())
-            created = self.api.create(obj)
-            # Respond through the body cache: `created` carries the assigned
-            # uid/resourceVersion and is content-identical to the stored
-            # clone, so this both serves the response and SEEDS the cache —
-            # the operator's next LIST of this version is a hit.
-            h._send_bytes(201, self._object_bytes(created))
-        elif method == "GET" and len(parts) == 1:
-            selector = None
-            if q.get("labelSelector"):
-                selector = dict(
-                    pair.split("=", 1) for pair in q["labelSelector"].split(",") if "=" in pair
-                )
-            refs = self.api.list_refs(parts[0], q.get("namespace") or None, selector)
-            # Byte concatenation, not re-encoding: each element's bytes come
-            # from the version-keyed cache, so a burst of identical LISTs
-            # costs one serialization per changed object, total.
-            h._send_bytes(
-                200,
-                b'{"items":[' + b",".join(self._object_bytes(o) for o in refs) + b"]}",
-            )
-        elif method == "GET" and len(parts) == 3:
-            h._send_bytes(
-                200,
-                self._object_bytes(self.api.get_ref(parts[0], _seg_ns(parts[1]), parts[2])),
-            )
-        elif method == "PUT" and len(parts) == 3:
-            obj = wire.decode(h._body())
-            updated = self.api.update(
-                obj,
-                check_version=q.get("check_version", "1") != "0",
-                status_only=q.get("status_only") == "1",
-            )
-            # Seeds the cache with the fresh version (see POST above).
-            h._send_bytes(200, self._object_bytes(updated))
-        elif method == "DELETE" and len(parts) == 3:
-            gone = self.api.delete(parts[0], _seg_ns(parts[1]), parts[2])
-            # The deleted object's final version is usually already cached.
-            h._send_bytes(200, self._object_bytes(gone))
-        else:
-            h._send(404, {"error": "NotFound", "message": "bad objects route"})
-
-    def _watches(self, h, method: str, parts: List[str], q: Dict[str, str]) -> None:
-        self._gc_sessions()
-        if method == "POST" and not parts:
-            body = h._body()
-            kinds = body.get("kinds")
-            wq = self.api.watch(kinds=kinds)
-            wid = uuid.uuid4().hex
-            with self._sessions_lock:
-                self._sessions[wid] = [wq, _time.monotonic()]
-            h._send(201, {"watch_id": wid})
-        elif method == "GET" and len(parts) == 1:
-            with self._sessions_lock:
-                session = self._sessions.get(parts[0])
-                if session is not None:
-                    session[1] = _time.monotonic()
-            if session is None:
-                raise NotFoundError(f"watch session {parts[0]}")
-            wq = session[0]
-            # Clamp the client-supplied long-poll timeout well under the
-            # session TTL: a poll allowed to outlive the TTL could have its
-            # session GC'd mid-wait, dropping the buffered events it was
-            # about to receive and forcing a needless resubscribe+resync.
-            timeout = min(float(q.get("timeout", "0")), self.session_ttl / 4)
-            # Park on the store's condition variable — zero CPU while idle,
-            # wakes on the next write, drain atomic w.r.t. pushes.
-            events = self.api.wait_and_drain(wq, timeout=timeout)
-            with self._sessions_lock:
-                session = self._sessions.get(parts[0])
-                if session is not None:
-                    session[1] = _time.monotonic()  # poll completion counts as activity
-            # Serialize-once fanout: each event's bytes are encoded exactly
-            # once (cached on the shared event object) and reused by every
-            # session's drain — N subscribers no longer cost N encodes.
-            h._send_bytes(
-                200,
-                b'{"events":['
-                + b",".join(wire.encode_watch_event_bytes(ev) for ev in events)
-                + b"]}",
-            )
-        elif method == "DELETE" and len(parts) == 1:
-            with self._sessions_lock:
-                session = self._sessions.pop(parts[0], None)
-            if session is not None:
-                self.api.unwatch(session[0])
-            h._send(200, {"ok": True})
-        else:
-            h._send(404, {"error": "NotFound", "message": "bad watches route"})
-
-    def _reap_all_sessions(self) -> None:
-        with self._sessions_lock:
-            dead = list(self._sessions.values())
-            self._sessions.clear()
-        for wq, _ in dead:
-            self.api.unwatch(wq)
-
-    def _gc_sessions(self) -> None:
-        now = _time.monotonic()
-        dead: List[Tuple[str, WatchQueue]] = []
-        with self._sessions_lock:
-            for wid, (wq, last) in list(self._sessions.items()):
-                if now - last > self.session_ttl:
-                    dead.append((wid, wq))
-                    del self._sessions[wid]
-        for _, wq in dead:
-            self.api.unwatch(wq)
-
-    def _logs(self, h, method: str, parts: List[str], q: Dict[str, str]) -> None:
-        if len(parts) != 2:
-            raise NotFoundError("logs route is /logs/<ns>/<pod>")
-        ns, name = _seg_ns(parts[0]), parts[1]
-        if method == "GET":
-            tail = int(q["tail"]) if q.get("tail") else None
-            lines, cursor = self.api.read_pod_log(
-                ns, name, since=int(q.get("since", "0")), tail=tail
-            )
-            h._send(200, {"lines": lines, "cursor": cursor})
-        elif method == "POST":
-            body = h._body()
-            self.api.append_pod_log(ns, name, body.get("line", ""), body.get("ts", 0.0))
-            h._send(200, {"ok": True})
-        else:
-            raise NotFoundError("bad logs method")
-
-    def _events(self, h, method: str, q: Dict[str, str]) -> None:
-        if method == "POST":
-            ev = wire.decode(h._body(), Event)
-            self.api.record_event(ev)
-            h._send(201, {"ok": True})
-        else:
-            evs = self.api.events(q.get("object_name") or None, q.get("reason") or None)
-            h._send(200, {"items": [wire.encode(e) for e in evs]})
-
-
-# ---------------------------------------------------------------------------
-# Client
-# ---------------------------------------------------------------------------
-
-
-# Sentinel delivered (only to opt-in subscribers) at the head of a relist:
-# "everything after this is the FULL current state — drop what you had".
-# Without it, a mirror fed by Added/Modified/Deleted events can never learn
-# about objects deleted while the watch session was lost: the relist only
-# re-announces survivors, so ghosts would live in the cache forever.
-RELIST_RESET = object()
-
-# Sentinel left as the sole content of a fanout queue whose consumer stopped
-# draining and let it hit its overflow limit: "your event history is gone —
-# rebuild from authoritative lists". Only mirror-building consumers opt into
-# bounded queues; for them a lost history is recoverable (re-prime), whereas
-# silently dropping individual events would leave permanent ghosts.
-QUEUE_OVERFLOW = object()
-
-
-class RemoteWatchQueue:
-    """Fanout handle on the client's ONE shared wire watch session.
-
-    Early rounds gave every consumer its own server-side session; with
-    several consumers per process (v1 manager + v2 manager), every idle
-    tick serialized multiple empty long-polls — over a second of pure
-    blocking per tick, a 12x submit->Running overhead on the wire vs
-    in-process. This is the informer fix: one wire session per
-    RemoteAPIServer (see _SharedWatch), events fanned out client-side by
-    kind filter, and at most ONE blocking long-poll per block interval
-    across all consumers. Matches the reference, where any number of
-    controllers share one informer's watch connection per resource.
-
-    `drain()` semantics are unchanged for consumers: returns pending
-    events, long-polling briefly when idle; after a server-side session
-    loss it transparently resubscribes and RELISTS (ListAndWatch), so
-    lost events can delay work but never wedge it.
-    """
-
-    def __init__(self, shared: "_SharedWatch", kinds: Optional[List[str]] = None):
-        from collections import deque
-
-        self._shared = shared
-        self.kinds = set(kinds) if kinds else None
-        # Opt-in: receive RELIST_RESET at the head of a post-reconnect
-        # relist. Mirror-building consumers (CachedReadAPI) need it;
-        # event-driven consumers (the managers, whose periodic resync
-        # re-enqueues work from authoritative lists) do not, and must not
-        # have to know about the sentinel.
-        self.reset_on_relist = False
-        # Bound for consumers that may legitimately stop draining for long
-        # stretches (a STANDBY operator never lists, so its lister cache
-        # never drains — without a bound every cluster event would
-        # accumulate in this deque for the whole standby lifetime). 0 = no
-        # bound (tick-driven consumers drain every tick by construction).
-        # On overflow the queue is collapsed to QUEUE_OVERFLOW.
-        self.overflow_limit = 0
-        self._local: "deque" = deque()
-
-    def _append(self, item: Any) -> None:
-        if self.overflow_limit and len(self._local) >= self.overflow_limit:
-            if self._local and self._local[-1] is QUEUE_OVERFLOW:
-                return
-            self._local.clear()
-            self._local.append(QUEUE_OVERFLOW)
-            return
-        self._local.append(item)
-
-    @property
-    def watch_id(self) -> Optional[str]:
-        return self._shared.watch_id
-
-    def drain(self, timeout: Optional[float] = None) -> List[Any]:
-        return self._shared.drain_for(self, timeout)
-
-    def poll_local(self) -> List[Any]:
-        """Drain ONLY events already distributed to this queue — never hits
-        the wire. For piggyback consumers (the lister cache) that ride the
-        pumping some other consumer (the manager tick) is already doing."""
-        with self._shared._lock:
-            out = list(self._local)
-            self._local.clear()
-            return out
-
-    def __len__(self) -> int:
-        return len(self._local)
-
-
-class _SharedWatch:
-    """The one wire watch session a RemoteAPIServer multiplexes.
-
-    The server session subscribes to ALL kinds (client-side filters do the
-    narrowing): per-subscriber server sessions would resurrect the
-    serialized-empty-poll problem this class exists to kill, and the
-    operator-side consumers want all kinds anyway.
-
-    Blocking policy: a drain may long-poll the wire only if no blocking
-    poll happened within `min_block_interval` (one tick); otherwise an
-    empty local queue returns [] immediately. Net effect: an idle process
-    holds ONE cheap long-poll open per window (the server parks it on the
-    store's condition variable — zero CPU both sides), and event delivery
-    latency stays ~one RTT because the parked poll wakes on the write.
-    """
-
-    def __init__(
-        self,
-        remote: "RemoteAPIServer",
-        poll_timeout: float = 0.25,
-        min_block_interval: float = 0.02,
-    ):
-        self._remote = remote
-        self.poll_timeout = poll_timeout
-        self.min_block_interval = min_block_interval
-        self.watch_id: Optional[str] = None
-        self._subs: List[RemoteWatchQueue] = []
-        self._needs_relist = False
-        self._last_block = -float("inf")
-        self._lock = threading.RLock()
-
-    # -- subscriber management --------------------------------------------
-
-    def subscribe(self, kinds: Optional[List[str]]) -> RemoteWatchQueue:
-        with self._lock:
-            q = RemoteWatchQueue(self, kinds)
-            self._subs.append(q)
-            if self.watch_id is None:
-                self._open()
-            return q
-
-    def unsubscribe(self, q: RemoteWatchQueue) -> None:
-        with self._lock:
-            if q in self._subs:
-                self._subs.remove(q)
-            if not self._subs and self.watch_id is not None:
-                wid, self.watch_id = self.watch_id, None
-                try:
-                    self._remote._request("DELETE", f"/watches/{wid}")
-                except (NotFoundError, ApiUnavailableError, ApiServerError,
-                        PermissionError):
-                    pass  # server GC reaps stale sessions anyway
-
-    def _open(self) -> None:
-        payload = self._remote._request("POST", "/watches", body={"kinds": None})
-        self.watch_id = payload["watch_id"]
-
-    # -- pumping ----------------------------------------------------------
-
-    def drain_for(self, q: RemoteWatchQueue, timeout: Optional[float]) -> List[Any]:
-        with self._lock:
-            if q not in self._subs:
-                # Drained after unwatch (or a fresh consumer of a dead
-                # handle): rejoin, and heal the unobserved gap by relist.
-                self._subs.append(q)
-                self._needs_relist = True
-            if not q._local:
-                # Contract: an EXPLICIT timeout is an explicit fetch — it
-                # always hits the wire. A bare drain() (the tick-loop form)
-                # is subject to the block window: if some consumer blocked
-                # within the last interval, pending events were already
-                # distributed and the next tick's pump is <=interval away.
-                if self._needs_relist:
-                    self._pump(0.0)
-                elif timeout is not None:
-                    self._pump(timeout)
-                elif (
-                    _time.monotonic() - self._last_block
-                    >= self.min_block_interval
-                ):
-                    self._pump(self.poll_timeout)
-            out = list(q._local)
-            q._local.clear()
-            return out
-
-    def _pump(self, t: float) -> None:
-        if self.watch_id is None:
-            self._open()
-            self._needs_relist = True
-        if self._needs_relist:
-            self._relist()
-            return
-        if t > 0:
-            # Count the attempt, success or not: a 5xx storm must not turn
-            # every consumer's drain back into a serial blocking poll.
-            self._last_block = _time.monotonic()
-        try:
-            payload = self._remote._request(
-                "GET", f"/watches/{self.watch_id}", query={"timeout": str(t)},
-                channel="watch", idempotent=False,
-            )
-        except ApiUnavailableError:
-            # The drain died mid-flight on a transport failure. The server
-            # may already have emptied the queue into the lost response —
-            # those events are unrecoverable via the session, so the ONLY
-            # safe recovery is a relist (marked now, run on the next drain).
-            # A transparent GET retry here (the pre-fix behavior) would
-            # return an empty drain and silently drop them instead.
-            self._needs_relist = True
-            raise
-        except NotFoundError:
-            # Session reaped server-side (idle past session_ttl, host
-            # restart, injected chaos). Re-subscribe, then RELIST and
-            # synthesize Added events for everything that exists — the
-            # informer ListAndWatch contract on reconnect. Without the
-            # relist, events lost in the gap (above all pod create-echoes)
-            # would wedge the engine's expectations cache until its 5-min
-            # TTL: a job-key resync re-ENQUEUES work but cannot OBSERVE
-            # the pods the lost events carried.
-            self._needs_relist = True
-            self._open()
-            self._relist()
-            return
-        for d in payload["events"]:
-            self._distribute(wire.decode_watch_event(d))
-
-    def _relist(self) -> List[Any]:
-        """Synthesize Added events for the full current state. Watch is
-        (re)opened BEFORE the lists, so an object written in between can be
-        seen twice (consumers are idempotent; expectations tolerate
-        over-observation) but never lost. Only a FULLY successful relist
-        clears the flag — a 5xx mid-relist retries on the next drain."""
-        from training_operator_tpu.cluster.apiserver import WatchEvent
-
-        events = []
-        for kind in wire.KIND_REGISTRY:
-            for obj in self._remote.list(kind):
-                events.append(WatchEvent("Added", kind, obj))
-        self._needs_relist = False  # only cleared on a FULLY successful relist
-        # Opt-in subscribers (mirror builders) get the reset marker FIRST:
-        # what follows is the complete state, and anything they hold that
-        # is absent from it was deleted while the session was down — its
-        # Deleted event is gone forever.
-        for q in self._subs:
-            if q.reset_on_relist:
-                q._append(RELIST_RESET)
-        for ev in events:
-            self._distribute(ev)
-        return events
-
-    def _distribute(self, ev: Any) -> None:
-        # One shared decoded copy per event, same as the in-process
-        # informer contract (apiserver.py module docstring).
-        for q in self._subs:
-            if q.kinds is None or ev.kind in q.kinds:
-                q._append(ev)
-
-
-class RemoteAPIServer:
-    """APIServer duck-type speaking the wire protocol.
-
-    Admission (`register_admission`) is a no-op here: validation and
-    defaulting are enforced inside the serving process, exactly as k8s
-    admission runs server-side no matter which client connects.
-    """
-
-    def __init__(
-        self,
-        base_url: str,
-        timeout: float = 30.0,
-        token: Optional[str] = None,
-        ca_file: Optional[str] = None,
-    ):
-        """`ca_file`: PEM CA bundle to verify an https host against (the
-        pin on the host-minted CA, certs.mint_ca). Without it an https URL
-        is verified against the system trust store — which will reject a
-        self-signed host CA, loudly, rather than silently not verifying."""
-        self.base_url = base_url.rstrip("/")
-        self.timeout = timeout
-        self.token = token
-        self.ca_file = ca_file
-        self._shared_watch: Optional[_SharedWatch] = None
-        self._local = threading.local()
-        self._ssl_context = None
-        # Request-path trims: the URL is parsed once and the header dict is
-        # built once — a reconcile makes ~8 wire calls and a 1k-job burst
-        # makes tens of thousands, so per-request urlsplit + dict rebuilds
-        # are measurable. http.client copies headers into its send buffer
-        # and never mutates the dict, so sharing one instance is safe.
-        parsed = urllib.parse.urlsplit(self.base_url)
-        self._host = parsed.hostname
-        self._port = parsed.port
-        self._scheme = parsed.scheme
-        self._headers: Dict[str, str] = {"Content-Type": "application/json"}
-        if token is not None:
-            self._headers["Authorization"] = f"Bearer {token}"
-        if self._scheme == "https":
-            from training_operator_tpu.cluster import certs as _certs
-
-            self._ssl_context = (
-                _certs.client_context(ca_file) if ca_file
-                else _ssl.create_default_context()
-            )
-
-    # -- transport ---------------------------------------------------------
-
-    def _conn(self, channel: str = "main"):
-        """Thread-local persistent connection (HTTP/1.1 keep-alive), one per
-        (thread, channel).
-
-        urllib opens a fresh TCP (+TLS handshake) connection per request; a
-        reconcile makes ~8 wire calls and a 50-job burst makes hundreds —
-        per-request handshakes alone put the wire deployment several times
-        over the in-process control-plane latency. One keep-alive connection
-        per thread brings a call back to ~one round trip, which is the
-        wire_overhead bench's whole budget.
-
-        `channel` exists because requests on one connection are strictly
-        sequential: the watch long-poll BLOCKS its connection for up to the
-        poll timeout, and CRUD calls queued behind it would eat that wait on
-        every reconcile. Watch traffic therefore rides its own connection,
-        and connections stay warm for the client's lifetime — they are only
-        dropped on a transport error (and then rebuilt on the next call).
-        """
-        conn = getattr(self._local, "conn_" + channel, None)
-        if conn is None:
-            if self._scheme == "https":
-                conn = http.client.HTTPSConnection(
-                    self._host, self._port, timeout=self.timeout,
-                    context=self._ssl_context,
-                )
-            else:
-                conn = http.client.HTTPConnection(
-                    self._host, self._port, timeout=self.timeout
-                )
-            conn.connect()
-            # Same delayed-ACK tax in the other direction: the request line/
-            # headers and the JSON body are separate send()s too.
-            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            setattr(self._local, "conn_" + channel, conn)
-        return conn
-
-    def _drop_conn(self, channel: str = "main") -> None:
-        conn = getattr(self._local, "conn_" + channel, None)
-        if conn is not None:
-            try:
-                conn.close()
-            except OSError:
-                pass
-            setattr(self._local, "conn_" + channel, None)
-
-    def _request(
-        self,
-        method: str,
-        path: str,
-        body: Optional[Dict[str, Any]] = None,
-        query: Optional[Dict[str, str]] = None,
-        channel: str = "main",
-        idempotent: bool = True,
-    ) -> Any:
-        """`idempotent=False` marks a request whose GET is NOT safe to
-        replay transparently — the watch-session drain, a DESTRUCTIVE read:
-        the server empties the queue when it serves the response, so if the
-        response is lost on a stale keep-alive connection, a silent retry
-        returns a fresh (empty) drain and the lost events are gone forever.
-        Such calls surface ApiUnavailableError instead and the caller heals
-        by relist."""
-        target = path
-        if query:
-            target += "?" + urllib.parse.urlencode(query)
-        data = json.dumps(body).encode() if body is not None else None
-        headers = self._headers
-
-        for attempt in (0, 1):
-            try:
-                # Inside the try: _conn() performs the TCP connect AND the
-                # TLS handshake, where cert verification failures surface.
-                conn = self._conn(channel)
-                conn.request(method, target, body=data, headers=headers)
-                resp = conn.getresponse()
-                raw = resp.read()
-                status = resp.status
-                break
-            except (http.client.HTTPException, socket.timeout, OSError) as e:
-                self._drop_conn(channel)
-                if isinstance(e, _ssl.SSLCertVerificationError):
-                    # A server cert the pinned CA didn't sign is a
-                    # configuration (or impersonation) problem — retrying
-                    # forever in the operator loop would just mask it.
-                    raise PermissionError(
-                        f"{method} {path}: TLS verification failed: {e}"
-                    ) from None
-                if attempt == 0 and method == "GET" and idempotent and isinstance(
-                    e,
-                    (
-                        http.client.RemoteDisconnected,
-                        http.client.BadStatusLine,
-                        ConnectionResetError,
-                        BrokenPipeError,
-                    ),
-                ):
-                    # A stale keep-alive connection the server closed while
-                    # we were idle dies exactly this way on the next use;
-                    # one transparent retry on a FRESH connection is standard
-                    # (urllib3 does the same) — but only for an IDEMPOTENT
-                    # GET: replaying a POST whose response was lost could
-                    # double-apply a create/log-append server-side, and
-                    # replaying a watch drain (a destructive read) would
-                    # silently drop the events the lost response carried.
-                    # Non-idempotent calls surface ApiUnavailableError and
-                    # the caller's retry arm (reconcile requeue, watch
-                    # relist) absorbs it.
-                    continue
-                raise ApiUnavailableError(f"{method} {path}: {e}") from None
-
-        if status < 400:
-            return json.loads(raw or b"{}")
-        try:
-            payload = json.loads(raw or b"{}")
-        except ValueError:
-            payload = {}
-        kind = payload.get("error", "")
-        msg = payload.get("message", f"HTTP {status}")
-        if status == 404:
-            raise NotFoundError(msg)
-        if status == 409 and kind == "AlreadyExists":
-            raise AlreadyExistsError(msg)
-        if status == 409:
-            raise ConflictError(msg)
-        if status == 422:
-            raise ValueError(msg)
-        if status == 401:
-            # Auth failures are config errors, not transients — the
-            # operator loop must NOT retry these silently forever.
-            raise PermissionError(msg)
-        raise ApiServerError(f"{method} {path}: {status} {msg}")
-
-    # -- CRUD --------------------------------------------------------------
-
-    def create(self, obj: Any) -> Any:
-        out = wire.decode(self._request("POST", "/objects", body=wire.encode(obj)))
-        # The caller's object carries the assigned uid/resourceVersion after
-        # create (in-process contract), but the RETURNED object is the
-        # server's stored state — including server-side admission mutations
-        # (defaulting) the local copy never saw.
-        obj.metadata.uid = out.metadata.uid
-        obj.metadata.resource_version = out.metadata.resource_version
-        return out
-
-    def get(self, kind: str, namespace: str, name: str) -> Any:
-        return wire.decode(
-            self._request("GET", f"/objects/{_quote_seg(kind)}/{_ns_seg(namespace)}/{_quote_seg(name)}")
-        )
-
-    def try_get(self, kind: str, namespace: str, name: str) -> Optional[Any]:
-        try:
-            return self.get(kind, namespace, name)
-        except NotFoundError:
-            return None
-
-    def list(
-        self,
-        kind: str,
-        namespace: Optional[str] = None,
-        label_selector: Optional[Dict[str, str]] = None,
-    ) -> List[Any]:
-        query: Dict[str, str] = {}
-        if namespace is not None:
-            query["namespace"] = namespace
-        if label_selector:
-            query["labelSelector"] = ",".join(f"{k}={v}" for k, v in label_selector.items())
-        payload = self._request("GET", f"/objects/{_quote_seg(kind)}", query=query or None)
-        return [wire.decode(d) for d in payload["items"]]
-
-    def update(self, obj: Any, check_version: bool = True, status_only: bool = False) -> Any:
-        ns = getattr(obj.metadata, "namespace", "") or ""
-        out = wire.decode(
-            self._request(
-                "PUT",
-                f"/objects/{_quote_seg(obj.KIND)}/{_ns_seg(ns)}/{_quote_seg(obj.metadata.name)}",
-                body=wire.encode(obj),
-                query={
-                    "check_version": "1" if check_version else "0",
-                    "status_only": "1" if status_only else "0",
-                },
-            )
-        )
-        obj.metadata.resource_version = out.metadata.resource_version
-        return out
-
-    def delete(self, kind: str, namespace: str, name: str) -> Any:
-        return wire.decode(
-            self._request("DELETE", f"/objects/{_quote_seg(kind)}/{_ns_seg(namespace)}/{_quote_seg(name)}")
-        )
-
-    def try_delete(self, kind: str, namespace: str, name: str) -> Optional[Any]:
-        try:
-            return self.delete(kind, namespace, name)
-        except NotFoundError:
-            return None
-
-    def resource_version(self, kind: str, namespace: str, name: str) -> Optional[int]:
-        return self._request("GET", f"/version/{_quote_seg(kind)}/{_ns_seg(namespace)}/{_quote_seg(name)}")[
-            "resourceVersion"
-        ]
-
-    def server_time(self) -> float:
-        """The serving host's cluster-clock reading (GET /time)."""
-        return float(self._request("GET", "/time")["now"])
-
-    def metrics_snapshot(self) -> Dict[str, float]:
-        """The SERVING process's metrics registry as a flat JSON dict
-        (GET /metrics) — how benchmarks and tests verify the wire-cache
-        hit-rate claims against the host instead of a self-run."""
-        return self._request("GET", "/metrics")
-
-    # -- watch -------------------------------------------------------------
-
-    def watch(self, kinds: Optional[List[str]] = None) -> RemoteWatchQueue:
-        if self._shared_watch is None:
-            self._shared_watch = _SharedWatch(self)
-        return self._shared_watch.subscribe(list(kinds) if kinds else None)
-
-    def unwatch(self, queue: RemoteWatchQueue) -> None:
-        if self._shared_watch is not None:
-            self._shared_watch.unsubscribe(queue)
-
-    # -- admission ---------------------------------------------------------
-
-    def register_admission(self, kind: str, fn: Callable[[Any], None]) -> None:
-        pass  # server-side concern (see class docstring)
-
-    def unregister_admission(self, kind: str, fn: Callable[[Any], None]) -> None:
-        pass
-
-    # -- logs / events -----------------------------------------------------
-
-    def append_pod_log(self, namespace: str, name: str, line: str, ts: float = 0.0) -> None:
-        self._request(
-            "POST", f"/logs/{_ns_seg(namespace)}/{_quote_seg(name)}", body={"line": line, "ts": ts}
-        )
-
-    def read_pod_log(
-        self, namespace: str, name: str, since: int = 0, tail: Optional[int] = None
-    ) -> Tuple[List[str], int]:
-        query = {"since": str(since)}
-        if tail is not None:
-            query["tail"] = str(tail)
-        payload = self._request("GET", f"/logs/{_ns_seg(namespace)}/{_quote_seg(name)}", query=query)
-        return payload["lines"], payload["cursor"]
-
-    def record_event(self, event: Event) -> None:
-        self._request("POST", "/events", body=wire.encode(event))
-
-    def events(
-        self, object_name: Optional[str] = None, reason: Optional[str] = None
-    ) -> List[Event]:
-        query: Dict[str, str] = {}
-        if object_name:
-            query["object_name"] = object_name
-        if reason:
-            query["reason"] = reason
-        payload = self._request("GET", "/events", query=query or None)
-        return [wire.decode(d, Event) for d in payload["items"]]
-
-
-class CachedReadAPI:
-    """RemoteAPIServer proxy serving LIST from a watch-fed mirror.
-
-    The reference's controllers never list from the apiserver on the hot
-    path — they read the shared informer's cache and only WRITE direct
-    (client-go listers). Without this, every reconcile pays 2+ wire RTTs
-    for pod/service lists, and a 200-job burst's operator loop spends most
-    of its wall time in serialized round trips (the wire_overhead bench
-    measured ~3x the in-process p50; with cached lists it is the write
-    traffic that remains).
-
-    Correctness rests on two invariants:
-
-    1. The mirror rides the SAME shared wire session as the manager's event
-       queue, and events are distributed to all fanout queues atomically
-       under the shared lock. The manager observes a pod create-echo (and
-       satisfies expectations) strictly no earlier than the mirror learns
-       the same pod — so an expectations-gated reconcile can never see a
-       cached list that is behind its own expectation state.
-    2. Only list() is cached. get/try_get stay direct: the optimistic-
-       concurrency write path (read fresh, mutate, update, retry on
-       conflict) must see the CURRENT resourceVersion, or a conflict retry
-       loop could spin against its own stale cache.
-
-    Reads return deep copies (the APIServer copy-on-read contract);
-    everything else delegates. Use from the single-threaded operator loop
-    whose manager tick pumps the shared session; a client with no pumping
-    consumer would read an ever-staler mirror.
-    """
-
-    def __init__(self, remote: RemoteAPIServer):
-        import copy as _copylib
-
-        self._remote = remote
-        self._copy = _copylib.deepcopy
-        self._mirror: Dict[str, Dict[Tuple[str, str], Any]] = {}
-        self._primed: set = set()
-        self._q = remote.watch()  # all kinds
-        self._q.reset_on_relist = True
-        self._q.overflow_limit = 8192  # standby-safe: see RemoteWatchQueue
-        # Parallel reconcile workers (OperatorManager parallel_reconciles)
-        # list concurrently; mirror mutation must be atomic.
-        self._cache_lock = threading.Lock()
-
-    # -- cached reads ------------------------------------------------------
-
-    def _sync_locked(self) -> None:
-        for ev in self._q.poll_local():
-            if ev is RELIST_RESET:
-                # Post-reconnect relist: the events that follow are the
-                # COMPLETE state. Dropping the mirror here is what expires
-                # objects deleted while the session was down — their
-                # Deleted events are gone and will never arrive. Every
-                # registry kind is re-listed, so mark them all primed (a
-                # kind with zero objects is correctly represented by an
-                # empty bucket, not by a re-prime).
-                self._mirror.clear()
-                self._primed = set(wire.KIND_REGISTRY)
-                continue
-            if ev is QUEUE_OVERFLOW:
-                # The queue overflowed while nobody was listing (a standby
-                # term): the event history is gone, so the mirror cannot be
-                # patched — rebuild lazily from authoritative lists.
-                self._mirror.clear()
-                self._primed.clear()
-                continue
-            ns = getattr(ev.obj.metadata, "namespace", "") or ""
-            key = (ns, ev.obj.metadata.name)
-            if ev.type == "Deleted":
-                self._mirror.get(ev.kind, {}).pop(key, None)
-            else:
-                self._mirror.setdefault(ev.kind, {})[key] = ev.obj
-
-    def _prime_locked(self, kind: str) -> None:
-        """Initial LIST for a kind (the informer's ListAndWatch seed). The
-        watch was opened before priming, so an object created in between
-        appears in both — upsert order makes that harmless."""
-        bucket = self._mirror.setdefault(kind, {})
-        for obj in self._remote.list(kind):
-            ns = getattr(obj.metadata, "namespace", "") or ""
-            bucket[(ns, obj.metadata.name)] = obj
-        self._primed.add(kind)
-
-    def list(
-        self,
-        kind: str,
-        namespace: Optional[str] = None,
-        label_selector: Optional[Dict[str, str]] = None,
-    ) -> List[Any]:
-        with self._cache_lock:
-            self._sync_locked()
-            if kind not in self._primed:
-                self._prime_locked(kind)
-            out = []
-            for (ns, _), obj in self._mirror.get(kind, {}).items():
-                if namespace is not None and ns != namespace:
-                    continue
-                if label_selector:
-                    labels = obj.metadata.labels
-                    if not all(
-                        labels.get(k) == v for k, v in label_selector.items()
-                    ):
-                        continue
-                out.append(self._copy(obj))
-            return out
-
-    # -- everything else: delegate ----------------------------------------
-
-    def __getattr__(self, name: str) -> Any:
-        return getattr(self._remote, name)
-
-
-# ---------------------------------------------------------------------------
-# Operator-side runtime
-# ---------------------------------------------------------------------------
-
-
-class SyncedClock(Clock):
-    """A clock slaved to the serving host's cluster clock via GET /time.
-
-    Every timestamp a remote operator writes into shared state — lease
-    acquire/renew times above all — must be comparable with timestamps other
-    processes write. Per-process `time.monotonic()` epochs are machine-boot-
-    relative: two operators on different machines would compare leases
-    across incomparable epochs, permanently blocking takeover or causing
-    instant split-brain. The reference avoids this by using apiserver-
-    comparable wall time for lease renewTime; this clock goes one better
-    and slaves directly to the HOST's clock, so even wall-clock skew
-    between machines cancels out.
-
-    now() = local_monotonic + offset, where offset is estimated against
-    /time with a midpoint RTT correction and re-estimated every
-    `resync_interval`. Between resyncs the clock advances on the local
-    monotonic rate (no network call per now()); a failed resync keeps the
-    previous offset — a host outage must not stop operator-local time.
-    """
-
-    def __init__(self, remote: "RemoteAPIServer", resync_interval: float = 30.0):
-        # Dedicated short-timeout client: the probe runs INSIDE now(), i.e.
-        # inside the operator tick loop — inheriting the 30s CRUD timeout
-        # would freeze ticks for up to 30s per resync attempt during a
-        # blackholed-host partition, exactly when responsiveness matters.
-        self._probe = RemoteAPIServer(
-            remote.base_url, timeout=2.0, token=remote.token,
-            ca_file=remote.ca_file,
-        )
-        self._resync_interval = resync_interval
-        self._offset: Optional[float] = None
-        self._last_sync = -float("inf")
-        self._sync()
-
-    def _sync(self) -> None:
-        t0 = _time.monotonic()
-        try:
-            server_now = self._probe.server_time()
-        except (ApiUnavailableError, ApiServerError, PermissionError):
-            # Count the ATTEMPT as the last sync: during a host outage,
-            # now() must keep running on the cached offset at local rate —
-            # one failed probe per resync_interval, not a blocking network
-            # call per now() (which would freeze the operator tick loop for
-            # the socket timeout, per call, exactly when responsiveness to
-            # the host's return matters most).
-            self._last_sync = _time.monotonic()
-            if self._offset is None:
-                # Never synced: fall back to wall time so timestamps are at
-                # least cross-machine *meaningful*; a later successful
-                # resync snaps onto the host epoch.
-                self._offset = _time.time() - t0
-            return
-        t1 = _time.monotonic()
-        self._offset = server_now - (t0 + t1) / 2.0
-        self._last_sync = t1
-
-    def now(self) -> float:
-        local = _time.monotonic()
-        if local - self._last_sync > self._resync_interval:
-            self._sync()
-            local = _time.monotonic()
-        return local + self._offset
-
-
-class RemoteRuntime:
-    """Run loop for a process whose API server lives elsewhere.
-
-    Shape-compatible with `Cluster` for everything the operator stack and
-    the SDK consume (`api`, `clock`, `add_ticker`/`remove_ticker`,
-    `schedule_at`/`schedule_after`, `run_until`/`run_for`, `live`), but with
-    no local store, scheduler, or kubelet — those live in the serving
-    process. Always real-clock: across OS processes there is no shared
-    virtual time.
-    """
-
-    def __init__(self, api: RemoteAPIServer, tick_interval: float = 0.02):
-        self.api = api
-        # Host-slaved time (see SyncedClock): lease and TTL arithmetic in
-        # this process compares against timestamps other processes wrote.
-        self.clock = SyncedClock(api)
-        self.tick_interval = tick_interval
-        self._tickers: List[Callable[[], None]] = []
-        self._timers: List[Tuple[float, int, Callable[[], None]]] = []
-        self._timer_seq = itertools.count()
-        # schedule_after is called from reconcile WORKER threads (requeue
-        # backoff) while the main loop pops due timers in step(); heapq on
-        # a shared list is not thread-safe, and a corrupted heap silently
-        # delays or drops requeue timers. All heap mutation goes through
-        # this lock; timer callbacks run OUTSIDE it (a callback that
-        # schedules again must not deadlock).
-        self._timers_lock = threading.Lock()
-
-    def add_ticker(self, fn: Callable[[], None]) -> None:
-        self._tickers.append(fn)
-
-    def remove_ticker(self, fn: Callable[[], None]) -> None:
-        try:
-            self._tickers.remove(fn)
-        except ValueError:
-            pass
-
-    def schedule_at(self, t: float, fn: Callable[[], None]) -> None:
-        with self._timers_lock:
-            heapq.heappush(self._timers, (t, next(self._timer_seq), fn))
-
-    def schedule_after(self, dt: float, fn: Callable[[], None]) -> None:
-        self.schedule_at(self.clock.now() + dt, fn)
-
-    def live(self, obj: Any) -> Any:
-        ns = getattr(obj.metadata, "namespace", "") or ""
-        return self.api.try_get(obj.KIND, ns, obj.metadata.name)
-
-    def step(self) -> None:
-        now = self.clock.now()
-        while True:
-            with self._timers_lock:
-                if not self._timers or self._timers[0][0] > now:
-                    break
-                _, _, fn = heapq.heappop(self._timers)
-            fn()
-        for fn in list(self._tickers):
-            fn()
-
-    def run_until(self, predicate: Callable[[], bool], timeout: float = 30.0) -> bool:
-        deadline = self.clock.now() + timeout
-        while True:
-            if predicate():
-                return True
-            self.step()
-            if predicate():
-                return True
-            if self.clock.now() >= deadline:
-                return False
-            _time.sleep(self.tick_interval)
-
-    def run_for(self, seconds: float) -> None:
-        self.run_until(lambda: False, timeout=seconds)
-
-    def run_forever(self, stop: threading.Event) -> None:
-        """Operator main loop: a transient transport failure (host restart,
-        connection reset) is survived with backoff — the process must NOT
-        die, or one API hiccup would take out leader and standby together.
-        Leadership safety doesn't depend on this: an unrenewable lease just
-        expires and the healthiest candidate re-acquires."""
-        backoff = 0.1
-        while not stop.is_set():
-            try:
-                self.step()
-                backoff = 0.1
-            except (ApiUnavailableError, ApiServerError) as e:
-                # Transport down, or the server answered 5xx — equally
-                # transient from here (k8s clients retry 500s the same
-                # way). Anything else — including plain RuntimeError from
-                # local code — is a bug and crashes loudly.
-                log.warning("API server error (%s); retrying in %.1fs", e, backoff)
-                _time.sleep(backoff)
-                backoff = min(backoff * 2, 5.0)
-                continue
-            _time.sleep(self.tick_interval)
+from training_operator_tpu.cluster.wire_server import ApiHTTPServer
+from training_operator_tpu.cluster.wire_transport import (
+    ApiServerError,
+    ApiUnavailableError,
+    RemoteAPIServer,
+)
+from training_operator_tpu.cluster.wire_watch import (
+    QUEUE_OVERFLOW,
+    RELIST_RESET,
+    CachedReadAPI,
+    RemoteWatchQueue,
+)
+
+__all__ = [
+    "ApiHTTPServer",
+    "ApiServerError",
+    "ApiUnavailableError",
+    "CachedReadAPI",
+    "QUEUE_OVERFLOW",
+    "RELIST_RESET",
+    "RemoteAPIServer",
+    "RemoteRuntime",
+    "RemoteWatchQueue",
+    "SyncedClock",
+]

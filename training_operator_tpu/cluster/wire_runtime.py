@@ -1,0 +1,190 @@
+"""Operator-side run loop for a remote API server: host-slaved clock,
+tickers, timers, and the crash-proof main loop.
+
+One of the four modules carved out of the original `cluster/httpapi.py`:
+this one owns `SyncedClock` (lease/TTL arithmetic on HOST time) and
+`RemoteRuntime` (the `Cluster`-shaped loop the operator stack and SDK run
+against when the API server lives in another process). The transport lives
+in `wire_transport.py`; the watch fanout in `wire_watch.py`; the server in
+`wire_server.py`. `cluster/httpapi.py` remains the public facade
+re-exporting all of it.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import threading
+import time as _time
+from typing import Any, Callable, List, Optional, Tuple
+
+from training_operator_tpu.cluster.runtime import Clock
+from training_operator_tpu.cluster.wire_transport import (
+    ApiServerError,
+    ApiUnavailableError,
+    RemoteAPIServer,
+)
+
+log = logging.getLogger(__name__)
+
+
+class SyncedClock(Clock):
+    """A clock slaved to the serving host's cluster clock via GET /time.
+
+    Every timestamp a remote operator writes into shared state — lease
+    acquire/renew times above all — must be comparable with timestamps other
+    processes write. Per-process `time.monotonic()` epochs are machine-boot-
+    relative: two operators on different machines would compare leases
+    across incomparable epochs, permanently blocking takeover or causing
+    instant split-brain. The reference avoids this by using apiserver-
+    comparable wall time for lease renewTime; this clock goes one better
+    and slaves directly to the HOST's clock, so even wall-clock skew
+    between machines cancels out.
+
+    now() = local_monotonic + offset, where offset is estimated against
+    /time with a midpoint RTT correction and re-estimated every
+    `resync_interval`. Between resyncs the clock advances on the local
+    monotonic rate (no network call per now()); a failed resync keeps the
+    previous offset — a host outage must not stop operator-local time.
+    """
+
+    def __init__(self, remote: "RemoteAPIServer", resync_interval: float = 30.0):
+        # Dedicated short-timeout client: the probe runs INSIDE now(), i.e.
+        # inside the operator tick loop — inheriting the 30s CRUD timeout
+        # would freeze ticks for up to 30s per resync attempt during a
+        # blackholed-host partition, exactly when responsiveness matters.
+        self._probe = RemoteAPIServer(
+            remote.base_url, timeout=2.0, token=remote.token,
+            ca_file=remote.ca_file,
+        )
+        self._resync_interval = resync_interval
+        self._offset: Optional[float] = None
+        self._last_sync = -float("inf")
+        self._sync()
+
+    def _sync(self) -> None:
+        t0 = _time.monotonic()
+        try:
+            server_now = self._probe.server_time()
+        except (ApiUnavailableError, ApiServerError, PermissionError):
+            # Count the ATTEMPT as the last sync: during a host outage,
+            # now() must keep running on the cached offset at local rate —
+            # one failed probe per resync_interval, not a blocking network
+            # call per now() (which would freeze the operator tick loop for
+            # the socket timeout, per call, exactly when responsiveness to
+            # the host's return matters most).
+            self._last_sync = _time.monotonic()
+            if self._offset is None:
+                # Never synced: fall back to wall time so timestamps are at
+                # least cross-machine *meaningful*; a later successful
+                # resync snaps onto the host epoch.
+                self._offset = _time.time() - t0
+            return
+        t1 = _time.monotonic()
+        self._offset = server_now - (t0 + t1) / 2.0
+        self._last_sync = t1
+
+    def now(self) -> float:
+        local = _time.monotonic()
+        if local - self._last_sync > self._resync_interval:
+            self._sync()
+            local = _time.monotonic()
+        return local + self._offset
+
+
+class RemoteRuntime:
+    """Run loop for a process whose API server lives elsewhere.
+
+    Shape-compatible with `Cluster` for everything the operator stack and
+    the SDK consume (`api`, `clock`, `add_ticker`/`remove_ticker`,
+    `schedule_at`/`schedule_after`, `run_until`/`run_for`, `live`), but with
+    no local store, scheduler, or kubelet — those live in the serving
+    process. Always real-clock: across OS processes there is no shared
+    virtual time.
+    """
+
+    def __init__(self, api: RemoteAPIServer, tick_interval: float = 0.02):
+        self.api = api
+        # Host-slaved time (see SyncedClock): lease and TTL arithmetic in
+        # this process compares against timestamps other processes wrote.
+        self.clock = SyncedClock(api)
+        self.tick_interval = tick_interval
+        self._tickers: List[Callable[[], None]] = []
+        self._timers: List[Tuple[float, int, Callable[[], None]]] = []
+        self._timer_seq = itertools.count()
+        # schedule_after is called from reconcile WORKER threads (requeue
+        # backoff) while the main loop pops due timers in step(); heapq on
+        # a shared list is not thread-safe, and a corrupted heap silently
+        # delays or drops requeue timers. All heap mutation goes through
+        # this lock; timer callbacks run OUTSIDE it (a callback that
+        # schedules again must not deadlock).
+        self._timers_lock = threading.Lock()
+
+    def add_ticker(self, fn: Callable[[], None]) -> None:
+        self._tickers.append(fn)
+
+    def remove_ticker(self, fn: Callable[[], None]) -> None:
+        try:
+            self._tickers.remove(fn)
+        except ValueError:
+            pass
+
+    def schedule_at(self, t: float, fn: Callable[[], None]) -> None:
+        with self._timers_lock:
+            heapq.heappush(self._timers, (t, next(self._timer_seq), fn))
+
+    def schedule_after(self, dt: float, fn: Callable[[], None]) -> None:
+        self.schedule_at(self.clock.now() + dt, fn)
+
+    def live(self, obj: Any) -> Any:
+        ns = getattr(obj.metadata, "namespace", "") or ""
+        return self.api.try_get(obj.KIND, ns, obj.metadata.name)
+
+    def step(self) -> None:
+        now = self.clock.now()
+        while True:
+            with self._timers_lock:
+                if not self._timers or self._timers[0][0] > now:
+                    break
+                _, _, fn = heapq.heappop(self._timers)
+            fn()
+        for fn in list(self._tickers):
+            fn()
+
+    def run_until(self, predicate: Callable[[], bool], timeout: float = 30.0) -> bool:
+        deadline = self.clock.now() + timeout
+        while True:
+            if predicate():
+                return True
+            self.step()
+            if predicate():
+                return True
+            if self.clock.now() >= deadline:
+                return False
+            _time.sleep(self.tick_interval)
+
+    def run_for(self, seconds: float) -> None:
+        self.run_until(lambda: False, timeout=seconds)
+
+    def run_forever(self, stop: threading.Event) -> None:
+        """Operator main loop: a transient transport failure (host restart,
+        connection reset) is survived with backoff — the process must NOT
+        die, or one API hiccup would take out leader and standby together.
+        Leadership safety doesn't depend on this: an unrenewable lease just
+        expires and the healthiest candidate re-acquires."""
+        backoff = 0.1
+        while not stop.is_set():
+            try:
+                self.step()
+                backoff = 0.1
+            except (ApiUnavailableError, ApiServerError) as e:
+                # Transport down, or the server answered 5xx — equally
+                # transient from here (k8s clients retry 500s the same
+                # way). Anything else — including plain RuntimeError from
+                # local code — is a bug and crashes loudly.
+                log.warning("API server error (%s); retrying in %.1fs", e, backoff)
+                _time.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+                continue
+            _time.sleep(self.tick_interval)

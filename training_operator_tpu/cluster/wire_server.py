@@ -1,0 +1,491 @@
+"""HTTP server side of the wire boundary: routing, body cache, watch
+sessions.
+
+One of the four modules carved out of the original `cluster/httpapi.py`:
+this one owns `ApiHTTPServer`, which serves an existing in-process
+`APIServer` over localhost HTTP(S) — CRUD + watch subscriptions + pod logs
++ events — with the version-keyed body cache and serialize-once watch
+fanout from the wire fast path. The client transport lives in
+`wire_transport.py`; the client watch fanout in `wire_watch.py`; the
+operator run loop in `wire_runtime.py`. `cluster/httpapi.py` remains the
+public facade re-exporting all of it.
+
+Watch sessions are server-side WatchQueues keyed by a token; clients poll
+`GET /watches/<id>` (optionally long-polling via ?timeout=). Sessions idle
+longer than `session_ttl` are garbage-collected so a kill -9'd operator
+doesn't leak an ever-growing event queue.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time as _time
+import urllib.parse
+import uuid
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from training_operator_tpu.cluster import wire
+from training_operator_tpu.cluster.apiserver import (
+    AlreadyExistsError,
+    APIServer,
+    ConflictError,
+    NotFoundError,
+    WatchQueue,
+)
+from training_operator_tpu.cluster.objects import Event
+from training_operator_tpu.cluster.wire_transport import seg_ns
+from training_operator_tpu.utils import metrics
+
+log = logging.getLogger(__name__)
+
+
+class ApiHTTPServer:
+    """Serve one APIServer over HTTP on a background thread.
+
+    The owning process keeps driving its Cluster loop; handler threads only
+    touch the APIServer, whose RLock makes every call atomic. Watch events
+    pushed by handler-thread writes are drained by local tickers on the next
+    step, identical to any other writer.
+    """
+
+    def __init__(
+        self,
+        api: APIServer,
+        port: int = 0,
+        bind: str = "127.0.0.1",
+        session_ttl: float = 120.0,
+        token: Optional[str] = None,
+        now_fn: Optional[Callable[[], float]] = None,
+        tls: Optional[Tuple[str, str]] = None,
+        chaos: Optional[object] = None,
+    ):
+        """`token`: require `Authorization: Bearer <token>` on every route
+        except /healthz and /readyz (probes stay open, like kubelet probes)
+        — the authn half of the reference's cert-gated apiserver connection
+        (pkg/cert/cert.go:45); the transport half is TLS (see `certs.py`).
+
+        `now_fn`: the serving process's cluster clock, exposed at GET /time
+        so remote operators can run their lease/TTL arithmetic on HOST time
+        (SyncedClock). Leases written by operators on different machines
+        would otherwise compare renew_time against incomparable per-machine
+        monotonic epochs — takeover permanently blocked, or split-brain.
+
+        `tls`: (cert_path, key_path) pair (see certs.mint_server_cert) —
+        serve HTTPS; the cert can be hot-rotated via rotate_cert().
+
+        `chaos`: a cluster.chaos.WireChaos policy — per-request transport
+        fault injection (5xx, connection reset, watch-session reap) for
+        adversarial testing of the client retry/resubscribe arms."""
+        self.api = api
+        self.session_ttl = session_ttl
+        self.token = token
+        self.chaos = chaos
+        self.now_fn = now_fn or _time.time
+        if token and tls is None and bind not in ("127.0.0.1", "::1", "localhost"):
+            log.warning(
+                "bearer token configured on a non-loopback cleartext bind "
+                "(%s): the token and all API traffic are sniffable; serve "
+                "TLS (--tls) for non-local deployments", bind,
+            )
+        # watch_id -> (WatchQueue, last_access_monotonic)
+        self._sessions: Dict[str, List[Any]] = {}
+        self._sessions_lock = threading.Lock()
+        # Version-keyed body cache: (kind, ns, name, resourceVersion) ->
+        # encoded JSON bytes. Objects are immutable between resourceVersions
+        # (copy-on-read store), so cached bytes can never be stale — an
+        # update bumps the rv and misses. GET serves straight from bytes;
+        # LIST responses are assembled by byte concatenation. LRU-bounded:
+        # dead versions age out, no invalidation hooks needed.
+        self._body_cache: "OrderedDict[Tuple[str, str, str, int], bytes]" = OrderedDict()
+        self._body_cache_max = 16384
+        self._body_lock = threading.Lock()
+        # Parsed-route memo keyed by the raw request target: watch polls and
+        # burst-time LISTs repeat identical paths thousands of times, and
+        # urlsplit+unquote+parse_qsl per request shows up at that scale.
+        # Handlers never mutate the parts/query they are handed. Unlocked by
+        # design: a lost race costs one re-parse, nothing else.
+        self._route_cache: Dict[str, Tuple[List[str], Dict[str, str]]] = {}
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            # Response headers and body go out as separate send()s; with
+            # Nagle on a keep-alive connection the second segment waits on
+            # the client's delayed ACK — a flat ~40ms tax on EVERY request.
+            disable_nagle_algorithm = True
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, payload: Any) -> None:
+                self._send_bytes(code, json.dumps(payload).encode())
+
+            def _send_bytes(self, code: int, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> Any:
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b"{}"
+                return json.loads(raw or b"{}")
+
+            def _route(self, method: str) -> None:
+                try:
+                    cached = outer._route_cache.get(self.path)
+                    if cached is None:
+                        parsed = urllib.parse.urlsplit(self.path)
+                        # Unquote AFTER splitting: a %2F inside an object
+                        # name must not become a path separator.
+                        parts = [
+                            urllib.parse.unquote(p)
+                            for p in parsed.path.split("/")
+                            if p
+                        ]
+                        q = dict(urllib.parse.parse_qsl(parsed.query))
+                        # Inserted by _dispatch only AFTER auth passes —
+                        # unauthenticated traffic must not evict hot routes
+                        # or pin attacker-chosen keys.
+                        outer._dispatch(self, method, parts, q, memo_key=self.path)
+                    else:
+                        parts, q = cached
+                        outer._dispatch(self, method, parts, q)
+                except NotFoundError as e:
+                    self._send(404, {"error": "NotFound", "message": str(e)})
+                except ConflictError as e:
+                    self._send(409, {"error": "Conflict", "message": str(e)})
+                except AlreadyExistsError as e:
+                    self._send(409, {"error": "AlreadyExists", "message": str(e)})
+                except ValueError as e:
+                    self._send(422, {"error": "Invalid", "message": str(e)})
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001 — wire boundary
+                    log.exception("httpapi handler error")
+                    self._send(500, {"error": "Internal", "message": str(e)})
+
+            def do_GET(self):
+                self._route("GET")
+
+            def do_POST(self):
+                self._route("POST")
+
+            def do_PUT(self):
+                self._route("PUT")
+
+            def do_DELETE(self):
+                self._route("DELETE")
+
+        class _Server(ThreadingHTTPServer):
+            # Default listen backlog (5) is too small for several clients
+            # opening a fresh connection per request. Subclass, not a class-
+            # attribute mutation on the stdlib type, so unrelated servers in
+            # this process keep their own backlog.
+            request_queue_size = 64
+            daemon_threads = True
+
+            def handle_error(self, request, client_address):
+                # TLS handshake failures (plain-HTTP probe against the HTTPS
+                # port, cert rejected by a mis-pinned client) arrive here per
+                # connection; stdlib prints a full traceback to stderr.
+                log.debug("connection error from %s", client_address, exc_info=True)
+
+        self._httpd = _Server((bind, port), Handler)
+        self._ssl_context = None
+        scheme = "http"
+        if tls is not None:
+            from training_operator_tpu.cluster import certs as _certs
+
+            self._ssl_context = _certs.server_context(*tls)
+            # Handshake deferred to the handler thread (first read), so a
+            # slow client's handshake can't stall the accept loop.
+            self._httpd.socket = self._ssl_context.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False,
+            )
+            scheme = "https"
+        self.port = self._httpd.server_address[1]
+        self.url = f"{scheme}://{bind}:{self.port}"
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        # Background session GC: route-handler GC alone never runs once the
+        # last watch client dies (kill -9 both operators), and the dead
+        # sessions' queues would then accumulate every write's event until
+        # OOM. A daemon timer sweeps regardless of request traffic.
+        self._gc_stop = threading.Event()
+
+        def _gc_loop():
+            while not self._gc_stop.wait(min(30.0, max(1.0, session_ttl / 4))):
+                self._gc_sessions()
+
+        self._gc_thread = threading.Thread(target=_gc_loop, daemon=True)
+        self._gc_thread.start()
+
+    def close(self) -> None:
+        self._gc_stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def rotate_cert(self, cert_path: str, key_path: str) -> None:
+        """Hot-rotate the serving cert: reload into the LIVE ssl context so
+        new handshakes present the fresh cert while established connections
+        finish on the old one. Clients pin the CA, not the serving cert, so
+        rotation is invisible to them — the reference's rotated webhook
+        serving certs behave the same way (pkg/cert/cert.go:45)."""
+        if self._ssl_context is None:
+            raise RuntimeError("server is not serving TLS")
+        self._ssl_context.load_cert_chain(cert_path, key_path)
+        log.info("rotated serving certificate from %s", cert_path)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _dispatch(
+        self,
+        h,
+        method: str,
+        parts: List[str],
+        q: Dict[str, str],
+        memo_key: Optional[str] = None,
+    ) -> None:
+        if not parts:
+            h._send(404, {"error": "NotFound", "message": "no route"})
+            return
+        head = parts[0]
+        if head in ("healthz", "readyz"):
+            h._send(200, {"ok": True})
+            return
+        if head == "time":
+            # Open like the probes: clock sync must work before a client
+            # has its token plumbed, and the value is not sensitive.
+            h._send(200, {"now": self.now_fn()})
+            return
+        if self.chaos is not None:
+            action = self.chaos.sample()
+            if action == "error":
+                h._send(500, {"error": "Internal", "message": "chaos: injected"})
+                return
+            if action == "reset":
+                # No response at all — the client sees a connection reset
+                # (transport failure, not an API status).
+                import socket as _socket
+
+                try:
+                    h.connection.shutdown(_socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                h.close_connection = True
+                return
+            if action == "reap":
+                # Session loss (failover / memory pressure): every watch
+                # client must resubscribe and heal by resync. The request
+                # itself is then served normally.
+                self.reap_all_sessions()
+        if self.token is not None:
+            import hmac
+
+            supplied = h.headers.get("Authorization", "")
+            if not hmac.compare_digest(
+                supplied.encode(), f"Bearer {self.token}".encode()
+            ):
+                h._send(401, {"error": "Unauthorized", "message": "bad or missing bearer token"})
+                return
+        if memo_key is not None and len(memo_key) <= 512:
+            # Authenticated (or open-deployment) request on a fresh path:
+            # memoize the parse. Bounded; clear-all on overflow is fine —
+            # the hot keys (watch polls, burst LISTs) repopulate instantly.
+            if len(self._route_cache) >= 4096:
+                self._route_cache.clear()
+            self._route_cache[memo_key] = (parts, q)
+        if head == "objects":
+            self._objects(h, method, parts[1:], q)
+        elif head == "watches":
+            self._watches(h, method, parts[1:], q)
+        elif head == "logs":
+            self._logs(h, method, parts[1:], q)
+        elif head == "events":
+            self._events(h, method, q)
+        elif head == "metrics":
+            # JSON snapshot of the serving process's metrics registry —
+            # how a remote bench/test reads the wire-cache hit rates
+            # (codec/body/event counters) instead of trusting a self-run.
+            h._send(200, metrics.registry.snapshot())
+        elif head == "version" and len(parts) == 4:
+            rv = self.api.resource_version(parts[1], seg_ns(parts[2]), parts[3])
+            h._send(200, {"resourceVersion": rv})
+        else:
+            h._send(404, {"error": "NotFound", "message": f"no route {head}"})
+
+    def _object_bytes(self, obj) -> bytes:
+        """Encoded JSON bytes for one STORED object reference, via the
+        version-keyed cache. The ref is a frozen version (updates replace,
+        never mutate), so encoding outside any lock is safe and the cached
+        bytes are valid for that (name, resourceVersion) forever."""
+        md = obj.metadata
+        key = (
+            obj.KIND,
+            getattr(md, "namespace", "") or "",
+            md.name,
+            md.resource_version,
+        )
+        with self._body_lock:
+            body = self._body_cache.get(key)
+            if body is not None:
+                self._body_cache.move_to_end(key)
+        if body is not None:
+            metrics.wire_body_cache_hits.inc()
+            return body
+        body = json.dumps(wire.encode(obj), separators=(",", ":")).encode()
+        metrics.wire_body_cache_misses.inc()
+        with self._body_lock:
+            self._body_cache[key] = body
+            while len(self._body_cache) > self._body_cache_max:
+                self._body_cache.popitem(last=False)
+        return body
+
+    def _objects(self, h, method: str, parts: List[str], q: Dict[str, str]) -> None:
+        if method == "POST" and not parts:
+            obj = wire.decode(h._body())
+            created = self.api.create(obj)
+            # Respond through the body cache: `created` carries the assigned
+            # uid/resourceVersion and is content-identical to the stored
+            # clone, so this both serves the response and SEEDS the cache —
+            # the operator's next LIST of this version is a hit.
+            h._send_bytes(201, self._object_bytes(created))
+        elif method == "GET" and len(parts) == 1:
+            selector = None
+            if q.get("labelSelector"):
+                selector = dict(
+                    pair.split("=", 1) for pair in q["labelSelector"].split(",") if "=" in pair
+                )
+            refs = self.api.list_refs(parts[0], q.get("namespace") or None, selector)
+            # Byte concatenation, not re-encoding: each element's bytes come
+            # from the version-keyed cache, so a burst of identical LISTs
+            # costs one serialization per changed object, total.
+            h._send_bytes(
+                200,
+                b'{"items":[' + b",".join(self._object_bytes(o) for o in refs) + b"]}",
+            )
+        elif method == "GET" and len(parts) == 3:
+            h._send_bytes(
+                200,
+                self._object_bytes(self.api.get_ref(parts[0], seg_ns(parts[1]), parts[2])),
+            )
+        elif method == "PUT" and len(parts) == 3:
+            obj = wire.decode(h._body())
+            updated = self.api.update(
+                obj,
+                check_version=q.get("check_version", "1") != "0",
+                status_only=q.get("status_only") == "1",
+            )
+            # Seeds the cache with the fresh version (see POST above).
+            h._send_bytes(200, self._object_bytes(updated))
+        elif method == "DELETE" and len(parts) == 3:
+            gone = self.api.delete(parts[0], seg_ns(parts[1]), parts[2])
+            # The deleted object's final version is usually already cached.
+            h._send_bytes(200, self._object_bytes(gone))
+        else:
+            h._send(404, {"error": "NotFound", "message": "bad objects route"})
+
+    def _watches(self, h, method: str, parts: List[str], q: Dict[str, str]) -> None:
+        self._gc_sessions()
+        if method == "POST" and not parts:
+            body = h._body()
+            kinds = body.get("kinds")
+            wq = self.api.watch(kinds=kinds)
+            wid = uuid.uuid4().hex
+            with self._sessions_lock:
+                self._sessions[wid] = [wq, _time.monotonic()]
+            h._send(201, {"watch_id": wid})
+        elif method == "GET" and len(parts) == 1:
+            with self._sessions_lock:
+                session = self._sessions.get(parts[0])
+                if session is not None:
+                    session[1] = _time.monotonic()
+            if session is None:
+                raise NotFoundError(f"watch session {parts[0]}")
+            wq = session[0]
+            # Clamp the client-supplied long-poll timeout well under the
+            # session TTL: a poll allowed to outlive the TTL could have its
+            # session GC'd mid-wait, dropping the buffered events it was
+            # about to receive and forcing a needless resubscribe+resync.
+            timeout = min(float(q.get("timeout", "0")), self.session_ttl / 4)
+            # Park on the store's condition variable — zero CPU while idle,
+            # wakes on the next write, drain atomic w.r.t. pushes.
+            events = self.api.wait_and_drain(wq, timeout=timeout)
+            with self._sessions_lock:
+                session = self._sessions.get(parts[0])
+                if session is not None:
+                    session[1] = _time.monotonic()  # poll completion counts as activity
+            # Serialize-once fanout: each event's bytes are encoded exactly
+            # once (cached on the shared event object) and reused by every
+            # session's drain — N subscribers no longer cost N encodes.
+            h._send_bytes(
+                200,
+                b'{"events":['
+                + b",".join(wire.encode_watch_event_bytes(ev) for ev in events)
+                + b"]}",
+            )
+        elif method == "DELETE" and len(parts) == 1:
+            with self._sessions_lock:
+                session = self._sessions.pop(parts[0], None)
+            if session is not None:
+                self.api.unwatch(session[0])
+            h._send(200, {"ok": True})
+        else:
+            h._send(404, {"error": "NotFound", "message": "bad watches route"})
+
+    def reap_all_sessions(self) -> None:
+        """Drop every server-side watch session (chaos 'reap' action, and
+        the bench's deterministic session-loss trigger): clients discover
+        the loss as 404 on their next poll and heal by resubscribe."""
+        with self._sessions_lock:
+            dead = list(self._sessions.values())
+            self._sessions.clear()
+        for wq, _ in dead:
+            self.api.unwatch(wq)
+
+    # Backwards-compatible alias (pre-split name; tests reach for it).
+    _reap_all_sessions = reap_all_sessions
+
+    def _gc_sessions(self) -> None:
+        now = _time.monotonic()
+        dead: List[Tuple[str, WatchQueue]] = []
+        with self._sessions_lock:
+            for wid, (wq, last) in list(self._sessions.items()):
+                if now - last > self.session_ttl:
+                    dead.append((wid, wq))
+                    del self._sessions[wid]
+        for _, wq in dead:
+            self.api.unwatch(wq)
+
+    def _logs(self, h, method: str, parts: List[str], q: Dict[str, str]) -> None:
+        if len(parts) != 2:
+            raise NotFoundError("logs route is /logs/<ns>/<pod>")
+        ns, name = seg_ns(parts[0]), parts[1]
+        if method == "GET":
+            tail = int(q["tail"]) if q.get("tail") else None
+            lines, cursor = self.api.read_pod_log(
+                ns, name, since=int(q.get("since", "0")), tail=tail
+            )
+            h._send(200, {"lines": lines, "cursor": cursor})
+        elif method == "POST":
+            body = h._body()
+            self.api.append_pod_log(ns, name, body.get("line", ""), body.get("ts", 0.0))
+            h._send(200, {"ok": True})
+        else:
+            raise NotFoundError("bad logs method")
+
+    def _events(self, h, method: str, q: Dict[str, str]) -> None:
+        if method == "POST":
+            ev = wire.decode(h._body(), Event)
+            self.api.record_event(ev)
+            h._send(201, {"ok": True})
+        else:
+            evs = self.api.events(q.get("object_name") or None, q.get("reason") or None)
+            h._send(200, {"items": [wire.encode(e) for e in evs]})
