@@ -5,13 +5,13 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast lint bench bench-quick bench-wire dryrun operator-demo ha-demo native clean
+.PHONY: test test-fast lint bench bench-quick bench-wire bench-wire-resume dryrun operator-demo ha-demo native clean
 
 test:            ## full suite (no hardware needed; ~10 min)
 	$(PY) -m pytest tests/ -q
 
-test-fast:       ## everything but the slow trainer-numerics tier
-	$(PY) -m pytest tests/ -q --ignore=tests/test_trainer.py
+test-fast:       ## the tier-1 fast lane: everything but the `slow`-marked jit-heavy numerics
+	$(PY) -m pytest tests/ -q -m "not slow"
 
 lint:            ## project code lint: AST discipline rules + ruff (if present)
 	$(PY) -m training_operator_tpu.analysis.codelint training_operator_tpu
@@ -47,6 +47,11 @@ bench-wire:      ## wire fast-path block standalone (quick-sized, one JSON line)
 	JAX_PLATFORMS=cpu $(PY) bench.py --wire-overhead-only --wire-jobs 100
 
 wire-bench: bench-wire  ## back-compat alias for bench-wire
+
+# Reap every watch session against a 1k-object cluster and compare the
+# reconnect cost of ResourceVersion delta-resume vs the forced full relist.
+bench-wire-resume:  ## watch-resume reconnect-cost block (one JSON line)
+	JAX_PLATFORMS=cpu $(PY) bench.py --wire-resume-only
 
 native:          ## force-rebuild the C++ data-path core (drops the hash cache)
 	$(PY) -c "from training_operator_tpu import native; import glob, os; \

@@ -790,6 +790,107 @@ def run_wire_overhead(n_jobs: int = 200):
     }
 
 
+# ---------------------------------------------------------------------------
+# Watch-resume reconnect cost: O(delta) vs O(cluster) at 1k objects.
+# ---------------------------------------------------------------------------
+
+
+def run_wire_resume(n_objects: int = 1000, delta_events: int = 20):
+    """The `wire_resume` bench block (VERDICT r5 Next #3 done-criterion):
+    reap every watch session against an `n_objects` cluster, then measure
+    what a reconnect COSTS for two identical clients that both observed the
+    full state — one presenting its ResourceVersion watermark (delta
+    resume), one with resume disabled (the pre-resume forced-relist arm).
+    The artifact must show O(delta): the resume leg transfers
+    `delta_events` events where the relist leg re-pulls the whole cluster,
+    and the host's `training_wire_resume_*` counters (read over the wire,
+    not trusted from a self-run) show delta > 0 with too_old == 0."""
+    from training_operator_tpu.api.jobs import ObjectMeta
+    from training_operator_tpu.cluster.httpapi import ApiHTTPServer, RemoteAPIServer
+    from training_operator_tpu.cluster.objects import ConfigMap
+    from training_operator_tpu.cluster.runtime import Cluster
+
+    cluster = Cluster()
+    server = ApiHTTPServer(cluster.api, port=0)
+    try:
+        resume_client = RemoteAPIServer(server.url, timeout=10.0)
+        relist_client = RemoteAPIServer(server.url, timeout=10.0, resume=False)
+        wq_resume = resume_client.watch(kinds=["ConfigMap"])
+        wq_relist = relist_client.watch(kinds=["ConfigMap"])
+
+        for i in range(n_objects):
+            cluster.api.create(
+                ConfigMap(metadata=ObjectMeta(name=f"rv-{i}"), data={"i": str(i)})
+            )
+
+        def drain_until(wq, want, deadline_s=120.0):
+            got = []
+            deadline = time.monotonic() + deadline_s
+            while len(got) < want and time.monotonic() < deadline:
+                got.extend(wq.drain(timeout=1.0))
+            return got
+
+        # Both clients observe the full state (their watermarks / knowledge
+        # are current) BEFORE the storm.
+        assert len(drain_until(wq_resume, n_objects)) == n_objects
+        assert len(drain_until(wq_relist, n_objects)) == n_objects
+
+        # The reap storm: every server-side session is gone at once.
+        server.reap_all_sessions()
+        for i in range(delta_events):
+            cluster.api.create(
+                ConfigMap(metadata=ObjectMeta(name=f"delta-{i}"), data={})
+            )
+
+        t0 = time.monotonic()
+        got = drain_until(wq_resume, delta_events)
+        delta_reconnect_s = time.monotonic() - t0
+        delta_names = {e.obj.metadata.name for e in got}
+
+        t0 = time.monotonic()
+        # The relist leg re-announces EVERYTHING (n_objects + the delta).
+        got_relist = drain_until(wq_relist, n_objects + delta_events)
+        relist_reconnect_s = time.monotonic() - t0
+
+        snap = resume_client.metrics_snapshot()
+        assert delta_names == {f"delta-{i}" for i in range(delta_events)}, (
+            "delta resume replayed the wrong events"
+        )
+        return {
+            "objects": n_objects,
+            "delta_events": delta_events,
+            "delta_resume": {
+                "reconnect_s": round(delta_reconnect_s, 4),
+                "events_transferred": len(got),
+            },
+            "forced_relist": {
+                "reconnect_s": round(relist_reconnect_s, 4),
+                "events_transferred": len(got_relist),
+            },
+            # >1 = resume reconnects faster; the events ratio is the
+            # structural O(delta)-vs-O(cluster) evidence, robust to timing
+            # noise on a loaded box.
+            "relist_over_delta_time": round(
+                relist_reconnect_s / delta_reconnect_s, 2
+            ) if delta_reconnect_s > 0 else None,
+            "relist_over_delta_events": round(
+                len(got_relist) / max(1, len(got)), 1
+            ),
+            "host_resume_counters": {
+                "delta_total": snap.get("training_wire_resume_delta_total", 0.0),
+                "replayed_events_total": snap.get(
+                    "training_wire_resume_replayed_events_total", 0.0
+                ),
+                "too_old_total": snap.get("training_wire_resume_too_old_total", 0.0),
+                "ring_evictions_total": snap.get(
+                    "training_wire_resume_ring_evictions_total", 0.0
+                ),
+            },
+        }
+    finally:
+        server.close()
+
+
 def _accelerator_reachable(timeout_s: float = 150.0) -> bool:
     """Probe the default JAX backend in a SUBPROCESS with a hard timeout.
 
@@ -846,6 +947,14 @@ def main():
                     help="run only the wire-overhead block")
     ap.add_argument("--wire-jobs", type=int, default=200,
                     help="burst size for the wire-overhead block")
+    ap.add_argument("--no-wire-resume", action="store_true",
+                    help="skip the watch-resume reconnect-cost block")
+    ap.add_argument("--wire-resume-only", action="store_true",
+                    help="run only the watch-resume reconnect-cost block "
+                         "(delta-resume vs forced-relist after a session "
+                         "reap against a 1k-object cluster)")
+    ap.add_argument("--wire-resume-objects", type=int, default=1000,
+                    help="cluster size for the wire-resume block")
     trainer_group = ap.add_mutually_exclusive_group()
     trainer_group.add_argument("--no-trainer", action="store_true",
                                help="skip the single-chip trainer compute benchmark")
@@ -853,6 +962,17 @@ def main():
                                help="run only the trainer compute benchmark")
     args = ap.parse_args()
     n = 100 if args.quick else args.jobs
+
+    if args.wire_resume_only:
+        block = run_wire_resume(args.wire_resume_objects)
+        print(json.dumps({
+            "metric": "wire_resume_relist_over_delta_events",
+            "value": block["relist_over_delta_events"],
+            "unit": "x (forced-relist events / delta-resume events per reconnect)",
+            "vs_baseline": None,
+            "wire_resume": block,
+        }))
+        return
 
     if args.wire_overhead_only:
         block = run_wire_overhead(args.wire_jobs)
@@ -903,6 +1023,28 @@ def main():
             from training_operator_tpu.trainer.bench import run_trainer_bench
 
             trainer = run_trainer_bench(steps=5 if args.quick else 10)
+    if (args.no_trainer or degraded) and not args.quick:
+        # Scheduler-only / tunnel-down runs still publish the END-TO-END
+        # trainer loop number on CPU (VERDICT r5 Next #5): the tokens/s +
+        # data/ckpt-split methodology must exist in an artifact — platform-
+        # labeled "cpu" so nobody mistakes it for the chip capture — before
+        # the TPU tunnel returns, not after.
+        try:
+            from training_operator_tpu.trainer.bench import bench_trainer_e2e
+
+            e2e_cpu = bench_trainer_e2e(steps=30, ckpt_every=10)
+            if trainer is None:
+                trainer = {}
+            trainer["trainer_e2e"] = e2e_cpu
+            trainer["note"] = (
+                "trainer_e2e measured on cpu (scheduler-only run); "
+                "the chip capture replaces it when the tunnel returns"
+            )
+        except Exception as e:  # noqa: BLE001 — the scheduler metric must survive
+            if trainer is None:
+                trainer = {}
+            trainer["trainer_e2e"] = {"error": f"{type(e).__name__}: {e}"}
+    if not args.no_trainer:
         if args.trainer_only:
             ts = (trainer or {}).get("train_step", {})
             print(json.dumps({
@@ -980,6 +1122,9 @@ def main():
     wire_overhead = None
     if not args.quick and not args.no_wire_overhead:
         wire_overhead = run_wire_overhead(args.wire_jobs)
+    wire_resume = None
+    if not args.quick and not args.no_wire_resume:
+        wire_resume = run_wire_resume(args.wire_resume_objects)
 
     oracle = oracle_bound(specs)
     goracle = granular_oracle(specs)
@@ -1013,6 +1158,8 @@ def main():
         out["duration_noise"] = duration_noise
     if wire_overhead is not None:
         out["wire_overhead"] = wire_overhead
+    if wire_resume is not None:
+        out["wire_resume"] = wire_resume
     if tail_by_class is not None:
         out["tail_by_class"] = tail_by_class
     if trainer is not None:

@@ -413,6 +413,29 @@ class TestCodelint:
         found = check_source("x.py", src, package_rel="utils/x.py")
         assert [f.rule_id for f in found] == ["CL003"], found
 
+    def test_cl004_wire_internal_import_flagged(self):
+        src = ("from training_operator_tpu.cluster.wire_watch import _SharedWatch\n")
+        found = check_source("x.py", src, package_rel="controllers/x.py")
+        assert [f.rule_id for f in found] == ["CL004"]
+        src2 = ("from training_operator_tpu.cluster.httpapi import _anything\n")
+        found = check_source("x.py", src2, package_rel="engine/x.py")
+        assert [f.rule_id for f in found] == ["CL004"]
+
+    def test_cl004_public_facade_imports_ok(self):
+        src = ("from training_operator_tpu.cluster.httpapi import (\n"
+               "    ApiHTTPServer, RemoteAPIServer, CachedReadAPI)\n")
+        assert not check_source("x.py", src, package_rel="sdk/x.py")
+
+    def test_cl004_wire_modules_exempt_among_themselves(self):
+        # The four wire modules are one subsystem: wire_server importing a
+        # transport helper is inside the seam, not across it.
+        src = ("from training_operator_tpu.cluster.wire_transport import _seg_ns\n")
+        assert not check_source(
+            "wire_server.py", src, package_rel="cluster/wire_server.py"
+        )
+        # ...but the same import from anywhere else is a violation.
+        assert check_source("x.py", src, package_rel="cluster/store.py")
+
     def test_cl003_daemon_or_join_ok(self):
         daemon = ("import threading\n"
                   "def f():\n    threading.Thread(target=f, daemon=True).start()\n")

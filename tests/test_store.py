@@ -271,6 +271,91 @@ class TestCompaction:
         assert snap["rv"] >= 1 and len(snap["objects"]) == 1
 
 
+class TestDurabilityKnobs:
+    def test_bytes_trigger_compacts_under_large_objects(self, tmp_path):
+        """VERDICT r5 Next #8: record-count-triggered compaction alone lets
+        a few huge objects grow the journal unboundedly — far under the
+        4096-record default, the BYTES bound must fire, rotate the journal,
+        and lose nothing."""
+        from training_operator_tpu.cluster.objects import ConfigMap
+
+        api = APIServer()
+        store = HostStore(str(tmp_path), compact_every=4096,
+                          compact_max_bytes=256 * 1024)
+        store.load_into(api)
+        store.attach(api)
+        big = "x" * 64 * 1024
+        for i in range(2):
+            api.create(ConfigMap(metadata=ObjectMeta(name=f"big-{i}"),
+                                 data={"blob": big}))
+        assert store.maybe_compact(api) is False, "under both bounds: no compact"
+        for i in range(2, 8):
+            api.create(ConfigMap(metadata=ObjectMeta(name=f"big-{i}"),
+                                 data={"blob": big}))
+        # 8 records << 4096, but ~512KiB of journal >= the 256KiB bound.
+        assert store.maybe_compact(api) is True
+        assert os.path.exists(os.path.join(str(tmp_path), SNAPSHOT))
+        assert os.path.getsize(
+            os.path.join(str(tmp_path), journal_name(store._gen))
+        ) == 0, "fresh generation after the bytes-triggered rotate"
+        store.close()
+
+        api2 = _recover(tmp_path)
+        assert {
+            o.metadata.name for o in api2.list("ConfigMap")
+        } == {f"big-{i}" for i in range(8)}
+        assert api2.get("ConfigMap", "default", "big-0").data["blob"] == big
+
+    def test_bytes_trigger_disabled_with_zero(self, tmp_path):
+        from training_operator_tpu.cluster.objects import ConfigMap
+
+        api = APIServer()
+        store = HostStore(str(tmp_path), compact_every=4096, compact_max_bytes=0)
+        store.load_into(api)
+        store.attach(api)
+        api.create(ConfigMap(metadata=ObjectMeta(name="b"),
+                             data={"blob": "x" * 1024 * 1024}))
+        assert store.maybe_compact(api) is False
+        store.close()
+
+    def test_fsync_per_record_opt_in(self, tmp_path):
+        """The flush-vs-fsync policy knob: fsync_per_record=True must fsync
+        the journal fd on every record (power-loss durability), and the
+        default must not (etcd-style batched fsync economics)."""
+        fsyncs = []
+        real_fsync = os.fsync
+
+        def counting_fsync(fd):
+            fsyncs.append(fd)
+            return real_fsync(fd)
+
+        api = APIServer()
+        store = HostStore(str(tmp_path), fsync_per_record=True)
+        store.load_into(api)
+        store.attach(api)
+        os.fsync = counting_fsync
+        try:
+            api.create(_pod("p0"))
+            api.create(_pod("p1"))
+        finally:
+            os.fsync = real_fsync
+        assert len(fsyncs) == 2
+        store.close()
+
+        api = APIServer()
+        store2 = HostStore(str(tmp_path / "nofsync"))
+        store2.load_into(api)
+        store2.attach(api)
+        os.fsync = counting_fsync
+        try:
+            fsyncs.clear()
+            api.create(_pod("p2"))
+        finally:
+            os.fsync = real_fsync
+        assert fsyncs == [], "default policy must flush, not fsync, per record"
+        store2.close()
+
+
 class _BoomFH:
     """A journal file handle whose writes fail (disk full / revoked fd)."""
 

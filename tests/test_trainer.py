@@ -61,6 +61,34 @@ def tiny_config(**kw):
     return TransformerConfig(**defaults)
 
 
+@pytest.fixture(scope="module")
+def fsdp2_bundle():
+    """Module-scoped compiled bundle for the default tiny_config on an
+    fsdp=2 mesh: (config, mesh, optimizer, initial state, jitted step).
+
+    Every `make_train_step` call returns a FRESH closure, so per-test
+    construction re-jits the identical program once per test — the r5
+    slow-tier finding. The checkpoint/data/convergence tests that all
+    train this exact (config, mesh, batch-shape) share ONE compile here.
+    The step DONATES its input state's buffers, so the bundle hands out a
+    state FACTORY, not a shared state — a donated pytree is consumed by
+    the first test that steps it."""
+    with jax.default_device(cpu_devices()[0]):
+        config = tiny_config()
+        mesh = cpu_mesh(fsdp=2)
+        optimizer = make_optimizer(learning_rate=1e-2, warmup_steps=1,
+                                   total_steps=50)
+        step = make_train_step(config, optimizer, mesh)
+
+        def fresh_state(seed: int = 0):
+            with jax.default_device(cpu_devices()[0]):
+                return init_train_state(
+                    config, optimizer, jax.random.PRNGKey(seed), mesh
+                )
+
+    return config, mesh, optimizer, fresh_state, step
+
+
 class TestMesh:
     def test_spec_parsing(self):
         spec = MeshSpec.from_string("data=2, tensor=4")
@@ -152,11 +180,13 @@ class TestShardedTraining:
             losses.append(float(metrics["loss"]))
         return losses
 
+    @pytest.mark.slow
     def test_fsdp_tensor_mesh_step(self):
         mesh = cpu_mesh(fsdp=2, tensor=2)
         losses = self._run_steps(mesh, tiny_config())
         assert all(np.isfinite(l) for l in losses)
 
+    @pytest.mark.slow
     def test_full_4axis_mesh_matches_single_device(self):
         """The same seed must produce the same loss trajectory on a
         dp x fsdp x sp x tp mesh as on one device — sharding must not change
@@ -167,6 +197,7 @@ class TestShardedTraining:
         sharded = self._run_steps(mesh, config)
         np.testing.assert_allclose(single, sharded, rtol=2e-3)
 
+    @pytest.mark.slow
     def test_pipeline_matches_single_device(self):
         """GPipe schedule over a pipeline=2 mesh: same seed, same loss
         trajectory as one device — the rotating-buffer schedule must not
@@ -177,6 +208,7 @@ class TestShardedTraining:
         piped = self._run_steps(mesh, config)
         np.testing.assert_allclose(single, piped, rtol=2e-3)
 
+    @pytest.mark.slow
     def test_pipeline_with_tensor_and_fsdp(self):
         """pipeline composes with tensor + fsdp sharding in one program."""
         config = tiny_config(n_layers=4, pipeline_microbatches=2)
@@ -184,6 +216,7 @@ class TestShardedTraining:
         losses = self._run_steps(mesh, config)
         assert all(np.isfinite(l) for l in losses)
 
+    @pytest.mark.slow
     def test_moe_expert_parallel_matches_flat(self):
         """Switch-MoE with experts sharded over the expert axis: trajectory
         matches the unsharded run (dispatch/combine all-to-alls are pure
@@ -194,6 +227,7 @@ class TestShardedTraining:
         sharded = self._run_steps(mesh, config)
         np.testing.assert_allclose(single, sharded, rtol=2e-2)
 
+    @pytest.mark.slow
     def test_moe_loss_decreases_and_balances(self):
         """MoE training converges on a fixed batch and the router spreads
         load: by the end every expert receives a nonzero token share."""
@@ -228,6 +262,7 @@ class TestShardedTraining:
         shares = jnp.bincount(choice, length=config.n_experts) / choice.shape[0]
         assert float(shares.min()) > 0.0, shares
 
+    @pytest.mark.slow
     def test_pipeline_moe_tensor_together(self):
         """PP + EP + TP in one jitted program on an 8-device mesh."""
         config = tiny_config(n_layers=4, n_experts=2, pipeline_microbatches=2)
@@ -235,6 +270,7 @@ class TestShardedTraining:
         losses = self._run_steps(mesh, config)
         assert all(np.isfinite(l) for l in losses)
 
+    @pytest.mark.slow
     @pytest.mark.parametrize(
         "policy", ["mlp_only", "save_attn", "save_attn_qkv", "save_dots"]
     )
@@ -259,6 +295,7 @@ class TestShardedTraining:
                 np.asarray(a), np.asarray(b), atol=2e-3, rtol=1e-3
             )
 
+    @pytest.mark.slow
     def test_save_attn_elides_flash_backward_rerun(self):
         """The core mechanism of the save_attn* policies: the (out, lse)
         names inside flash.py:_fwd mark the custom_vjp residuals saveable,
@@ -293,6 +330,7 @@ class TestShardedTraining:
         with pytest.raises(ValueError, match="remat_policy"):
             init_params(cfg, jax.random.PRNGKey(0))
 
+    @pytest.mark.slow
     def test_remat_policy_in_pipeline(self):
         """Selective remat composes with the GPipe schedule."""
         import dataclasses
@@ -309,12 +347,9 @@ class TestShardedTraining:
             want = float(loss_fn(params, batch, ref, mesh))
         assert abs(got - want) < 1e-5
 
-    def test_loss_decreases_on_fixed_batch(self):
-        config = tiny_config()
-        mesh = cpu_mesh(fsdp=2)
-        optimizer = make_optimizer(learning_rate=1e-2, warmup_steps=1, total_steps=50)
-        state = init_train_state(config, optimizer, jax.random.PRNGKey(0), mesh)
-        step = make_train_step(config, optimizer, mesh)
+    def test_loss_decreases_on_fixed_batch(self, fsdp2_bundle):
+        config, mesh, _optimizer, fresh_state, step = fsdp2_bundle
+        state = fresh_state()
         batch = make_example_batch(config, 4, 32, jax.random.PRNGKey(0))
         batch = jax.device_put(batch, batch_sharding(mesh))
         first = last = None
@@ -355,6 +390,7 @@ class TestFlashAttention:
         got = flash_attention(q, k, v, causal, 128, 128, True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(exp), atol=2e-5)
 
+    @pytest.mark.slow
     def test_gradients_match_reference(self):
         """The PALLAS backward kernels (dq + dk/dv) against AD of the XLA
         reference — distinct q/k/v so each gradient path is checked."""
@@ -377,6 +413,7 @@ class TestFlashAttention:
                 np.asarray(got), np.asarray(exp), atol=2e-4, err_msg=name
             )
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("seq", [100, 200])
     def test_odd_seq_len_padded(self, seq):
         """Sequence lengths that don't tile by 128: the kernel pads + masks
@@ -412,14 +449,11 @@ class TestFlashAttention:
 
 
 class TestCheckpoint:
-    def test_save_restore_roundtrip(self, tmp_path):
+    def test_save_restore_roundtrip(self, tmp_path, fsdp2_bundle):
         from training_operator_tpu.trainer.checkpoint import Checkpointer
 
-        config = tiny_config()
-        optimizer = make_optimizer(warmup_steps=1, total_steps=50)
-        mesh = cpu_mesh(fsdp=2)
-        state = init_train_state(config, optimizer, jax.random.PRNGKey(0), mesh)
-        step = make_train_step(config, optimizer, mesh)
+        config, mesh, optimizer, fresh_state, step = fsdp2_bundle
+        state = fresh_state()
         batch = make_example_batch(config, 4, 32, jax.random.PRNGKey(0))
         batch = jax.device_put(batch, batch_sharding(mesh))
         for _ in range(3):
@@ -435,7 +469,7 @@ class TestCheckpoint:
             np.asarray(restored.params["embed"]), np.asarray(state.params["embed"]), atol=0
         )
 
-    def test_overwrite_same_step_is_crash_safe(self, tmp_path):
+    def test_overwrite_same_step_is_crash_safe(self, tmp_path, fsdp2_bundle):
         """Overwriting a step (the forced final save landing on the interval
         save's step) must keep the old copy durable until the new one is
         written — and leave no stale directory behind."""
@@ -443,10 +477,8 @@ class TestCheckpoint:
 
         from training_operator_tpu.trainer.checkpoint import Checkpointer
 
-        config = tiny_config()
-        optimizer = make_optimizer(warmup_steps=1, total_steps=50)
-        mesh = cpu_mesh(fsdp=2)
-        state = init_train_state(config, optimizer, jax.random.PRNGKey(0), mesh)
+        config, mesh, optimizer, fresh_state, _step = fsdp2_bundle
+        state = fresh_state()
         ckpt = Checkpointer(str(tmp_path / "ckpt"), max_to_keep=1)
         assert ckpt.save(state, force=True)
         # Leftover stale dir from a hypothetical interrupted overwrite is
@@ -484,6 +516,7 @@ class TestCheckpoint:
         assert not os.path.isdir(str(tmp_path / "c3") + ".stale.10")
         ckpt3.close()
 
+    @pytest.mark.slow
     def test_elastic_remesh_restore(self, tmp_path):
         """Resize story: train on a 4-way mesh, restore onto a 2-way mesh;
         the restored state must continue training bit-compatibly."""
@@ -519,16 +552,13 @@ class TestData:
         assert len(shards[0]) + len(shards[1]) == 10
         assert not set(map(tuple, shards[0])) & set(map(tuple, shards[1]))
 
-    def test_loader_batches_feed_train_step(self):
+    def test_loader_batches_feed_train_step(self, fsdp2_bundle):
         from training_operator_tpu.trainer.data import DataLoader, TokenDataset
 
-        config = tiny_config()
-        mesh = cpu_mesh(fsdp=2)
+        config, mesh, _optimizer, fresh_state, step = fsdp2_bundle
+        state = fresh_state()
         ds = TokenDataset.synthetic(config.vocab_size, seq_len=32, num_rows=16)
         loader = DataLoader(ds, batch_size=4, mesh=mesh)
-        optimizer = make_optimizer(warmup_steps=1, total_steps=50)
-        state = init_train_state(config, optimizer, jax.random.PRNGKey(0), mesh)
-        step = make_train_step(config, optimizer, mesh)
         n = 0
         for batch in loader:
             state, metrics = step(state, batch)
@@ -636,6 +666,7 @@ class TestVisionFamily:
         batch = synthetic_mnist(jax.random.PRNGKey(1), 64, config)
         return config, params, opt_state, step, batch
 
+    @pytest.mark.slow
     def test_learns_synthetic_digits(self):
         _, params, opt_state, step, batch = self._setup()
         acc = None
@@ -693,6 +724,7 @@ class TestRematNames:
 
 
 class TestTrainerE2EBench:
+    @pytest.mark.slow
     def test_e2e_loop_runs_with_checkpoints_on_cpu(self, tmp_path):
         """The trainer_e2e bench block's loop (dataio -> jitted step ->
         periodic orbax save) on the CPU smoke path: completes, checkpoints

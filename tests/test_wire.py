@@ -303,19 +303,20 @@ class TestHTTPApi:
         assert [e.type for e in events] == ["Added"]  # Node filtered out
         assert events[0].obj.name == "w-0"
         # After the server forgets the session (explicit unwatch here; TTL
-        # GC in production), drain() transparently re-subscribes and
-        # RELISTS — the ListAndWatch reconnect contract: existing state
-        # comes back as synthetic Added events (never NotFoundError killing
+        # GC in production), drain() transparently re-subscribes presenting
+        # its ResourceVersion watermark — the informer resume contract: the
+        # server replays only events newer than the watermark. Everything
+        # here was already observed, so the heal delivers NOTHING (the old
+        # O(cluster) behavior re-announced w-0; never NotFoundError killing
         # the operator loop, never silently-lost events wedging the
         # expectations cache until its TTL).
         remote.unwatch(wq)
-        relisted = wq.drain()
-        assert [e.type for e in relisted] == ["Added"]  # w-0 re-announced
-        assert relisted[0].obj.name == "w-0"
+        assert wq.drain() == []  # delta resume: no redundant re-announcement
         remote.create(Node(metadata=ObjectMeta(name="n10"), capacity={"cpu": 1}))
         cluster.api.delete("Pod", "ns1", "w-0")
         # Explicit timeout = explicit fetch (bare drain() may defer to the
-        # shared session's next block window).
+        # shared session's next block window). Events written AFTER the
+        # heal flow normally — the resumed session is live.
         events = wq.drain(timeout=1.0)
         assert [e.type for e in events] == ["Deleted"]  # kinds filter survived
 

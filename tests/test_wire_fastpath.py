@@ -256,11 +256,13 @@ class TestWatchDrainNotRetried:
             wq.drain(timeout=1.0)
         assert boom.used, "poisoned watch connection was never exercised"
         assert remote._shared_watch._needs_relist is True
-        # Recovery: a write that raced the failure is re-announced by the
-        # relist on the next drain — delayed, never lost.
+        # Recovery: the next drain heals by watermark resume — the write
+        # that raced the failure is REPLAYED from the server's resume ring
+        # (delayed, never lost), while "pre" (already observed, watermark
+        # covers it) is NOT duplicated: exactly-once, not at-least-once.
         cluster.api.create(ConfigMap(metadata=ObjectMeta(name="during-outage")))
         names = {e.obj.metadata.name for e in wq.drain(timeout=1.0)}
-        assert "during-outage" in names and "pre" in names
+        assert "during-outage" in names and "pre" not in names
         remote.unwatch(wq)
 
 

@@ -1,0 +1,292 @@
+"""ResourceVersion-resumable watch sessions (the informer resume contract).
+
+The wire used to heal EVERY reaped/reconnected session by relisting every
+kind, every object — O(cluster) per reconnect. These tests pin the O(delta)
+protocol: clients present per-kind watermarks on resubscribe, the server
+replays only the missed events from its bounded per-kind ring, and the
+"410 too old → full relist" arm fires only when the ring was outrun (and
+exactly once — a relist rebases the watermarks so the next reconnect is a
+delta again). Observability rides the `training_wire_resume_*` counters,
+the same ones the `wire_resume` bench block reports.
+"""
+
+import pytest
+
+from training_operator_tpu.api.jobs import ObjectMeta
+from training_operator_tpu.cluster import wire
+from training_operator_tpu.cluster.apiserver import WatchEvent
+from training_operator_tpu.cluster.httpapi import (
+    ApiHTTPServer,
+    CachedReadAPI,
+    RemoteAPIServer,
+)
+from training_operator_tpu.cluster.objects import ConfigMap
+from training_operator_tpu.cluster.runtime import Cluster
+from training_operator_tpu.utils import metrics
+
+
+def _cm(i):
+    return ConfigMap(metadata=ObjectMeta(name=f"cm-{i}"), data={"i": str(i)})
+
+
+@pytest.fixture()
+def served():
+    cluster = Cluster()
+    server = ApiHTTPServer(cluster.api, port=0)
+    try:
+        yield cluster, server
+    finally:
+        server.close()
+
+
+def _counters():
+    return {
+        "delta": metrics.wire_resume_delta.total(),
+        "replayed": metrics.wire_resume_replayed.total(),
+        "too_old": metrics.wire_resume_too_old.total(),
+    }
+
+
+def _deltas(before):
+    now = _counters()
+    return {k: now[k] - before[k] for k in before}
+
+
+class TestDeltaResume:
+    def test_reap_heals_by_delta_not_relist(self, served):
+        """The steady case the acceptance pins: reconnect after a reap
+        replays ONLY the missed events — delta_total climbs, too_old stays
+        zero, and nothing already observed is re-delivered."""
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=5.0)
+        wq = remote.watch(kinds=["ConfigMap"])
+        for i in range(5):
+            cluster.api.create(_cm(i))
+        assert len(wq.drain(timeout=1.0)) == 5  # watermark now current
+
+        server.reap_all_sessions()
+        for i in range(5, 8):  # written while the session is gone
+            cluster.api.create(_cm(i))
+
+        before = _counters()
+        lists = []
+        orig_list = remote.list
+        remote.list = lambda *a, **k: lists.append(a) or orig_list(*a, **k)
+        events = wq.drain(timeout=1.0)
+        remote.list = orig_list
+        assert sorted(e.obj.metadata.name for e in events) == [
+            "cm-5", "cm-6", "cm-7"
+        ], "delta resume must replay exactly the missed events"
+        got = _deltas(before)
+        assert got["delta"] == 1 and got["replayed"] == 3 and got["too_old"] == 0
+        assert lists == [], "a delta resume must not relist anything"
+
+    def test_watermark_survives_session_reap(self, served):
+        """The watermark lives client-side, not in the server session:
+        repeated reaps each heal by delta, never degrading to relist."""
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=5.0)
+        wq = remote.watch(kinds=["ConfigMap"])
+        before = _counters()
+        for round_ in range(3):
+            cluster.api.create(_cm(round_))
+            assert len(wq.drain(timeout=1.0)) == 1
+            server.reap_all_sessions()
+        cluster.api.create(_cm(99))
+        events = wq.drain(timeout=1.0)
+        assert [e.obj.metadata.name for e in events] == ["cm-99"]
+        got = _deltas(before)
+        # One delta heal per reap survived (3 reaps), zero too-old: the
+        # watermark carried across every session loss.
+        assert got["delta"] == 3 and got["too_old"] == 0
+
+    def test_lost_drain_response_healed_by_delta(self, served):
+        """ADVICE r5's destructive-drain case, upgraded: a poll whose
+        response is lost marks `_needs_relist`, but the heal now resumes
+        from the watermark — the lost events come back from the ring."""
+        import http.client
+
+        from training_operator_tpu.cluster.httpapi import ApiUnavailableError
+
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=5.0)
+        wq = remote.watch(kinds=["ConfigMap"])
+        cluster.api.create(_cm(0))
+        assert len(wq.drain(timeout=1.0)) == 1
+
+        class _Boom:
+            def request(self, *a, **k):
+                raise http.client.RemoteDisconnected("stale keep-alive")
+
+            def close(self):
+                pass
+
+        cluster.api.create(_cm(1))
+        remote._local.conn_watch = _Boom()
+        with pytest.raises(ApiUnavailableError):
+            wq.drain(timeout=1.0)
+        before = _counters()
+        events = wq.drain(timeout=1.0)
+        assert [e.obj.metadata.name for e in events] == ["cm-1"]
+        assert _deltas(before) == {"delta": 1, "replayed": 1, "too_old": 0}
+
+
+class TestRingOutrun:
+    def test_outrun_forces_exactly_one_relist_then_deltas_again(self):
+        """More events missed than the ring retains: the 410-style arm
+        fires ONCE (full relist, every kind listed exactly once), the
+        watermarks rebase, and the NEXT reap is back to O(delta)."""
+        cluster = Cluster()
+        server = ApiHTTPServer(cluster.api, port=0, resume_ring_size=4)
+        try:
+            remote = RemoteAPIServer(server.url, timeout=5.0)
+            wq = remote.watch(kinds=["ConfigMap"])
+            cluster.api.create(_cm(0))
+            assert len(wq.drain(timeout=1.0)) == 1
+
+            server.reap_all_sessions()
+            for i in range(1, 11):  # 10 missed >> ring of 4
+                cluster.api.create(_cm(i))
+
+            before = _counters()
+            lists = []
+            orig_list = remote.list
+            remote.list = lambda *a, **k: lists.append(a[0]) or orig_list(*a, **k)
+            events = wq.drain(timeout=1.0)
+            remote.list = orig_list
+            # Relist arm: full state re-announced (synthetic Added, seq 0).
+            assert {e.obj.metadata.name for e in events} == {
+                f"cm-{i}" for i in range(11)
+            }
+            got = _deltas(before)
+            assert got["too_old"] == 1 and got["delta"] == 0
+            assert sorted(lists) == sorted(wire.KIND_REGISTRY), (
+                "exactly one relist: each kind listed exactly once"
+            )
+
+            # Recovered: the relist rebased the watermarks, so the next
+            # reap heals by delta — one outrun must not poison the future.
+            server.reap_all_sessions()
+            cluster.api.create(_cm(99))
+            before = _counters()
+            events = wq.drain(timeout=1.0)
+            assert [e.obj.metadata.name for e in events] == ["cm-99"]
+            got = _deltas(before)
+            assert got["delta"] == 1 and got["too_old"] == 0
+        finally:
+            server.close()
+
+    def test_unwatched_kind_churn_cannot_outrun_filtered_session(self):
+        """A kind-filtered session's resume is judged against ITS kinds
+        only: unrelated churn past the ring bound must not degrade a
+        Pod-only watcher to O(cluster) relists forever."""
+        from training_operator_tpu.api.jobs import ObjectMeta as OM
+        from training_operator_tpu.cluster.objects import Node
+
+        cluster = Cluster()
+        server = ApiHTTPServer(cluster.api, port=0, resume_ring_size=4)
+        try:
+            cluster.api.create(Node(metadata=OM(name="n0"), capacity={"cpu": 1}))
+            node_seq = cluster.api.event_seq()
+            for i in range(10):  # ConfigMap churn outruns the size-4 ring
+                cluster.api.create(_cm(i))
+            ring = server._ring
+            # Scoped to Node: the ConfigMap floor is irrelevant — delta OK.
+            out = ring.replay({"Node": node_seq}, base=0, kinds=["Node"])
+            assert out == []
+            # Unscoped: the outrun ConfigMap ring forces too-old.
+            assert ring.replay({"Node": node_seq}, base=0, kinds=None) is None
+        finally:
+            server.close()
+
+    def test_new_server_incarnation_epoch_mismatch_relists(self):
+        """Watermarks are scoped to one ring epoch: a new ApiHTTPServer
+        (host restart) must answer too-old no matter how the seq numbers
+        compare, and the client must converge by relist."""
+        cluster = Cluster()
+        server = ApiHTTPServer(cluster.api, port=0)
+        remote = RemoteAPIServer(server.url, timeout=5.0)
+        wq = remote.watch(kinds=["ConfigMap"])
+        cluster.api.create(_cm(0))
+        assert len(wq.drain(timeout=1.0)) == 1
+        server.close()
+
+        server2 = ApiHTTPServer(cluster.api, port=0)
+        try:
+            # Same port is gone; point the client at the new incarnation
+            # the way a restarted host announces a fresh URL.
+            remote2 = RemoteAPIServer(server2.url, timeout=5.0)
+            remote2._shared_watch = remote._shared_watch
+            remote._shared_watch._remote = remote2
+            before = _counters()
+            events = wq.drain(timeout=1.0)
+            assert {e.obj.metadata.name for e in events} == {"cm-0"}
+            assert _deltas(before)["too_old"] == 1
+        finally:
+            server2.close()
+
+    def test_resume_disabled_client_always_relists(self, served):
+        """`RemoteAPIServer(resume=False)` pins the pre-resume behavior —
+        the bench's forced-relist comparison leg."""
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=5.0, resume=False)
+        wq = remote.watch(kinds=["ConfigMap"])
+        cluster.api.create(_cm(0))
+        assert len(wq.drain(timeout=1.0)) == 1
+        server.reap_all_sessions()
+        cluster.api.create(_cm(1))
+        before = _counters()
+        events = wq.drain(timeout=1.0)
+        # Relist: the full state comes back, including what was seen.
+        assert {e.obj.metadata.name for e in events} == {"cm-0", "cm-1"}
+        got = _deltas(before)
+        assert got["delta"] == 0 and got["too_old"] == 0
+
+
+class TestExactlyOnce:
+    def test_replay_overlap_deduplicated_by_seq(self, served):
+        """The server subscribes the fresh session BEFORE computing the
+        delta, so an event written in that window travels twice (replay +
+        session). The watermark dedup must collapse it to exactly one
+        delivery."""
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=5.0)
+        wq = remote.watch(kinds=["ConfigMap"])
+        shared = remote._shared_watch
+        cluster.api.create(_cm(0))
+        assert len(wq.drain(timeout=1.0)) == 1
+        ev = WatchEvent("Added", "ConfigMap", _cm(7), seq=999)
+        with shared._lock:
+            shared._distribute(ev)
+            shared._distribute(ev)  # the overlap copy
+        assert len(wq.drain(timeout=0.0)) == 1, (
+            "an event distributed twice (replay overlap) must reach "
+            "consumers exactly once"
+        )
+
+    def test_lister_cache_not_double_applied_and_no_ghosts(self, served):
+        """CachedReadAPI over a reap: replayed Modified lands once, a
+        Deleted replay expires the mirror entry — correct without any
+        RELIST_RESET (the delta path never clears the mirror)."""
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=5.0)
+        cached = CachedReadAPI(remote)
+        pump = remote.watch()  # the manager-tick analogue that pumps
+        cluster.api.create(_cm(0))
+        cluster.api.create(_cm(1))
+        pump.drain(timeout=1.0)
+        assert {o.metadata.name for o in cached.list("ConfigMap")} == {"cm-0", "cm-1"}
+
+        server.reap_all_sessions()
+        live = cluster.api.get("ConfigMap", "default", "cm-0")
+        live.data["i"] = "updated"
+        cluster.api.update(live)
+        cluster.api.delete("ConfigMap", "default", "cm-1")
+
+        before = _counters()
+        pump.drain(timeout=1.0)
+        out = cached.list("ConfigMap")
+        assert [o.metadata.name for o in out] == ["cm-0"], "ghost survived delta"
+        assert out[0].data["i"] == "updated"
+        got = _deltas(before)
+        assert got["delta"] == 1 and got["replayed"] == 2 and got["too_old"] == 0

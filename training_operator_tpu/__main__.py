@@ -109,6 +109,24 @@ def parse_args(argv=None) -> argparse.Namespace:
                          "write-ahead journal) and restore it on startup, so "
                          "a host crash/restart does not erase the cluster "
                          "(the etcd-durability analogue; omit = volatile)")
+    ap.add_argument("--compact-every", type=int, default=None,
+                    help="host role: rotate journal into a fresh snapshot "
+                         "after this many records (default 4096)")
+    ap.add_argument("--compact-max-journal-bytes", type=int, default=None,
+                    help="host role: also compact once the journal exceeds "
+                         "this many bytes — a few huge objects must not "
+                         "grow it unboundedly (default 64MiB; 0 disables)")
+    ap.add_argument("--journal-fsync", dest="journal_fsync",
+                    action="store_true", default=None,
+                    help="host role: fsync the journal per record (survives "
+                         "power loss; default flushes only — survives "
+                         "kill -9 — because per-record fsync gates every "
+                         "control-plane write on disk latency)")
+    ap.add_argument("--watch-ring-size", type=int, default=None,
+                    help="host role: watch events retained per kind for "
+                         "ResourceVersion delta resume; a reconnect older "
+                         "than the ring falls back to a full relist "
+                         "(default 8192)")
     ap.add_argument("--api-server", default=None, metavar="URL",
                     help="operator role: base URL of the serving host")
     ap.add_argument("--api-token", default=None,
@@ -202,6 +220,14 @@ def build_config(args: argparse.Namespace) -> OperatorConfig:
         cfg.namespace = args.namespace
     if args.controller_threads is not None:
         cfg.controller_threads = args.controller_threads
+    if args.compact_every is not None:
+        cfg.compact_every = args.compact_every
+    if args.compact_max_journal_bytes is not None:
+        cfg.compact_max_journal_bytes = args.compact_max_journal_bytes
+    if args.journal_fsync is not None:
+        cfg.journal_fsync = args.journal_fsync
+    if args.watch_ring_size is not None:
+        cfg.watch_ring_size = args.watch_ring_size
     if args.health_probe_port is not None:
         cfg.health_port = args.health_probe_port
     if args.health_probe_bind_address is not None:
@@ -410,6 +436,20 @@ def _install_stop() -> threading.Event:
     return stop
 
 
+def make_host_store(cfg: OperatorConfig, state_dir: str):
+    """The HostStore exactly as run_host constructs it — factored out so
+    the knob round-trip tests (test_config_knobs.py pattern) exercise the
+    REAL flag->config->store path, not a parallel construction."""
+    from training_operator_tpu.cluster.store import HostStore
+
+    return HostStore(
+        state_dir,
+        compact_every=cfg.compact_every,
+        compact_max_bytes=cfg.compact_max_journal_bytes,
+        fsync_per_record=cfg.journal_fsync,
+    )
+
+
 def run_host(args, cfg) -> int:
     """Host role: the substrate process — API server over HTTP, default
     scheduler, sim kubelet, gang scheduler; admission (defaulting +
@@ -430,9 +470,7 @@ def run_host(args, cfg) -> int:
     cluster = build_cluster(args, clock=WallClock())
     store = None
     if args.state_dir:
-        from training_operator_tpu.cluster.store import HostStore
-
-        store = HostStore(args.state_dir)
+        store = make_host_store(cfg, args.state_dir)
         store.load_into(cluster.api)
         store.attach(cluster.api)
         # Fold the replayed journal (and any torn tail) into a fresh
@@ -483,6 +521,7 @@ def run_host(args, cfg) -> int:
     server = ApiHTTPServer(
         cluster.api, port=args.serve_port, bind=args.serve_bind, token=token,
         now_fn=cluster.clock.now, tls=tls, chaos=chaos,
+        resume_ring_size=cfg.watch_ring_size,
     )
     if tls is not None:
         from training_operator_tpu.cluster import certs

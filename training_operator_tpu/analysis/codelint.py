@@ -14,6 +14,12 @@ Rules (all ERROR; the tree must stay green — `make lint` runs this):
   CL003 naked-thread    `threading.Thread(...)` without `daemon=True` and
         with no `.join(...)` in the same function: such a thread outlives
         shutdown and hangs interpreter exit.
+  CL004 wire-internals-import    importing an underscore-prefixed name from
+        the wire modules (`cluster.httpapi` facade or its `cluster.wire_*`
+        backends) anywhere outside those modules. The round-6 split of
+        httpapi.py holds only if everything else consumes the facade's
+        public surface — a private import across the seam re-welds the
+        modules together and breaks silently on the next internal rename.
 
 Run: `python -m training_operator_tpu.analysis.codelint [paths...]`
 (defaults to the `training_operator_tpu` package). Exit 1 on findings.
@@ -32,6 +38,19 @@ CONTROL_LOOP_PACKAGES = ("controllers", "engine", "runtime", "scheduler")
 
 # Attributes whose assignment counts as snapshot mutation.
 SNAPSHOT_MUTABLE_ATTRS = ("free", "nodes", "slices")
+
+# The wire layer's module seams (CL004): the httpapi facade and the four
+# modules behind it. Matched by module path suffix so both absolute imports
+# and the files' own package_rel identify consistently.
+WIRE_MODULES = ("httpapi", "wire_server", "wire_transport", "wire_watch",
+                "wire_runtime")
+
+
+def _is_wire_module_path(module: str) -> bool:
+    """`module` (dotted, from an ImportFrom) names one of the wire seam
+    modules."""
+    tail = module.rsplit(".", 1)[-1] if module else ""
+    return tail in WIRE_MODULES and ("cluster" in module.split(".") or module == tail)
 
 
 @dataclass(frozen=True)
@@ -106,8 +125,29 @@ def check_source(path: str, source: str, package_rel: Optional[str] = None) -> L
 
     in_control_pkg = any(f"{pkg}/" in rel for pkg in CONTROL_LOOP_PACKAGES)
     in_scheduler = "scheduler/" in rel
+    # The wire modules may import each other's internals (one subsystem,
+    # four files); everyone else goes through the httpapi facade's public
+    # names.
+    in_wire_layer = any(
+        rel.endswith(f"cluster/{m}.py") for m in WIRE_MODULES
+    )
 
     for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.ImportFrom)
+            and not in_wire_layer
+            and node.module
+            and _is_wire_module_path(node.module)
+        ):
+            for alias in node.names:
+                if alias.name.startswith("_"):
+                    findings.append(Finding(
+                        path, node.lineno, "CL004",
+                        f"import of wire-layer internal "
+                        f"{node.module}.{alias.name} outside the wire "
+                        f"modules; use the cluster.httpapi facade's public "
+                        f"surface",
+                    ))
         if isinstance(node, ast.Call) and _is_time_sleep(node) and in_control_pkg:
             findings.append(Finding(
                 path, node.lineno, "CL001",

@@ -58,6 +58,13 @@ class WatchEvent:
     # managers skip re-enqueueing these to avoid self-echo reconcile storms
     # (the role GenerationChangedPredicate plays in controller-runtime).
     status_only: bool = False
+    # Per-APIServer monotonic event sequence, assigned by _notify. This is
+    # the wire watch layer's ResourceVersion watermark: a client that has
+    # observed seq N has observed EVERY event up to N (deletes don't bump
+    # the object rv counter, so the object rv alone can't order a stream
+    # that includes Deleted events). 0 = synthesized event (client-side
+    # relist), never a store notification.
+    seq: int = 0
 
 
 class WatchQueue:
@@ -130,6 +137,10 @@ class APIServer:
         # so selector lists touch only matching objects.
         self._by_label: Dict[Tuple[str, str, str], set] = {}
         self._rv_value = 0
+        # Watch-event sequence (see WatchEvent.seq): distinct from the rv
+        # counter because deletes notify without bumping rv, and restored
+        # objects notify at their restored rv.
+        self._event_seq = 0
         self._watchers: List[WatchQueue] = []
         self._events: List[Event] = []
         self._lock = threading.RLock()
@@ -283,8 +294,17 @@ class APIServer:
         with self._lock:
             return self._rv_value
 
+    def event_seq(self) -> int:
+        """The last assigned watch-event sequence number — the 'now' a
+        resume ring is born at (wire_server._ResumeRing)."""
+        with self._lock:
+            return self._event_seq
+
     def _notify(self, ev_type: str, obj: Any, status_only: bool = False) -> None:
-        ev = WatchEvent(ev_type, obj.KIND, obj, status_only=status_only)
+        self._event_seq += 1
+        ev = WatchEvent(
+            ev_type, obj.KIND, obj, status_only=status_only, seq=self._event_seq
+        )
         for w in self._watchers:
             w.push(ev)
         self._watch_cond.notify_all()

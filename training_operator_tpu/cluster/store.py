@@ -41,7 +41,9 @@ Durability level: `flush()` per record (survives kill -9 of the host, the
 failure mode HA actually exercises) + fsync on snapshot rotation. Full
 power-loss fsync-per-write is deliberately not the default — it would gate
 every control-plane write on disk latency, and the reference's own etcd
-batches fsyncs too.
+batches fsyncs too — but is available as the `fsync_per_record` knob
+(OperatorConfig.journal_fsync / --journal-fsync). Compaction cadence and
+the journal-bytes bound are knobs too: see __init__.
 """
 
 from __future__ import annotations
@@ -92,14 +94,33 @@ class HostStore:
         store.maybe_compact(api)  # called periodically from the host loop
     """
 
-    def __init__(self, root: str, compact_every: int = 4096):
+    def __init__(
+        self,
+        root: str,
+        compact_every: int = 4096,
+        compact_max_bytes: int = 64 * 1024 * 1024,
+        fsync_per_record: bool = False,
+    ):
+        """Durability knobs (OperatorConfig.compact_every /
+        .compact_max_journal_bytes / .journal_fsync + the matching CLI
+        flags): compaction fires when EITHER the record count or the
+        journal byte size exceeds its bound — record count alone lets a
+        few huge objects grow the journal unboundedly between compacts
+        (compact_max_bytes=0 disables the bytes trigger). fsync_per_record
+        upgrades the per-record flush to a real fsync: survives power
+        loss, not just kill -9, at the price of gating every control-plane
+        write on disk latency (the reference's etcd batches fsyncs for
+        the same reason — this is deliberately opt-in)."""
         self.root = root
         os.makedirs(root, exist_ok=True)
         self.compact_every = compact_every
+        self.compact_max_bytes = compact_max_bytes
+        self.fsync_per_record = fsync_per_record
         self._lock = threading.Lock()
         self._journal_fh = None
         self._gen = 0
         self._records_since_snapshot = 0
+        self._bytes_since_snapshot = 0
         # Latched on the first journal write failure; read by the host main
         # loop, which exits rather than keep serving writes whose journal
         # records are silently missing (see JournalWriteError).
@@ -279,9 +300,12 @@ class HostStore:
             fh = self._journal_fh
             if fh is None:
                 return
+            line = json.dumps(rec) + "\n"
             try:
-                fh.write(json.dumps(rec) + "\n")
+                fh.write(line)
                 fh.flush()
+                if self.fsync_per_record:
+                    os.fsync(fh.fileno())
             except (OSError, ValueError) as e:
                 # ValueError: write on a closed fd. The sink is write-ahead,
                 # so the caller aborts the in-memory apply — but the journal
@@ -296,15 +320,27 @@ class HostStore:
                 )
                 raise JournalWriteError(f"journal write failed: {e}") from e
             self._records_since_snapshot += 1
+            # json.dumps defaults to ensure_ascii, so the line is pure
+            # ASCII: len(line) IS the byte count — no second encode of a
+            # possibly-megabyte record on the write-ahead hot path.
+            self._bytes_since_snapshot += len(line)
 
     # -- compaction --------------------------------------------------------
 
     def maybe_compact(self, api: APIServer) -> bool:
-        """Rotate journal into a fresh snapshot once enough records have
-        accumulated. Called from the host main loop (never a handler
-        thread)."""
+        """Rotate journal into a fresh snapshot once enough has
+        accumulated — by record count OR by journal bytes, whichever bound
+        trips first (a handful of megabyte-scale objects must not grow the
+        journal unboundedly while the record counter idles). Called from
+        the host main loop (never a handler thread)."""
         with self._lock:
-            if self.degraded or self._records_since_snapshot < self.compact_every:
+            if self.degraded:
+                return False
+            due = self._records_since_snapshot >= self.compact_every or (
+                self.compact_max_bytes
+                and self._bytes_since_snapshot >= self.compact_max_bytes
+            )
+            if not due:
                 return False
         self.compact(api)
         return True
@@ -348,6 +384,7 @@ class HostStore:
                 )
                 old_gen, self._gen = self._gen, new_gen
                 self._records_since_snapshot = 0
+                self._bytes_since_snapshot = 0
         snap = encode_snapshot(refs)
         snap["gen"] = self._gen  # journals >= this gen are NOT in the snapshot
 

@@ -394,6 +394,11 @@ def encode_watch_event(ev) -> Dict[str, Any]:
         "type": ev.type,
         "kind": ev.kind,
         "status_only": ev.status_only,
+        # The resume watermark (apiserver.WatchEvent.seq): clients track the
+        # max seq observed per kind and present it on resubscribe so the
+        # server can replay only the delta. Old payloads without it decode
+        # to 0 (= not resumable past this event).
+        "seq": getattr(ev, "seq", 0),
         "object": encode(ev.obj),
     }
 
@@ -437,4 +442,5 @@ def decode_watch_event(d: Dict[str, Any]):
         kind=d["kind"],
         obj=decode(d["object"]),
         status_only=bool(d.get("status_only", False)),
+        seq=int(d.get("seq", 0)),
     )

@@ -54,8 +54,10 @@ class RemoteWatchQueue:
 
     `drain()` semantics are unchanged for consumers: returns pending
     events, long-polling briefly when idle; after a server-side session
-    loss it transparently resubscribes and RELISTS (ListAndWatch), so
-    lost events can delay work but never wedge it.
+    loss it transparently resubscribes with its ResourceVersion watermark
+    and receives the missed DELTA (falling back to a full relist only when
+    the server's resume ring was outrun — the informer's "410 too old"
+    arm), so lost events can delay work but never wedge it.
     """
 
     def __init__(self, shared: "_SharedWatch", kinds: Optional[List[str]] = None):
@@ -133,11 +135,26 @@ class _SharedWatch:
         self._remote = remote
         self.poll_timeout = poll_timeout
         self.min_block_interval = min_block_interval
+        # Present per-kind watermarks on resubscribe so the server replays
+        # only the delta; False pins the pre-resume behavior (every
+        # reconnect heals by full relist) — the bench's forced-relist
+        # comparison leg and the escape hatch against an old host.
         self.resume = resume
         self.watch_id: Optional[str] = None
         self._subs: List[RemoteWatchQueue] = []
         self._needs_relist = False
         self._last_block = -float("inf")
+        # Per-kind ResourceVersion watermark: the max WatchEvent.seq this
+        # client has DISTRIBUTED (i.e. its consumers have observed), per
+        # kind. Survives session reaps by construction — it lives here, not
+        # in the server session — which is what makes reconnect O(delta).
+        self._watermarks: Dict[str, int] = {}
+        # Ring epoch + session-base seq from the server's subscribe
+        # response: watermarks are only meaningful against the same server
+        # incarnation, and `base` covers kinds with no observed events yet
+        # (their knowledge came from post-subscribe LIST primes).
+        self._epoch: Optional[str] = None
+        self._base = 0
         self._lock = threading.RLock()
 
     # -- subscriber management --------------------------------------------
@@ -162,9 +179,23 @@ class _SharedWatch:
                         PermissionError):
                     pass  # server GC reaps stale sessions anyway
 
-    def _open(self) -> None:
-        payload = self._remote._request("POST", "/watches", body={"kinds": None})
+    def _open(self, resume: bool = False) -> Dict[str, Any]:
+        body: Dict[str, Any] = {"kinds": None}
+        if resume and self.resume and self._epoch is not None:
+            body["resume"] = dict(self._watermarks)
+            body["epoch"] = self._epoch
+            body["base"] = self._base
+        payload = self._remote._request("POST", "/watches", body=body)
         self.watch_id = payload["watch_id"]
+        epoch = payload.get("epoch")
+        if epoch != self._epoch:
+            # First open, or a NEW server incarnation (host restart): seq
+            # counters restarted, so the old watermarks are meaningless —
+            # and must not be allowed to dedup-drop the new epoch's events.
+            self._epoch = epoch
+            self._base = int(payload.get("seq", 0) or 0)
+            self._watermarks.clear()
+        return payload
 
     # -- pumping ----------------------------------------------------------
 
@@ -172,7 +203,8 @@ class _SharedWatch:
         with self._lock:
             if q not in self._subs:
                 # Drained after unwatch (or a fresh consumer of a dead
-                # handle): rejoin, and heal the unobserved gap by relist.
+                # handle): rejoin, and heal the unobserved gap by watermark
+                # resume (full relist only when the ring was outrun).
                 self._subs.append(q)
                 self._needs_relist = True
             if not q._local:
@@ -195,11 +227,11 @@ class _SharedWatch:
             return out
 
     def _pump(self, t: float) -> None:
-        if self.watch_id is None:
-            self._open()
-            self._needs_relist = True
-        if self._needs_relist:
-            self._relist()
+        if self.watch_id is None or self._needs_relist:
+            # Dead handle (rejoin after unwatch), a lost drain response, or
+            # an earlier heal that couldn't finish: close the gap before
+            # polling again.
+            self._heal()
             return
         if t > 0:
             # Count the attempt, success or not: a 5xx storm must not turn
@@ -213,27 +245,61 @@ class _SharedWatch:
         except ApiUnavailableError:
             # The drain died mid-flight on a transport failure. The server
             # may already have emptied the queue into the lost response —
-            # those events are unrecoverable via the session, so the ONLY
-            # safe recovery is a relist (marked now, run on the next drain).
-            # A transparent GET retry here (the pre-fix behavior) would
-            # return an empty drain and silently drop them instead.
+            # those events are unrecoverable via the SESSION, but they are
+            # still in the server's resume ring: mark the gap now, heal on
+            # the next drain by watermark resume (relist only if the ring
+            # was outrun). A transparent GET retry here (the pre-fix
+            # behavior) would return an empty drain and silently drop them.
             self._needs_relist = True
             raise
         except NotFoundError:
             # Session reaped server-side (idle past session_ttl, host
-            # restart, injected chaos). Re-subscribe, then RELIST and
-            # synthesize Added events for everything that exists — the
-            # informer ListAndWatch contract on reconnect. Without the
-            # relist, events lost in the gap (above all pod create-echoes)
-            # would wedge the engine's expectations cache until its 5-min
-            # TTL: a job-key resync re-ENQUEUES work but cannot OBSERVE
-            # the pods the lost events carried.
-            self._needs_relist = True
-            self._open()
-            self._relist()
+            # restart, injected chaos). Heal immediately: resubscribe
+            # presenting the watermarks; the server replays the delta, or
+            # answers too-old and the relist arm runs. The server just
+            # 404'd this session, so the heal skips the courtesy DELETE.
+            self._heal(session_known_dead=True)
             return
         for d in payload["events"]:
             self._distribute(wire.decode_watch_event(d))
+
+    def _heal(self, session_known_dead: bool = False) -> None:
+        """Close an observation gap (reaped session, lost drain response,
+        rejoined handle): open a FRESH session presenting the per-kind
+        watermarks. A "delta" answer replays exactly the missed events —
+        O(gap), the informer resume contract — and anything else (ring
+        outrun → too_old, resume disabled, an old or restarted host) falls
+        back to the existing full-relist arm. The flag is cleared only when
+        one of the two heals fully succeeds; a failure mid-heal retries on
+        the next drain."""
+        self._needs_relist = True
+        old, self.watch_id = self.watch_id, None
+        if old is not None and not session_known_dead:
+            # The abandoned (but possibly still-live) session would only be
+            # GC'd at session_ttl; delete best-effort so its queue stops
+            # accumulating now. Skipped when the server already 404'd it —
+            # that DELETE would be a guaranteed-wasted round trip on the
+            # reconnect path the bench measures.
+            try:
+                self._remote._request("DELETE", f"/watches/{old}")
+            except (NotFoundError, ApiUnavailableError, ApiServerError,
+                    PermissionError):
+                pass
+        payload = self._open(resume=True)
+        if payload.get("resume") == "delta":
+            for d in payload.get("events", []):
+                self._distribute(wire.decode_watch_event(d))
+            self._needs_relist = False
+            return
+        self._relist()
+        # The relist succeeded (a raise above leaves the flag set and the
+        # OLD watermarks in place for the retry): the client's knowledge is
+        # now complete as of the session open. REBASE the watermark state —
+        # without this, one too-old event would poison every later
+        # reconnect: quiet kinds keep their outrun watermark forever, so
+        # each reap would cascade into another O(cluster) relist.
+        self._base = int(payload.get("seq", 0) or 0)
+        self._watermarks.clear()
 
     def _relist(self) -> List[Any]:
         """Synthesize Added events for the full current state. Watch is
@@ -260,6 +326,17 @@ class _SharedWatch:
         return events
 
     def _distribute(self, ev: Any) -> None:
+        # Exactly-once by watermark: the server subscribes the new session
+        # BEFORE computing a resume delta, so an event written in that
+        # window arrives twice (once replayed, once via the session). The
+        # seq dedup drops the second copy — replayed deltas are never
+        # double-applied by any consumer (above all the lister cache).
+        # Relist-synthesized events carry seq 0 and bypass this (consumers
+        # are idempotent under relist over-observation, as before).
+        if ev.seq:
+            if ev.seq <= self._watermarks.get(ev.kind, 0):
+                return
+            self._watermarks[ev.kind] = ev.seq
         # One shared decoded copy per event, same as the in-process
         # informer contract (apiserver.py module docstring).
         for q in self._subs:

@@ -57,6 +57,25 @@ class OperatorConfig:
     drain_reserve_seconds: float = 300.0
     max_drain_fraction: float = 0.08
     aging_seconds: float = 300.0
+    # Watch-resume ring: events retained PER KIND by the wire API server
+    # for ResourceVersion delta resume (httpapi.ApiHTTPServer). A reconnect
+    # whose watermark the ring has outrun falls back to a full relist
+    # ("410 too old"); size it above the peak event rate times the longest
+    # expected reconnect window. The default absorbs a full 1k-job burst's
+    # pod events with headroom.
+    watch_ring_size: int = 8192
+    # Host durability knobs (cluster/store.py HostStore; --state-dir role).
+    # Compaction fires when EITHER bound is exceeded: record count (the
+    # original knob) or journal BYTES — a few huge objects (big ConfigMaps,
+    # 1k-pod snapshots) can grow a journal unboundedly long before 4096
+    # records accumulate. 0 disables the bytes trigger.
+    compact_every: int = 4096
+    compact_max_journal_bytes: int = 64 * 1024 * 1024
+    # Per-record durability: False = flush() per record (survives kill -9
+    # of the host — the failure mode HA exercises); True = fsync per record
+    # (survives power loss, at the cost of gating every control-plane write
+    # on disk latency; etcd batches fsyncs for the same reason).
+    journal_fsync: bool = False
     # Probe/metrics HTTP port; 0 disables (reference --health-probe-bind-
     # address / --metrics-bind-address, collapsed to one server here).
     health_port: int = 0
@@ -94,6 +113,16 @@ class OperatorConfig:
             )
         if self.controller_threads < 1:
             raise ValueError("controller_threads must be >= 1")
+        if self.watch_ring_size < 1:
+            # A zero-size ring would answer EVERY resume too-old: clients
+            # still converge (relist arm) but every reconnect goes back to
+            # O(cluster) — that degradation should be impossible to
+            # configure by accident; disable resume client-side instead.
+            raise ValueError("watch_ring_size must be >= 1")
+        if self.compact_every < 1:
+            raise ValueError("compact_every must be >= 1")
+        if self.compact_max_journal_bytes < 0:
+            raise ValueError("compact_max_journal_bytes must be >= 0 (0 disables)")
         if not 0.0 <= self.max_drain_fraction <= 1.0:
             raise ValueError("max_drain_fraction must be in [0, 1]")
         if self.aging_seconds < 0:

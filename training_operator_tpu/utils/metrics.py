@@ -246,6 +246,26 @@ wire_event_cache_hits = registry.counter(
     "training_wire_event_cache_hits_total",
     "watch event drains served from the serialize-once byte cache", (),
 )
+# Watch-session resume (wire_server._ResumeRing + wire_watch._SharedWatch):
+# the O(delta) reconnect path. In the steady state delta_total climbs while
+# too_old_total stays 0 — a nonzero too_old means the ring was outrun (or a
+# host restart changed the epoch) and the client fell back to a full relist.
+wire_resume_delta = registry.counter(
+    "training_wire_resume_delta_total",
+    "watch resubscribes served by delta replay from the resume ring", (),
+)
+wire_resume_replayed = registry.counter(
+    "training_wire_resume_replayed_events_total",
+    "watch events replayed (byte-copied) across all delta resumes", (),
+)
+wire_resume_too_old = registry.counter(
+    "training_wire_resume_too_old_total",
+    "watch resubscribes whose watermark the ring had outrun (410-style full-relist fallback)", (),
+)
+wire_resume_ring_evictions = registry.counter(
+    "training_wire_resume_ring_evictions_total",
+    "watch events evicted from the bounded resume ring", (),
+)
 workqueue_depth = registry.gauge(
     "training_operator_workqueue_depth",
     "Keys pending in the manager workqueue after the current tick",
