@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast lint bench bench-quick bench-wire bench-wire-resume dryrun operator-demo ha-demo native clean
+.PHONY: test test-fast lint bench bench-quick bench-wire bench-wire-resume bench-observe dryrun operator-demo ha-demo native clean
 
 test:            ## full suite (no hardware needed; ~10 min)
 	$(PY) -m pytest tests/ -q
@@ -52,6 +52,11 @@ wire-bench: bench-wire  ## back-compat alias for bench-wire
 # reconnect cost of ResourceVersion delta-resume vs the forced full relist.
 bench-wire-resume:  ## watch-resume reconnect-cost block (one JSON line)
 	JAX_PLATFORMS=cpu $(PY) bench.py --wire-resume-only
+
+# Job-lifecycle tracing on vs off over the same gang burst: the
+# instrumentation must stay under 5% to be left enabled in production.
+bench-observe:   ## observability-overhead block (one JSON line)
+	JAX_PLATFORMS=cpu $(PY) bench.py --observe-only
 
 native:          ## force-rebuild the C++ data-path core (drops the hash cache)
 	$(PY) -c "from training_operator_tpu import native; import glob, os; \

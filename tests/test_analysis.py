@@ -436,6 +436,32 @@ class TestCodelint:
         # ...but the same import from anywhere else is a violation.
         assert check_source("x.py", src, package_rel="cluster/store.py")
 
+    def test_cl005_metric_registration_outside_metrics(self):
+        src = ("from training_operator_tpu.utils import metrics\n"
+               "c = metrics.registry.counter('my_total', 'help', ())\n")
+        found = check_source("x.py", src, package_rel="controllers/x.py")
+        assert [f.rule_id for f in found] == ["CL005"], found
+        # All three factory verbs are covered, including a bare `registry`.
+        src2 = "h = registry.histogram('x_seconds')\n"
+        found2 = check_source("x.py", src2, package_rel="engine/x.py")
+        assert [f.rule_id for f in found2] == ["CL005"], found2
+        src3 = "g = registry.gauge('depth', '', ())\n"
+        assert [f.rule_id for f in check_source(
+            "x.py", src3, package_rel="scheduler/x.py"
+        )] == ["CL005"]
+
+    def test_cl005_metrics_module_exempt(self):
+        # The one legal registration site; USING a metric elsewhere
+        # (inc/observe/set) is not a registration and stays legal.
+        src = "c = registry.counter('my_total', 'help', ())\n"
+        assert not check_source(
+            "metrics.py", src, package_rel="utils/metrics.py"
+        )
+        use = ("from training_operator_tpu.utils import metrics\n"
+               "metrics.jobs_created.inc('ns', 'JAXJob')\n"
+               "metrics.reconcile_seconds.observe(0.1)\n")
+        assert not check_source("x.py", use, package_rel="controllers/x.py")
+
     def test_cl003_daemon_or_join_ok(self):
         daemon = ("import threading\n"
                   "def f():\n    threading.Thread(target=f, daemon=True).start()\n")

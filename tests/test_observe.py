@@ -1,0 +1,496 @@
+"""Observability surfaces (PR 4): bucketed histogram exposition, the
+timeline span tracer + ring bounds, lifecycle Events from the controller
+path, the describe renderer on a completed preset job, the wire
+/timelines and /metrics.txt routes, and the Chrome-trace exporter."""
+
+from __future__ import annotations
+
+import math
+
+import pytest
+
+from training_operator_tpu import observe
+from training_operator_tpu.cluster.inventory import TPU_RESOURCE, make_tpu_pool
+from training_operator_tpu.cluster.runtime import (
+    ANNOTATION_SIM_DURATION,
+    Cluster,
+    DefaultScheduler,
+    SimKubelet,
+    VirtualClock,
+)
+from training_operator_tpu.controllers import OperatorManager, register_all
+from training_operator_tpu.observe.timeline import TimelineStore
+from training_operator_tpu.runtime.api import ClusterTrainingRuntime
+from training_operator_tpu.runtime.controller import TrainJobManager
+from training_operator_tpu.scheduler import GangScheduler, TPUPacker
+from training_operator_tpu.sdk import TrainingClient
+from training_operator_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+
+
+# ---------------------------------------------------------------------------
+# Bucketed histograms + registry guards (satellite 1 & 2)
+# ---------------------------------------------------------------------------
+
+
+class TestBucketedHistogram:
+    def test_cumulative_buckets_and_minmax(self):
+        h = Histogram("t_seconds", "help", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        cum = dict(
+            (("+Inf" if b == math.inf else b), c) for b, c in h.cumulative_buckets()
+        )
+        assert cum == {0.1: 1, 1.0: 3, 10.0: 4, "+Inf": 5}
+        assert h.count == 5
+        assert h.min == 0.05 and h.max == 50.0
+        assert h.sum == pytest.approx(56.05)
+
+    def test_boundary_value_counts_le(self):
+        # Prometheus buckets are `le` (less-or-equal): an observation ON the
+        # bound lands in that bucket.
+        h = Histogram("b_seconds", "", buckets=(1.0, 2.0))
+        h.observe(1.0)
+        cum = dict(h.cumulative_buckets())
+        assert cum[1.0] == 1
+
+    def test_render_text_and_json_snapshot_agree(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "latency", buckets=(0.5, 5.0))
+        c = reg.counter("ops_total", "ops", ("kind",))
+        h.observe(0.25)
+        h.observe(2.5)
+        c.inc("JAXJob")
+        snap = reg.snapshot()
+        rendered = {}
+        for line in reg.render().splitlines():
+            if not line or line.startswith("#"):
+                continue
+            key, _, val = line.rpartition(" ")
+            rendered[key] = float(val)
+        assert rendered == {k: float(v) for k, v in snap.items()}
+        # The exposition carries real le-labeled buckets plus the envelope.
+        assert snap['lat_seconds_bucket{le="0.5"}'] == 1.0
+        assert snap['lat_seconds_bucket{le="+Inf"}'] == 2.0
+        assert snap["lat_seconds_min"] == 0.25
+        assert snap["lat_seconds_max"] == 2.5
+        assert snap["lat_seconds_count"] == 2.0
+        assert 'ops_total{kind="JAXJob"}' in snap
+
+    def test_empty_histogram_renders_zero_envelope(self):
+        h = Histogram("e_seconds", "", buckets=(1.0,))
+        items = h.snapshot_items()
+        assert items["e_seconds_min"] == 0.0
+        assert items["e_seconds_max"] == 0.0
+        assert items['e_seconds_bucket{le="+Inf"}'] == 0.0
+
+
+class TestRegistryGuards:
+    def test_same_registration_is_memoized(self):
+        reg = MetricsRegistry()
+        a = reg.counter("x_total", "h", ("ns",))
+        b = reg.counter("x_total", "h", ("ns",))
+        assert a is b
+
+    def test_type_change_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("x_total", "h", ())
+        with pytest.raises(ValueError, match="already registered as Counter"):
+            reg.gauge("x_total", "h", ())
+        with pytest.raises(ValueError, match="already registered as Counter"):
+            reg.histogram("x_total", "h")
+
+    def test_gauge_is_not_a_counter(self):
+        reg = MetricsRegistry()
+        reg.gauge("g", "h", ())
+        with pytest.raises(ValueError, match="Gauge"):
+            reg.counter("g", "h", ())
+
+    def test_label_change_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("y_total", "h", ("a", "b"))
+        with pytest.raises(ValueError, match="labels"):
+            reg.counter("y_total", "h", ("a",))
+
+    def test_bucket_change_raises(self):
+        reg = MetricsRegistry()
+        reg.histogram("z_seconds", "h", buckets=(1.0, 2.0))
+        with pytest.raises(ValueError, match="buckets"):
+            reg.histogram("z_seconds", "h", buckets=(1.0, 3.0))
+
+    def test_counter_value_and_total_locked_reads(self):
+        c = Counter("v_total", "h", ("k",))
+        c.inc("a", amount=2.0)
+        c.inc("b")
+        assert c.value("a") == 2.0
+        assert c.value("missing") == 0.0
+        assert c.total() == 3.0
+        g = Gauge("g", "h", ())
+        g.set(value=7.0)
+        assert g.value() == 7.0
+
+
+# ---------------------------------------------------------------------------
+# Timeline tracer: ordering, ring bounds, toggle
+# ---------------------------------------------------------------------------
+
+
+class TestTimelineStore:
+    def test_span_ordering_is_by_start(self):
+        ts = TimelineStore(now_fn=lambda: 0.0)
+        ts.record_span("ns", "j", "u1", "late", start=5.0, end=6.0)
+        ts.record_span("ns", "j", "u1", "early", start=1.0, end=2.0)
+        tl = ts.timeline("ns", "j")
+        assert [s.name for s in tl.sorted_spans()] == ["early", "late"]
+        d = tl.to_dict()
+        assert [s["name"] for s in d["spans"]] == ["early", "late"]
+        assert d["uids"] == ["u1"]
+
+    def test_per_job_span_ring_is_bounded(self):
+        ts = TimelineStore(now_fn=lambda: 0.0, max_spans=4)
+        for i in range(10):
+            ts.record_span("ns", "j", "", f"s{i}", start=float(i), end=float(i))
+        tl = ts.timeline("ns", "j")
+        assert len(tl.spans) == 4
+        assert [s.name for s in tl.sorted_spans()] == ["s6", "s7", "s8", "s9"]
+
+    def test_job_lru_ring_is_bounded(self):
+        ts = TimelineStore(now_fn=lambda: 0.0, max_jobs=2)
+        for name in ("a", "b", "c"):
+            ts.record_span("ns", name, "", "x", start=0.0, end=0.0)
+        assert ts.timeline("ns", "a") is None  # oldest evicted
+        assert ts.timeline("ns", "b") is not None
+        assert ts.timeline("ns", "c") is not None
+        # Touching "b" makes "c" the eviction candidate.
+        ts.record_span("ns", "b", "", "x2", start=1.0, end=1.0)
+        ts.record_span("ns", "d", "", "x", start=2.0, end=2.0)
+        assert ts.timeline("ns", "c") is None
+        assert ts.timeline("ns", "b") is not None
+
+    def test_marks_are_first_wins(self):
+        ts = TimelineStore(now_fn=lambda: 0.0)
+        ts.mark("ns", "j", "", "created", t=1.0)
+        ts.mark("ns", "j", "", "created", t=9.0)
+        assert ts.timeline("ns", "j").marks == {"created": 1.0}
+
+    def test_global_toggle_disables_recording(self):
+        ts = TimelineStore(now_fn=lambda: 0.0)
+        observe.set_enabled(False)
+        try:
+            ts.record_span("ns", "j", "", "x", start=0.0, end=1.0)
+            ts.mark("ns", "j", "", "m", t=0.0)
+            assert ts.timeline("ns", "j") is None
+        finally:
+            observe.set_enabled(True)
+
+    def test_wall_duration_wins_over_instant_interval(self):
+        ts = TimelineStore(now_fn=lambda: 0.0)
+        ts.record_span("ns", "j", "", "solve", start=3.0, end=3.0, wall=0.25)
+        span = ts.timeline("ns", "j").sorted_spans()[0]
+        assert span.duration() == 0.25
+
+    def test_uid_history_is_capped(self):
+        # A name resubmitted forever must not grow uids unboundedly; the
+        # first incarnation stays, recent ones are kept.
+        ts = TimelineStore(now_fn=lambda: 0.0)
+        for i in range(50):
+            ts.record_span("ns", "nightly", f"uid-{i}", "x", start=0.0, end=0.0)
+        uids = ts.timeline("ns", "nightly").uids
+        assert len(uids) <= TimelineStore.MAX_UIDS
+        assert uids[0] == "uid-0" and uids[-1] == "uid-49"
+
+    def test_hostile_attr_keys_ride_the_attrs_dict(self):
+        # Wire ingest passes client-chosen attr keys; ones that collide
+        # with the record_span signature must not blow up the call.
+        ts = TimelineStore(now_fn=lambda: 0.0)
+        ts.record_span("ns", "j", "", "x", start=1.0, end=2.0,
+                       attrs={"start": 99.0, "name": "evil", "wall": 7.0})
+        span = ts.timeline("ns", "j").sorted_spans()[0]
+        assert span.start == 1.0 and span.name == "x" and span.wall == 0.0
+        assert span.attrs["start"] == 99.0 and span.attrs["name"] == "evil"
+
+
+class TestWorkqueueWaitStamps:
+    def test_stamps_do_not_outlive_queue_membership(self):
+        from training_operator_tpu.engine.workqueue import RateLimitingQueue
+
+        q = RateLimitingQueue(now_fn=lambda: 1.0)
+        for i in range(10):
+            q.add(f"k{i}")
+        q.drain()
+        assert not q._enqueued_at  # settled at pop
+        # A consumer that never reads waits (v2 manager) stays bounded:
+        # the next drain clears the unread waits.
+        q.add("k0")
+        q.drain()
+        assert list(q._pop_waits) == ["k0"]
+        assert q.waited("k0") >= 0.0
+        assert not q._pop_waits
+
+    def test_waited_reports_enqueue_to_pop(self):
+        from training_operator_tpu.engine.workqueue import RateLimitingQueue
+
+        clock = [0.0]
+        q = RateLimitingQueue(now_fn=lambda: clock[0])
+        q.add("a")
+        clock[0] = 2.5
+        assert q.get() == "a"
+        assert q.waited("a") == 2.5
+        assert q.waited("a") == 0.0  # consumed
+
+
+# ---------------------------------------------------------------------------
+# The full path: preset TrainJob -> completion -> describe / wire / export
+# ---------------------------------------------------------------------------
+
+
+def preset_env(start_latency: float = 0.5):
+    """Gang-scheduled TPU cluster + v1/v2 managers + SDK, with the
+    tpu-jax-default preset customized the way an operator would (sim
+    duration so pods complete, chip resources, nonzero kubelet start
+    latency so time-to-running is a real interval)."""
+    cluster = Cluster(VirtualClock())
+    cluster.add_nodes(make_tpu_pool(1, slice_topology="2x4", chips_per_host=4))
+    DefaultScheduler(cluster)
+    SimKubelet(cluster, start_latency=start_latency)
+    GangScheduler(cluster, TPUPacker())
+    mgr = OperatorManager(cluster, gang_enabled=True)
+    register_all(mgr)
+    TrainJobManager(cluster)
+    client = TrainingClient(cluster)
+    rt = cluster.api.get(ClusterTrainingRuntime.KIND, "", "tpu-jax-default")
+    tmpl = rt.spec.template[0].template
+    tmpl.annotations[ANNOTATION_SIM_DURATION] = "2"
+    tmpl.containers[0].resources = {"cpu": 0.5, TPU_RESOURCE: 4.0}
+    cluster.api.update(rt)
+    return cluster, client
+
+
+class TestDescribePresetJob:
+    @pytest.fixture(scope="class")
+    def completed(self):
+        cluster, client = preset_env()
+        client.train(name="demo")
+        done = client.wait_for_trainjob("demo", timeout=120)
+        assert done.is_finished()
+        return cluster, client
+
+    def test_timeline_has_all_phases_with_nonzero_durations(self, completed):
+        cluster, client = completed
+        tl = client.get_job_timeline("demo")
+        assert tl is not None
+        rows = {r["phase"]: r for r in observe.phase_table(tl)}
+        for phase in ("admission", "queue_wait", "reconcile", "gang_solve",
+                      "bind", "time_to_running", "total"):
+            assert phase in rows, f"missing phase {phase}: {sorted(rows)}"
+        # The acceptance trio must be REAL durations, not zeros.
+        assert rows["queue_wait"]["total_s"] > 0.0
+        assert rows["gang_solve"]["total_s"] > 0.0
+        assert rows["time_to_running"]["total_s"] > 0.0
+
+    def test_describe_renders_conditions_events_and_phase_table(self, completed):
+        cluster, client = completed
+        text = client.describe_job("demo")
+        # Condition history (v2 TrainJob resolves first for the name).
+        assert "Kind:         TrainJob" in text
+        assert "Created" in text and "Complete" in text
+        # The uniform lifecycle Event stream from the controller path.
+        for reason in ("JobCreated", "JobRunning", "JobSucceeded",
+                       "GangAdmitted", "JobsCreated"):
+            assert reason in text, f"missing event {reason}:\n{text}"
+        # The phase table with the acceptance trio present.
+        for phase in ("queue_wait", "gang_solve", "time_to_running"):
+            assert phase in text
+
+    def test_time_to_running_metric_observed(self, completed):
+        from training_operator_tpu.utils import metrics
+
+        assert metrics.job_time_to_running_seconds.count > 0
+        assert metrics.job_time_to_running_seconds.max > 0.0
+        assert metrics.job_queue_wait_seconds.count > 0
+        assert metrics.job_admission_seconds.count > 0
+
+    def test_chrome_trace_round_trips_spans(self, completed, tmp_path):
+        import json
+
+        cluster, client = completed
+        tl = client.get_job_timeline("demo")
+        out = tmp_path / "trace.json"
+        doc = observe.export_chrome_trace(tl, str(out))
+        names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+        assert {s["name"] for s in tl["spans"]} == names
+        # Every duration event carries microsecond ts/dur fields.
+        for e in doc["traceEvents"]:
+            if e["ph"] == "X":
+                assert e["ts"] >= 0 and e["dur"] >= 0
+        on_disk = json.loads(out.read_text())
+        assert on_disk["traceEvents"] == doc["traceEvents"]
+        # A store export covers every job the ring retains.
+        full = observe.export_chrome_trace(cluster.api.timelines)
+        procs = [e for e in full["traceEvents"] if e["ph"] == "M"]
+        assert any(e["args"]["name"] == "default/demo" for e in procs)
+
+    def test_describe_unknown_job_raises(self, completed):
+        cluster, client = completed
+        with pytest.raises(ValueError, match="no job"):
+            client.describe_job("nope")
+
+
+class TestTimeToRunningFirstRunOnly:
+    def test_restart_retransition_does_not_reobserve(self):
+        import copy
+
+        import training_operator_tpu.api.common as capi
+        from training_operator_tpu.api.common import (
+            JobConditionType,
+            update_job_conditions,
+        )
+        from training_operator_tpu.api.jobs import JAXJob, ObjectMeta
+        from training_operator_tpu.cluster.apiserver import APIServer
+        from training_operator_tpu.controllers.jax import JAXController
+        from training_operator_tpu.engine import core
+        from training_operator_tpu.engine.controller import JobController
+        from training_operator_tpu.utils import metrics
+
+        api = APIServer()
+        jc = JobController(api, JAXController(api), now_fn=lambda: 10.0)
+        job = JAXJob(metadata=ObjectMeta(name="r", namespace="default"))
+        job.metadata.creation_time = 0.0
+        prev = copy.deepcopy(job.status)
+        update_job_conditions(
+            job.status, JobConditionType.RUNNING, True, "JobRunning", "m", now=5.0
+        )
+        before = metrics.job_time_to_running_seconds.count
+        jc._observe_transitions(job, prev)
+        assert metrics.job_time_to_running_seconds.count == before + 1
+
+        # Restart cycle: Restarting was set (clearing Running), then the
+        # new pod runs — the re-transition must NOT re-observe.
+        prev2 = copy.deepcopy(job.status)
+        update_job_conditions(
+            prev2, JobConditionType.RESTARTING, True, "JobRestarting", "m", now=20.0
+        )
+        job.metadata.annotations[core.RESTART_COUNT_ANNOTATION] = "1"
+        update_job_conditions(
+            job.status, JobConditionType.RUNNING, True, "JobRunning", "m", now=25.0
+        )
+        jc._observe_transitions(job, prev2)
+        assert metrics.job_time_to_running_seconds.count == before + 1
+        spans = [
+            s for s in api.get_timeline("default", "r")["spans"]
+            if s["name"] == "time_to_running"
+        ]
+        assert len(spans) == 1
+
+
+class TestFailureEventStream:
+    def test_failed_job_gets_failed_event_once(self):
+        from training_operator_tpu.api.common import (
+            Container,
+            PodTemplateSpec,
+            ReplicaSpec,
+            RestartPolicy,
+        )
+        from training_operator_tpu.api.jobs import JAXJob, ObjectMeta
+        from training_operator_tpu.cluster.inventory import make_cpu_pool
+        from training_operator_tpu.cluster.runtime import (
+            ANNOTATION_SIM_EXIT_CODE,
+        )
+
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_cpu_pool(4))
+        DefaultScheduler(cluster)
+        SimKubelet(cluster)
+        mgr = OperatorManager(cluster)
+        register_all(mgr)
+        client = TrainingClient(cluster)
+        t = PodTemplateSpec(
+            containers=[Container(name="jax", image="img", resources={"cpu": 0.5})]
+        )
+        t.annotations[ANNOTATION_SIM_DURATION] = "1"
+        t.annotations[ANNOTATION_SIM_EXIT_CODE] = "3"
+        job = JAXJob(
+            metadata=ObjectMeta(name="boom"),
+            replica_specs={"Worker": ReplicaSpec(
+                replicas=1, template=t, restart_policy=RestartPolicy.NEVER,
+            )},
+        )
+        client.create_job(job)
+        with pytest.raises(RuntimeError):
+            client.wait_for_job_conditions("boom", timeout=60)
+        cluster.run_for(1.0)  # let the terminal pass settle
+        evs = cluster.api.events(object_name="boom", reason="JobFailed")
+        assert len(evs) == 1, evs
+        assert evs[0].event_type == "Warning"
+        created = cluster.api.events(object_name="boom", reason="JobCreated")
+        assert len(created) == 1
+        # Terminal span landed with the failure outcome.
+        tl = cluster.api.get_timeline("default", "boom")
+        totals = [s for s in tl["spans"] if s["name"] == "total"]
+        assert totals and totals[0]["attrs"]["outcome"] == "Failed"
+
+
+# ---------------------------------------------------------------------------
+# Wire surfaces: /timelines round-trip, /metrics.txt, remote span push
+# ---------------------------------------------------------------------------
+
+
+class TestWireObservability:
+    @pytest.fixture()
+    def served(self):
+        from training_operator_tpu.cluster.httpapi import (
+            ApiHTTPServer,
+            RemoteAPIServer,
+        )
+
+        cluster, client = preset_env()
+        server = ApiHTTPServer(cluster.api, port=0)
+        remote = RemoteAPIServer(server.url, timeout=10.0)
+        try:
+            yield cluster, client, server, remote
+        finally:
+            server.close()
+
+    def test_timeline_round_trips_over_the_wire(self, served):
+        cluster, client, server, remote = served
+        client.train(name="wired")
+        assert client.wait_for_trainjob("wired", timeout=120).is_finished()
+        local = cluster.api.get_timeline("default", "wired")
+        over_wire = remote.get_timeline("default", "wired")
+        assert over_wire is not None
+        assert over_wire["spans"] == local["spans"]
+        assert over_wire["marks"] == local["marks"]
+        # And the exporter accepts the wire shape unchanged.
+        doc = observe.export_chrome_trace(over_wire)
+        assert any(e["ph"] == "X" for e in doc["traceEvents"])
+
+    def test_missing_timeline_is_none_over_the_wire(self, served):
+        _, _, _, remote = served
+        assert remote.get_timeline("default", "ghost") is None
+
+    def test_metrics_text_exposition_served(self, served):
+        _, _, _, remote = served
+        text = remote.metrics_text()
+        assert "# TYPE training_operator_reconcile_seconds histogram" in text
+        assert 'training_job_queue_wait_seconds_bucket{le="' in text
+        # Text and JSON views are the same registry, same numbers.
+        snap = remote.metrics_snapshot()
+        assert 'training_job_queue_wait_seconds_bucket{le="+Inf"}' in snap
+
+    def test_remote_span_push_lands_in_host_ring(self, served):
+        cluster, _, _, remote = served
+        rec = remote.timelines
+        rec.record_span("default", "pushed", "uid-1", "queue_wait",
+                        start=1.0, end=1.0, wall=0.125, kind="JAXJob")
+        rec.mark("default", "pushed", "", "created", t=1.0)
+        rec.flush()
+        tl = cluster.api.get_timeline("default", "pushed")
+        assert tl is not None
+        span = tl["spans"][0]
+        assert span["name"] == "queue_wait" and span["wall"] == 0.125
+        assert span["attrs"]["uid"] == "uid-1"
+        assert tl["marks"] == {"created": 1.0}

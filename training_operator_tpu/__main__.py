@@ -640,6 +640,52 @@ def run_operator(args, cfg) -> int:
     return 0
 
 
+def run_describe(argv) -> int:
+    """`python -m training_operator_tpu describe <ns>/<job>` — the
+    kubectl-describe analogue against a serving host: condition history,
+    the job's Event stream, and the phase-duration table from the
+    timeline ring (observe/describe.py)."""
+    import os as _os
+
+    ap = argparse.ArgumentParser(
+        prog="python -m training_operator_tpu describe",
+        description="condition history + Events + phase timeline for one job",
+    )
+    ap.add_argument("target", help="<namespace>/<job> (or just <job>, "
+                                   "namespace defaults to 'default')")
+    ap.add_argument("--api-server", required=True, metavar="URL",
+                    help="base URL of the serving host (WIRE_API=...)")
+    ap.add_argument("--api-token", default=None,
+                    help="bearer token (env TPU_OPERATOR_API_TOKEN)")
+    ap.add_argument("--ca-cert", default=None, metavar="PEM",
+                    help="CA bundle pinning an https host (WIRE_CA=...; "
+                         "env TPU_OPERATOR_CA_CERT)")
+    ap.add_argument("--chrome-trace", default=None, metavar="FILE",
+                    help="also dump the job's timeline as Trace Event "
+                         "Format JSON (chrome://tracing / Perfetto)")
+    args = ap.parse_args(argv)
+    ns, _, name = args.target.rpartition("/")
+    ns = ns or "default"
+    from training_operator_tpu.cluster.httpapi import RemoteAPIServer
+    from training_operator_tpu.observe import export_chrome_trace, render_describe
+
+    api = RemoteAPIServer(
+        args.api_server,
+        token=args.api_token or _os.environ.get("TPU_OPERATOR_API_TOKEN") or None,
+        ca_file=args.ca_cert or _os.environ.get("TPU_OPERATOR_CA_CERT") or None,
+    )
+    try:
+        print(render_describe(api, ns, name))
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if args.chrome_trace:
+        tl = api.get_timeline(ns, name)
+        export_chrome_trace([tl] if tl else [], args.chrome_trace)
+        print(f"chrome trace written to {args.chrome_trace}")
+    return 0
+
+
 def main(argv=None) -> int:
     raw = list(sys.argv[1:] if argv is None else argv)
     if raw and raw[0] == "lint":
@@ -648,6 +694,8 @@ def main(argv=None) -> int:
         from training_operator_tpu.analysis.cli import run as lint_run
 
         return lint_run(raw[1:])
+    if raw and raw[0] == "describe":
+        return run_describe(raw[1:])
     args = parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,

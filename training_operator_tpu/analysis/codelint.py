@@ -20,6 +20,12 @@ Rules (all ERROR; the tree must stay green — `make lint` runs this):
         httpapi.py holds only if everything else consumes the facade's
         public surface — a private import across the seam re-welds the
         modules together and breaks silently on the next internal rename.
+  CL005 metric-registration-outside-metrics    calling
+        `registry.counter/gauge/histogram(...)` anywhere but
+        utils/metrics.py. Every metric family is declared in one file so
+        the README's family table (and the registry's duplicate-
+        registration guard) can't silently drift against scattered inline
+        registrations.
 
 Run: `python -m training_operator_tpu.analysis.codelint [paths...]`
 (defaults to the `training_operator_tpu` package). Exit 1 on findings.
@@ -83,6 +89,31 @@ def _looks_like_snapshot(node: ast.AST) -> bool:
     return False
 
 
+# The registry factory methods whose call outside utils/metrics.py is a
+# CL005 finding.
+METRIC_FACTORIES = ("counter", "gauge", "histogram")
+
+
+def _is_registry_receiver(node: ast.AST) -> bool:
+    """The receiver is (or holds) a MetricsRegistry: a bare `registry`
+    name, something ending in `registry`, or an attribute access like
+    `metrics.registry`."""
+    if isinstance(node, ast.Name):
+        return node.id.lower().endswith("registry")
+    if isinstance(node, ast.Attribute):
+        return node.attr.lower().endswith("registry")
+    return False
+
+
+def _is_metric_registration(call: ast.Call) -> bool:
+    f = call.func
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr in METRIC_FACTORIES
+        and _is_registry_receiver(f.value)
+    )
+
+
 def _is_thread_ctor(call: ast.Call) -> bool:
     f = call.func
     if isinstance(f, ast.Attribute) and f.attr == "Thread":
@@ -125,6 +156,8 @@ def check_source(path: str, source: str, package_rel: Optional[str] = None) -> L
 
     in_control_pkg = any(f"{pkg}/" in rel for pkg in CONTROL_LOOP_PACKAGES)
     in_scheduler = "scheduler/" in rel
+    # The one file allowed to register metric families (CL005).
+    in_metrics_module = rel.endswith("utils/metrics.py")
     # The wire modules may import each other's internals (one subsystem,
     # four files); everyone else goes through the httpapi facade's public
     # names.
@@ -148,6 +181,17 @@ def check_source(path: str, source: str, package_rel: Optional[str] = None) -> L
                         f"modules; use the cluster.httpapi facade's public "
                         f"surface",
                     ))
+        if (
+            isinstance(node, ast.Call)
+            and not in_metrics_module
+            and _is_metric_registration(node)
+        ):
+            findings.append(Finding(
+                path, node.lineno, "CL005",
+                f"metric registration (registry.{node.func.attr}) outside "
+                f"utils/metrics.py; declare the family there so the "
+                f"README table and duplicate-registration guard hold",
+            ))
         if isinstance(node, ast.Call) and _is_time_sleep(node) and in_control_pkg:
             findings.append(Finding(
                 path, node.lineno, "CL001",

@@ -35,6 +35,14 @@ from dataclasses import dataclass
 from typing import Any, Callable, Deque, Dict, Iterable, List, Optional, Tuple
 
 from training_operator_tpu.cluster.objects import Event
+from training_operator_tpu.observe.timeline import TimelineStore
+from training_operator_tpu.utils import metrics
+
+
+def _is_job_like(obj: Any) -> bool:
+    """Objects the lifecycle tracer follows: v1 jobs (replica_specs) and v2
+    TrainJobs — not pods/services/etc., whose churn would flood the ring."""
+    return hasattr(obj, "replica_specs") or obj.KIND == "TrainJob"
 
 
 class ConflictError(Exception):
@@ -162,6 +170,11 @@ class APIServer:
         # pod object, like kubelet-held logs do.
         self._pod_logs: Dict[Tuple[str, str], Dict[str, Any]] = {}
         self._pod_log_max = 4096
+        # Job-lifecycle timeline ring (observe/timeline.py): admission,
+        # queue-wait, reconcile, gang-solve, bind, and condition-transition
+        # spans land here, served at GET /timelines/{ns}/{name}. The owning
+        # Cluster injects its clock so virtual-clock sims trace in sim time.
+        self.timelines = TimelineStore()
 
     @staticmethod
     def _clone(obj: Any) -> Any:
@@ -333,10 +346,60 @@ class APIServer:
         ns = getattr(obj.metadata, "namespace", "") or ""
         return (obj.KIND, ns, obj.metadata.name)
 
+    def get_timeline(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+        """One job's timeline as a wire-shaped dict (None when absent) —
+        the same payload GET /timelines/{ns}/{name} serves, and the shape
+        observe.export_chrome_trace consumes."""
+        tl = self.timelines.timeline(namespace, name)
+        return None if tl is None else tl.to_dict()
+
+    def record_spans(
+        self,
+        namespace: str,
+        name: str,
+        spans: List[Dict[str, Any]],
+        marks: Optional[List[Dict[str, Any]]] = None,
+    ) -> None:
+        """Bulk span/mark ingest (wire POST /timelines: a remote operator's
+        manager pushes its queue-wait/reconcile spans to the host ring)."""
+        for sd in spans:
+            attrs = dict(sd.get("attrs", {}))
+            uid = str(attrs.pop("uid", ""))
+            # Client-chosen attr keys ride the `attrs` dict, never the
+            # call signature — a span attr named "start" must not shadow
+            # the parameter (or 500 the wire boundary).
+            self.timelines.record_span(
+                namespace, name, uid, str(sd.get("name", "")),
+                start=float(sd.get("start", 0.0)),
+                end=float(sd.get("end", 0.0)),
+                wall=float(sd.get("wall", 0.0)),
+                attrs=attrs,
+            )
+        for md in marks or []:
+            self.timelines.mark(
+                namespace, name, "", str(md.get("name", "")),
+                t=float(md.get("t", 0.0)),
+            )
+
     def create(self, obj: Any) -> Any:
         with self._lock:
-            for fn in self._admission.get(obj.KIND, []):
+            hooks = self._admission.get(obj.KIND, [])
+            traced = hooks and _is_job_like(obj) and self.timelines.enabled
+            if traced:
+                t0 = _time.perf_counter()
+            for fn in hooks:
                 fn(obj)
+            if traced:
+                admission_wall = _time.perf_counter() - t0
+                metrics.job_admission_seconds.observe(admission_wall)
+                now = self.timelines.now()
+                self.timelines.record_span(
+                    getattr(obj.metadata, "namespace", "") or "",
+                    obj.metadata.name,
+                    obj.metadata.uid or "",
+                    "admission",
+                    start=now, end=now, wall=admission_wall, kind=obj.KIND,
+                )
             key = self._key(obj)
             if key in self._objects:
                 raise AlreadyExistsError(f"{key} already exists")

@@ -43,6 +43,7 @@ from training_operator_tpu.cluster.wire_transport import (
     ApiServerError,
     ApiUnavailableError,
     RemoteAPIServer,
+    RemoteTimelines,
 )
 from training_operator_tpu.cluster.wire_watch import (
     QUEUE_OVERFLOW,
@@ -60,6 +61,7 @@ __all__ = [
     "RELIST_RESET",
     "RemoteAPIServer",
     "RemoteRuntime",
+    "RemoteTimelines",
     "RemoteWatchQueue",
     "SyncedClock",
 ]

@@ -80,6 +80,9 @@ class Cluster:
     def __init__(self, clock: Optional[Clock] = None):
         self.clock = clock or Clock()
         self.api = APIServer()
+        # Timeline spans must be stamped in CLUSTER time (virtual-clock sims
+        # trace in sim time; the host role's WallClock keeps them durable).
+        self.api.timelines.set_clock(self.clock.now)
         # Shared read cache (controller-runtime's shared informer): synced at
         # the top of every step, read by schedulers/kubelet/benchmarks so
         # full-state scans don't clone the store each tick.

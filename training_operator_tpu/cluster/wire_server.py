@@ -233,9 +233,12 @@ class ApiHTTPServer:
             def _send(self, code: int, payload: Any) -> None:
                 self._send_bytes(code, json.dumps(payload).encode())
 
-            def _send_bytes(self, code: int, body: bytes) -> None:
+            def _send_bytes(
+                self, code: int, body: bytes,
+                ctype: str = "application/json",
+            ) -> None:
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -432,6 +435,16 @@ class ApiHTTPServer:
             # how a remote bench/test reads the wire-cache hit rates
             # (codec/body/event counters) instead of trusting a self-run.
             h._send(200, metrics.registry.snapshot())
+        elif head == "metrics.txt":
+            # The same registry in Prometheus text exposition — render()
+            # was previously only reachable via the probe listener; now a
+            # scraper pointed at the wire API gets both forms.
+            h._send_bytes(
+                200, metrics.registry.render().encode(),
+                ctype="text/plain; version=0.0.4",
+            )
+        elif head == "timelines":
+            self._timelines(h, method, parts[1:])
         elif head == "version" and len(parts) == 4:
             rv = self.api.resource_version(parts[1], seg_ns(parts[2]), parts[3])
             h._send(200, {"resourceVersion": rv})
@@ -641,6 +654,29 @@ class ApiHTTPServer:
             h._send(200, {"ok": True})
         else:
             raise NotFoundError("bad logs method")
+
+    def _timelines(self, h, method: str, parts: List[str]) -> None:
+        """/timelines/{ns}/{name}: GET one job's lifecycle timeline from
+        the ring; POST ingests spans a remote operator recorded (its
+        manager's queue-wait/reconcile instrumentation runs in another
+        process but the ring lives with the store)."""
+        if len(parts) != 2:
+            raise NotFoundError("timelines route is /timelines/<ns>/<job>")
+        ns, name = seg_ns(parts[0]), parts[1]
+        if method == "GET":
+            tl = self.api.get_timeline(ns, name)
+            if tl is None:
+                raise NotFoundError(f"no timeline for {ns}/{name}")
+            h._send(200, tl)
+        elif method == "POST":
+            body = h._body()
+            self.api.record_spans(
+                ns, name, list(body.get("spans", [])),
+                marks=list(body.get("marks", [])),
+            )
+            h._send(200, {"ok": True})
+        else:
+            raise NotFoundError("bad timelines method")
 
     def _events(self, h, method: str, q: Dict[str, str]) -> None:
         if method == "POST":

@@ -21,6 +21,7 @@ import json
 import socket
 import ssl as _ssl
 import threading
+import time as _time
 import urllib.parse
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -72,6 +73,97 @@ def seg_ns(segment: str) -> str:
 
 # Pre-split private aliases (the old httpapi.py spellings).
 _ns_seg, _quote_seg, _seg_ns = ns_seg, quote_seg, seg_ns
+
+
+class RemoteTimelines:
+    """Duck-type of `APIServer.timelines` for remote processes: spans an
+    operator records (queue wait, reconcile) are BUFFERED and pushed to the
+    serving host's timeline ring in batches (POST /timelines), so tracing
+    never adds a wire round trip per reconcile. Push is best-effort — a
+    host hiccup drops buffered spans rather than stall the control loop
+    (traces are diagnostics, not state)."""
+
+    def __init__(self, remote: "RemoteAPIServer",
+                 flush_after: int = 64, flush_interval: float = 2.0):
+        self._remote = remote
+        self.flush_after = flush_after
+        self.flush_interval = flush_interval
+        self.enabled = True
+        self._buf: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._buffered = 0
+        self._last_flush = _time.monotonic()
+        self._lock = threading.Lock()
+
+    def now(self) -> float:
+        return _time.time()
+
+    def _entry_locked(self, namespace: str, name: str) -> Dict[str, Any]:
+        return self._buf.setdefault(
+            (namespace or "", name), {"spans": [], "marks": []}
+        )
+
+    def record_span(self, namespace: str, name: str, uid: str, span_name: str,
+                    start: Optional[float] = None, end: Optional[float] = None,
+                    wall: float = 0.0, attrs: Optional[Dict[str, Any]] = None,
+                    **extra: Any) -> None:
+        from training_operator_tpu.observe.timeline import enabled as _tracing
+
+        if not (_tracing() and self.enabled):
+            return
+        t = self.now() if start is None or end is None else 0.0
+        merged = {**(attrs or {}), **extra}
+        if uid:
+            merged.setdefault("uid", uid)
+        with self._lock:
+            self._entry_locked(namespace, name)["spans"].append({
+                "name": span_name,
+                "start": t if start is None else start,
+                "end": t if end is None else end,
+                "wall": wall,
+                "attrs": merged,
+            })
+            self._buffered += 1
+        if span_name == "total":
+            # Terminal span: the job is done and this process may be about
+            # to stop — don't let the closing chapter die in the buffer.
+            self.flush()
+        else:
+            self._maybe_flush()
+
+    def mark(self, namespace: str, name: str, uid: str, mark_name: str,
+             t: Optional[float] = None) -> None:
+        from training_operator_tpu.observe.timeline import enabled as _tracing
+
+        if not (_tracing() and self.enabled):
+            return
+        with self._lock:
+            self._entry_locked(namespace, name)["marks"].append({
+                "name": mark_name, "t": self.now() if t is None else t,
+            })
+            self._buffered += 1
+        self._maybe_flush()
+
+    def _maybe_flush(self) -> None:
+        if (
+            self._buffered >= self.flush_after
+            or _time.monotonic() - self._last_flush >= self.flush_interval
+        ):
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            pending, self._buf = self._buf, {}
+            self._buffered = 0
+            self._last_flush = _time.monotonic()
+        for (ns, name), entry in pending.items():
+            try:
+                self._remote._request(
+                    "POST",
+                    f"/timelines/{ns_seg(ns)}/{quote_seg(name)}",
+                    body=entry,
+                )
+            except (ApiUnavailableError, ApiServerError, PermissionError):
+                return  # best-effort: drop the batch, keep the loop alive
 
 
 class RemoteAPIServer:
@@ -339,6 +431,45 @@ class RemoteAPIServer:
         (GET /metrics) — how benchmarks and tests verify the wire-cache
         hit-rate claims against the host instead of a self-run."""
         return self._request("GET", "/metrics")
+
+    def metrics_text(self) -> str:
+        """The serving process's registry in Prometheus text exposition
+        (GET /metrics.txt) — the scrape-format twin of metrics_snapshot."""
+        conn = self._conn()
+        try:
+            conn.request("GET", "/metrics.txt", headers=self._headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            if resp.status >= 400:
+                raise ApiServerError(f"GET /metrics.txt: {resp.status}")
+            return raw.decode("utf-8")
+        except (http.client.HTTPException, socket.timeout, OSError) as e:
+            self._drop_conn()
+            raise ApiUnavailableError(f"GET /metrics.txt: {e}") from None
+
+    # -- timelines ---------------------------------------------------------
+
+    def get_timeline(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+        """One job's lifecycle timeline from the host's ring
+        (GET /timelines/{ns}/{name}); None when no spans were recorded."""
+        try:
+            return self._request(
+                "GET", f"/timelines/{ns_seg(namespace)}/{quote_seg(name)}"
+            )
+        except NotFoundError:
+            return None
+
+    @property
+    def timelines(self) -> "RemoteTimelines":
+        """`APIServer.timelines` duck-type: batched best-effort span push to
+        the host ring (see RemoteTimelines). One recorder per client, not
+        per thread — the buffer lock is cheap and batches compose better
+        across reconcile workers (a lost init race leaks one empty buffer,
+        nothing else)."""
+        tl = self.__dict__.get("_timelines")
+        if tl is None:
+            tl = self.__dict__["_timelines"] = RemoteTimelines(self)
+        return tl
 
     # -- watch -------------------------------------------------------------
 

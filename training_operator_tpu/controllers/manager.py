@@ -10,7 +10,10 @@ expectations and enqueue the owning job).
 from __future__ import annotations
 
 import logging
+import time as _time
 from typing import Dict, Optional, Tuple
+
+from training_operator_tpu import observe
 
 from training_operator_tpu.api.common import (
     JOB_KIND_LABEL,
@@ -123,6 +126,15 @@ class OperatorManager:
         self.cluster.remove_ticker(self.tick)
         if self._pool is not None:
             self._pool.shutdown(wait=True)
+        # Remote-mode tracing buffers spans (RemoteTimelines); push what's
+        # left so a clean shutdown doesn't strand the last spans. No-op
+        # in-process (TimelineStore has no flush).
+        flush = getattr(getattr(self.api, "timelines", None), "flush", None)
+        if flush is not None:
+            try:
+                flush()
+            except Exception:  # noqa: BLE001 — best-effort, host may be gone
+                pass
         self.api.unwatch(self._watch)
         for kind in self.controllers:
             self.api.unregister_admission(kind, validate_job)
@@ -263,9 +275,20 @@ class OperatorManager:
         if entry is None:
             return
         _, jc = entry
-        import time as _time
-
+        # Queue wait is attributed BEFORE the reconcile so a slow pass does
+        # not inflate it; the timeline span sits at the pop instant with the
+        # wall wait carried in `wall` (workqueue stamps are wall-monotonic).
+        wait = self.queue.waited(key)
+        metrics.job_queue_wait_seconds.observe(wait)
+        tracing = observe.enabled()
+        now = self.cluster.clock.now() if tracing else 0.0
+        if tracing:
+            self.api.timelines.record_span(
+                ns, name, "", "queue_wait",
+                start=now, end=now, wall=wait, kind=kind,
+            )
         t0 = _time.perf_counter()
+        result = "error"
         try:
             jc.reconcile(ns, name)
         except Exception:
@@ -276,5 +299,13 @@ class OperatorManager:
         else:
             metrics.reconcile_total.inc(kind, "success")
             self.queue.forget(key)
+            result = "success"
         finally:
-            metrics.reconcile_seconds.observe(_time.perf_counter() - t0)
+            wall = _time.perf_counter() - t0
+            metrics.reconcile_seconds.observe(wall)
+            if tracing:
+                self.api.timelines.record_span(
+                    ns, name, "", "reconcile",
+                    start=now, end=self.cluster.clock.now(), wall=wall,
+                    kind=kind, result=result,
+                )

@@ -161,7 +161,7 @@ class JobController:
                 f"{job.kind} {name} is resumed.", now=now,
             )
             job.status.start_time = now
-            self._event(job, "Normal", "JobResumed", f"{job.kind} {name} is resumed.")
+            # The JobResumed Event rides the condition-transition emitter.
             self._schedule_deadline_requeue(job, key)
 
         if job.status.start_time is None:
@@ -184,7 +184,8 @@ class JobController:
                 job.status, JobConditionType.FAILED, True, failure_reason, failure_msg, now=now
             )
             metrics.jobs_failed.inc(namespace, job.kind, failure_reason)
-            self._event(job, "Warning", failure_reason, failure_msg)
+            # The Failed Event rides the uniform condition-transition
+            # emitter in _write_status (same reason/message).
             self._write_status(job, prev_status)
             return
 
@@ -519,12 +520,76 @@ class JobController:
         for s in services:
             self._delete_service(s, job)
 
+    # Condition types whose true-transitions get a lifecycle Event; Warning
+    # severity for the two that mean something went wrong.
+    _EVENTED_CONDITIONS = (
+        (JobConditionType.CREATED, "Normal"),
+        (JobConditionType.RUNNING, "Normal"),
+        (JobConditionType.SUCCEEDED, "Normal"),
+        (JobConditionType.FAILED, "Warning"),
+        (JobConditionType.RESTARTING, "Warning"),
+        (JobConditionType.SUSPENDED, "Normal"),
+    )
+
+    def _observe_transitions(self, job: Job, prev_status: capi.JobStatus) -> None:
+        """Uniform lifecycle Events + timeline spans from condition
+        transitions, for EVERY job kind (the reference emits Events ad hoc
+        per controller; `describe` needs a complete stream for a plain
+        preset job). Runs once per status change, in _write_status, so all
+        reconcile exit paths are covered."""
+        status = job.status
+        created = job.metadata.creation_time
+        for cond_type, severity in self._EVENTED_CONDITIONS:
+            cond = capi.get_condition(status, cond_type)
+            was_true = capi.has_condition(prev_status, cond_type)
+            if cond is not None and cond.status and not was_true:
+                self._event(job, severity, cond.reason, cond.message)
+                at = cond.last_transition_time
+                tl = self.api.timelines
+                if cond_type == JobConditionType.CREATED:
+                    tl.mark(job.namespace, job.name, job.uid, "created", t=at)
+                elif cond_type == JobConditionType.RUNNING:
+                    # First run only: a restart clears RUNNING (Restarting
+                    # is mutually exclusive with it), so the post-restart
+                    # re-transition would otherwise re-observe
+                    # creation->now — polluting the histogram with
+                    # restart-recovery durations and duplicating the span.
+                    first_run = (
+                        capi.get_condition(prev_status, JobConditionType.RESTARTING) is None
+                        and core.job_recreate_restarts(job) == 0
+                    )
+                    if first_run:
+                        start = created if created is not None else at
+                        metrics.job_time_to_running_seconds.observe(max(0.0, at - start))
+                        tl.record_span(
+                            job.namespace, job.name, job.uid, "time_to_running",
+                            start=start, end=at, kind=job.kind,
+                        )
+                elif cond_type in (JobConditionType.SUCCEEDED, JobConditionType.FAILED):
+                    start = created if created is not None else at
+                    tl.record_span(
+                        job.namespace, job.name, job.uid, "total",
+                        start=start, end=at, kind=job.kind,
+                        outcome=cond_type.value,
+                    )
+            elif (
+                cond_type == JobConditionType.SUSPENDED
+                and was_true
+                and cond is not None
+                and not cond.status
+            ):
+                # Explicit resume (Suspended flipped to False) — distinct
+                # from the condition being filtered out by a phase change.
+                self._event(job, "Normal", cond.reason, cond.message)
+
     def _write_status(self, job: Job, prev_status: Optional[capi.JobStatus] = None) -> None:
         """Optimistic-concurrency status write with one re-get retry,
         skipped when the pass didn't change anything
         (reference UpdateJobStatusInApiServer, common/job.go:360)."""
         if prev_status is not None and prev_status == job.status:
             return
+        if prev_status is not None:
+            self._observe_transitions(job, prev_status)
         job.status.last_reconcile_time = self.now()
         try:
             self.api.update(job, status_only=True)

@@ -1,0 +1,28 @@
+"""Job-lifecycle observability: span tracer, describe surface, trace export.
+
+Public surface:
+  TimelineStore / JobTimeline / Span   the tracer model (observe/timeline.py)
+  set_enabled / enabled                process-wide tracing switch
+  export_chrome_trace                  Trace Event Format dump (observe/export.py)
+  render_describe / phase_table        the describe renderer (observe/describe.py)
+
+The APIServer owns a `TimelineStore` as `api.timelines`; instrumentation
+in the admission path, the manager workqueue, the reconcile engine, and
+the gang scheduler records into it. The wire exposes one job's timeline at
+`GET /timelines/{ns}/{name}` and the registry text exposition at
+`GET /metrics.txt`.
+"""
+
+from training_operator_tpu.observe.describe import (  # noqa: F401
+    find_job,
+    phase_table,
+    render_describe,
+)
+from training_operator_tpu.observe.export import export_chrome_trace  # noqa: F401
+from training_operator_tpu.observe.timeline import (  # noqa: F401
+    JobTimeline,
+    Span,
+    TimelineStore,
+    enabled,
+    set_enabled,
+)

@@ -1,0 +1,154 @@
+"""kubectl-describe analogue: one job's conditions, Events, and phase table.
+
+`render_describe(api, namespace, name)` works against any APIServer
+duck-type — the in-process store, or a `RemoteAPIServer` pointed at a
+serving host — and is what `python -m training_operator_tpu describe`
+prints. Three sections:
+
+  Conditions  condition history from job status (type/status/reason/age)
+  Events      the job's Event stream (uniform lifecycle events from the
+              controller path + gang scheduler warnings)
+  Phases      durations aggregated from the job's timeline ring
+              (observe/timeline.py): where the job spent its time —
+              admission, workqueue wait, gang solve, bind, reconcile,
+              submit->Running, submit->terminal.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+# Canonical phase order for the table; unknown span names follow sorted.
+PHASE_ORDER = (
+    "admission",
+    "queue_wait",
+    "reconcile",
+    "gang_solve",
+    "bind",
+    "time_to_running",
+    "total",
+)
+
+
+def find_job(api, namespace: str, name: str) -> Optional[Any]:
+    """Probe every job kind (v2 TrainJob first — it owns same-named
+    workload jobs) for namespace/name."""
+    from training_operator_tpu.api.jobs import JOB_KINDS
+
+    for kind in ("TrainJob", *JOB_KINDS):
+        obj = api.try_get(kind, namespace, name)
+        if obj is not None:
+            return obj
+    return None
+
+
+def _conditions(job) -> List[Tuple[str, str, str, float, str]]:
+    """(type, status, reason, transition_time, message) rows from either a
+    v1 JobStatus or a v2 TrainJob condition list."""
+    status = getattr(job, "status", None)
+    conds = list(getattr(status, "conditions", []) or [])
+    rows = []
+    for c in sorted(conds, key=lambda c: getattr(c, "last_transition_time", 0.0)):
+        ctype = getattr(c.type, "value", c.type)
+        rows.append((
+            str(ctype),
+            "True" if c.status else "False",
+            c.reason,
+            getattr(c, "last_transition_time", 0.0),
+            c.message,
+        ))
+    return rows
+
+
+def phase_table(timeline: Optional[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Aggregate a wire-shaped timeline dict into per-phase rows:
+    {phase, count, total_s, first_start, last_end}."""
+    if not timeline:
+        return []
+    agg: Dict[str, Dict[str, Any]] = {}
+    for span in timeline.get("spans", []):
+        name = span.get("name", "")
+        wall = float(span.get("wall", 0.0))
+        start = float(span.get("start", 0.0))
+        end = float(span.get("end", 0.0))
+        dur = wall if wall > 0.0 else max(0.0, end - start)
+        row = agg.setdefault(
+            name,
+            {"phase": name, "count": 0, "total_s": 0.0,
+             "first_start": start, "last_end": end},
+        )
+        row["count"] += 1
+        row["total_s"] += dur
+        row["first_start"] = min(row["first_start"], start)
+        row["last_end"] = max(row["last_end"], end)
+    order = {p: i for i, p in enumerate(PHASE_ORDER)}
+    return sorted(
+        agg.values(), key=lambda r: (order.get(r["phase"], len(order)), r["phase"])
+    )
+
+
+def _get_timeline(api, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+    getter = getattr(api, "get_timeline", None)
+    if getter is None:
+        return None
+    return getter(namespace, name)
+
+
+def render_describe(api, namespace: str, name: str, max_events: int = 40) -> str:
+    """The full describe document as a string (raises NotFoundError-shaped
+    ValueError when no job kind matches)."""
+    job = find_job(api, namespace, name)
+    if job is None:
+        raise ValueError(f"no job of any known kind named {namespace}/{name}")
+
+    lines: List[str] = []
+    meta = job.metadata
+    lines.append(f"Name:         {meta.name}")
+    lines.append(f"Namespace:    {meta.namespace or ''}")
+    lines.append(f"Kind:         {job.KIND}")
+    lines.append(f"UID:          {meta.uid or ''}")
+    if meta.creation_time is not None:
+        lines.append(f"Created:      t={meta.creation_time:.3f}")
+
+    lines.append("")
+    lines.append("Conditions:")
+    rows = _conditions(job)
+    if rows:
+        lines.append(f"  {'TYPE':<12} {'STATUS':<7} {'REASON':<24} {'AT':>12}  MESSAGE")
+        for ctype, status, reason, at, message in rows:
+            lines.append(
+                f"  {ctype:<12} {status:<7} {reason:<24} {at:>12.3f}  {message}"
+            )
+    else:
+        lines.append("  <none>")
+
+    lines.append("")
+    lines.append("Events:")
+    events = [
+        e for e in api.events(object_name=name)
+        if (e.namespace or "") == (namespace or "")
+    ]
+    events.sort(key=lambda e: e.timestamp)
+    if events:
+        lines.append(f"  {'AT':>12}  {'TYPE':<8} {'KIND':<10} {'REASON':<22} MESSAGE")
+        for e in events[-max_events:]:
+            lines.append(
+                f"  {e.timestamp:>12.3f}  {e.event_type:<8} {e.object_kind:<10} "
+                f"{e.reason:<22} {e.message}"
+            )
+    else:
+        lines.append("  <none>")
+
+    lines.append("")
+    lines.append("Phases (from timeline ring):")
+    table = phase_table(_get_timeline(api, namespace, name))
+    if table:
+        lines.append(f"  {'PHASE':<18} {'COUNT':>5} {'TOTAL_S':>12} {'FIRST':>12} {'LAST':>12}")
+        for row in table:
+            lines.append(
+                f"  {row['phase']:<18} {row['count']:>5} {row['total_s']:>12.6f} "
+                f"{row['first_start']:>12.3f} {row['last_end']:>12.3f}"
+            )
+    else:
+        lines.append("  <no timeline recorded (tracing disabled, or job predates the ring)>")
+    return "\n".join(lines)

@@ -1,0 +1,77 @@
+"""Chrome-trace (catapult) exporter for job timelines.
+
+`export_chrome_trace` turns timelines into the Trace Event Format JSON
+that chrome://tracing and Perfetto load directly, so a bench run can dump
+the full burst's phase structure for offline flame views:
+
+    from training_operator_tpu import observe
+    observe.export_chrome_trace(api.timelines, "/tmp/burst-trace.json")
+
+Each job becomes one "process" row (pid + process_name metadata); spans
+become complete ("X") duration events. Cluster-clock seconds map to trace
+microseconds; spans whose cluster interval is instantaneous but which
+carry a real `wall` measurement (solver time on a virtual clock) use the
+wall duration, so the flame widths stay truthful.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Union
+
+from training_operator_tpu.observe.timeline import JobTimeline, TimelineStore
+
+
+def _as_timeline_dicts(source) -> List[Dict[str, Any]]:
+    if isinstance(source, TimelineStore):
+        return [tl.to_dict() for tl in source.timelines()]
+    if isinstance(source, JobTimeline):
+        return [source.to_dict()]
+    if isinstance(source, dict):
+        return [source]
+    out = []
+    for item in source:
+        if isinstance(item, JobTimeline):
+            out.append(item.to_dict())
+        elif item:  # plain timeline dict (wire shape)
+            out.append(item)
+    return out
+
+
+def export_chrome_trace(
+    source: Union[TimelineStore, JobTimeline, Dict[str, Any], list],
+    path: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Build (and optionally write) a Trace Event Format document from a
+    TimelineStore, JobTimeline(s), or wire-shaped timeline dict(s)."""
+    events: List[Dict[str, Any]] = []
+    for pid, tl in enumerate(_as_timeline_dicts(source), start=1):
+        job = f"{tl.get('namespace', '')}/{tl.get('name', '')}"
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": job},
+        })
+        for span in tl.get("spans", []):
+            start = float(span.get("start", 0.0))
+            end = float(span.get("end", 0.0))
+            wall = float(span.get("wall", 0.0))
+            dur = wall if wall > 0.0 else max(0.0, end - start)
+            events.append({
+                "ph": "X",
+                "name": span.get("name", ""),
+                "pid": pid,
+                "tid": 0,
+                "ts": round(start * 1e6, 3),
+                "dur": round(dur * 1e6, 3),
+                "args": dict(span.get("attrs", {})),
+            })
+        for mark, t in sorted(tl.get("marks", {}).items(), key=lambda kv: kv[1]):
+            events.append({
+                "ph": "i", "s": "p", "name": mark, "pid": pid, "tid": 0,
+                "ts": round(float(t) * 1e6, 3), "args": {},
+            })
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return doc

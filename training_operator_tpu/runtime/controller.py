@@ -150,6 +150,8 @@ class TrainJobController:
     def _write(self, job: TrainJob, prev_status=None) -> None:
         if prev_status is not None and prev_status == job.status:
             return
+        if prev_status is not None:
+            self._emit_transition_events(job, prev_status)
         try:
             # Version-checked: `job` was read at reconcile start. A conflict
             # (client spec update raced this reconcile) propagates to the
@@ -157,6 +159,37 @@ class TrainJobController:
             self.api.update(job, check_version=True, status_only=True)
         except NotFoundError:
             pass
+
+
+    def _emit_transition_events(self, job: TrainJob, prev_status) -> None:
+        """Lifecycle Events for TrainJob condition transitions (the same
+        uniform stream the v1 engine emits, so `describe` on a preset job
+        shows the v2 object's milestones next to its workload's). Terminal
+        transitions also close the job's timeline with a `total` span."""
+        from training_operator_tpu.cluster.objects import Event as ClusterEvent
+
+        prev = {c.type: c.status for c in prev_status.conditions}
+        for c in job.status.conditions:
+            if not c.status or prev.get(c.type):
+                continue
+            severity = "Warning" if c.type == TrainJobConditionType.FAILED else "Normal"
+            self.api.record_event(ClusterEvent(
+                object_kind=TrainJob.KIND,
+                object_name=job.metadata.name,
+                namespace=job.namespace,
+                event_type=severity,
+                reason=c.reason,
+                message=c.message,
+                timestamp=c.last_transition_time,
+            ))
+            if c.type in (TrainJobConditionType.COMPLETE, TrainJobConditionType.FAILED):
+                created = job.metadata.creation_time
+                start = created if created is not None else c.last_transition_time
+                self.api.timelines.record_span(
+                    job.namespace, job.metadata.name, job.uid, "total",
+                    start=start, end=c.last_transition_time,
+                    kind=TrainJob.KIND, outcome=c.type.value,
+                )
 
 
 class TrainJobManager:

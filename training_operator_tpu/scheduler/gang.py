@@ -313,6 +313,7 @@ class GangScheduler:
                 )
             return
         t0 = time.perf_counter()
+        solve_at = now  # cluster-clock solve start, for the timeline spans
         snapshot = self._snapshot()
         requests = []
         for pg in groups:
@@ -348,6 +349,17 @@ class GangScheduler:
                     metrics.podgroups_admitted.inc()
                     self._event(live, "Normal", "GangAdmitted",
                                 f"placed on {len(set(placement.assignments.values()))} nodes")
+                    # Timeline: the solve cycle that admitted this gang.
+                    # PodGroup name == owning job name (PodGroupControl),
+                    # so the span lands on the job's timeline; the batch
+                    # solve's wall time is attributed to each gang it
+                    # admitted (they shared the cycle).
+                    self.api.timelines.record_span(
+                        live.namespace, live.name, live.metadata.owner_uid or "",
+                        "gang_solve", start=solve_at, end=now, wall=wall,
+                        pending=len(requests),
+                        nodes=len(set(placement.assignments.values())),
+                    )
             else:
                 # Track attempts scheduler-side without an API write per
                 # cycle — persisting every failed attempt would look like
@@ -451,9 +463,16 @@ class GangScheduler:
                     self._event(live, "Warning", "PlacementInvalidated",
                                 f"node {target} is gone; re-solving")
                 continue
-            bind_pod(self.api, pod, target, now=self.cluster.clock.now())
+            bind_now = self.cluster.clock.now()
+            bind_pod(self.api, pod, target, now=bind_now)
             self._unbound.pop(key, None)
             metrics.pods_bound.inc()
+            # Timeline: one bind instant per gang pod (pg name == job name).
+            self.api.timelines.record_span(
+                pod.namespace, pg_name, pg.metadata.owner_uid or "",
+                "bind", start=bind_now, end=bind_now,
+                pod=pod.name, node=target,
+            )
 
     def _advance_running(self) -> None:
         inqueue = [

@@ -363,6 +363,26 @@ class TrainingClient:
             self.cluster.run_for(poll)
             waited += poll
 
+    # -- observability -----------------------------------------------------
+
+    def get_job_timeline(
+        self, name: str, namespace: Optional[str] = None
+    ) -> Optional[Dict[str, Any]]:
+        """The job's lifecycle timeline (admission / queue-wait / gang-solve
+        / bind / time-to-running spans) from the API server's ring — the
+        wire route GET /timelines/{ns}/{name} in remote mode. None when
+        nothing was recorded. Feed to observe.export_chrome_trace for a
+        chrome://tracing / Perfetto flame view."""
+        return self.api.get_timeline(namespace or self.namespace, name)
+
+    def describe_job(self, name: str, namespace: Optional[str] = None) -> str:
+        """kubectl-describe analogue: condition history + Events + phase
+        table for one job (see observe/describe.py; also available as
+        `python -m training_operator_tpu describe <ns>/<job>`)."""
+        from training_operator_tpu.observe import render_describe
+
+        return render_describe(self.api, namespace or self.namespace, name)
+
     # -- static analysis ---------------------------------------------------
 
     def lint(self, job: Union[TrainJob, str], namespace: Optional[str] = None):

@@ -11,9 +11,11 @@ text exposition format for scraping/export.
 
 from __future__ import annotations
 
+import bisect
+import math
 import threading
 from collections import defaultdict
-from typing import Dict, List, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 
 def _label_str(label_names: Tuple[str, ...], labels: Tuple[str, ...]) -> str:
@@ -37,10 +39,14 @@ class Counter:
             self._values[tuple(label_values)] += amount
 
     def value(self, *label_values: str) -> float:
-        return self._values.get(tuple(label_values), 0.0)
+        # Locked like items(): a read racing a first-seen-label insert must
+        # observe either the pre- or post-insert dict, consistently.
+        with self._lock:
+            return self._values.get(tuple(label_values), 0.0)
 
     def total(self) -> float:
-        return sum(self._values.values())
+        with self._lock:
+            return sum(self._values.values())
 
     def items(self) -> List[Tuple[Tuple[str, ...], float]]:
         """Stable copy for iteration: a concurrent inc() inserting a
@@ -68,52 +74,142 @@ class Gauge(Counter):
         return lines
 
 
-class Histogram:
-    """Summary-style observation metric (count/sum/min/max) — enough for the
-    scheduler-latency surface without bucket bookkeeping."""
+# controller-runtime's reconcile_time_seconds convention, stretched to the
+# minutes-long tail a queued gang can legitimately spend waiting.
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+)
 
-    def __init__(self, name: str, help_text: str):
+
+class Histogram:
+    """Cumulative-bucket observation metric (Prometheus histogram shape:
+    `le`-labeled buckets + sum/count), extended with tracked min/max so the
+    envelope survives without a quantile sketch."""
+
+    def __init__(self, name: str, help_text: str,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
         self.name = name
         self.help = help_text
+        self.buckets: Tuple[float, ...] = tuple(sorted(buckets))
+        # Per-bucket (non-cumulative) counts; index len(buckets) = +Inf.
+        self._bucket_counts = [0] * (len(self.buckets) + 1)
         self.count = 0
         self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
         self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
         with self._lock:
             self.count += 1
             self.sum += value
+            if value < self.min:
+                self.min = value
+            if value > self.max:
+                self.max = value
+            self._bucket_counts[bisect.bisect_left(self.buckets, value)] += 1
 
     def mean(self) -> float:
         return self.sum / self.count if self.count else 0.0
 
+    @staticmethod
+    def _le(bound: float) -> str:
+        return "+Inf" if bound == math.inf else repr(bound)
+
+    @staticmethod
+    def _cumulate(buckets: Tuple[float, ...], counts: List[int]) -> List[Tuple[float, int]]:
+        out = []
+        running = 0
+        for bound, c in zip(buckets, counts):
+            running += c
+            out.append((bound, running))
+        out.append((math.inf, running + counts[-1]))
+        return out
+
+    def cumulative_buckets(self) -> List[Tuple[float, int]]:
+        """(upper_bound, cumulative_count) pairs, ending at (+Inf, count) —
+        THE bucket view both render() and snapshot_items() derive from, so
+        the text and JSON expositions cannot disagree."""
+        with self._lock:
+            counts = list(self._bucket_counts)
+        return self._cumulate(self.buckets, counts)
+
+    def snapshot_items(self) -> Dict[str, float]:
+        """Flat JSON form — same numbers render() prints. One lock
+        acquisition captures buckets AND envelope together, so the +Inf
+        bucket always equals _count even under concurrent observes."""
+        with self._lock:
+            counts = list(self._bucket_counts)
+            count, total = self.count, self.sum
+            lo = self.min if count else 0.0
+            hi = self.max if count else 0.0
+        out: Dict[str, float] = {}
+        for bound, cum in self._cumulate(self.buckets, counts):
+            out[f'{self.name}_bucket{{le="{self._le(bound)}"}}'] = float(cum)
+        out[f"{self.name}_count"] = float(count)
+        out[f"{self.name}_sum"] = total
+        out[f"{self.name}_min"] = lo
+        out[f"{self.name}_max"] = hi
+        return out
+
     def render(self) -> List[str]:
-        return [
+        lines = [
             f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} summary",
-            f"{self.name}_count {self.count}",
-            f"{self.name}_sum {self.sum}",
+            f"# TYPE {self.name} histogram",
         ]
+        for key, v in self.snapshot_items().items():
+            lines.append(f"{key} {v}")
+        return lines
 
 
 class MetricsRegistry:
     def __init__(self) -> None:
         self._metrics: Dict[str, Counter] = {}
 
+    def _existing(self, name: str, cls, labels=None, buckets=None):
+        """Re-registration guard: the same name must come back as the SAME
+        metric — a second registration with a different type, label tuple,
+        or bucket layout silently splitting/aliasing a family is exactly
+        the drift the registry exists to prevent."""
+        m = self._metrics.get(name)
+        if m is None:
+            return None
+        if type(m) is not cls:
+            raise ValueError(
+                f"metric {name!r} already registered as {type(m).__name__}, "
+                f"not {cls.__name__}"
+            )
+        if labels is not None and m.label_names != tuple(labels):
+            raise ValueError(
+                f"metric {name!r} already registered with labels "
+                f"{m.label_names}, not {tuple(labels)}"
+            )
+        if buckets is not None and m.buckets != tuple(sorted(buckets)):
+            raise ValueError(
+                f"metric {name!r} already registered with buckets "
+                f"{m.buckets}, not {tuple(sorted(buckets))}"
+            )
+        return m
+
     def counter(self, name: str, help_text: str = "", labels: Tuple[str, ...] = ()) -> Counter:
-        if name not in self._metrics:
-            self._metrics[name] = Counter(name, help_text, labels)
-        return self._metrics[name]
+        existing = self._existing(name, Counter, labels=labels)
+        if existing is None:
+            existing = self._metrics[name] = Counter(name, help_text, tuple(labels))
+        return existing
 
     def gauge(self, name: str, help_text: str = "", labels: Tuple[str, ...] = ()) -> Gauge:
-        if name not in self._metrics:
-            self._metrics[name] = Gauge(name, help_text, labels)
-        return self._metrics[name]
+        existing = self._existing(name, Gauge, labels=labels)
+        if existing is None:
+            existing = self._metrics[name] = Gauge(name, help_text, tuple(labels))
+        return existing
 
-    def histogram(self, name: str, help_text: str = "") -> Histogram:
-        if name not in self._metrics:
-            self._metrics[name] = Histogram(name, help_text)
-        return self._metrics[name]
+    def histogram(self, name: str, help_text: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        existing = self._existing(name, Histogram, buckets=buckets)
+        if existing is None:
+            existing = self._metrics[name] = Histogram(name, help_text, buckets)
+        return existing
 
     def render(self) -> str:
         out: List[str] = []
@@ -128,9 +224,7 @@ class MetricsRegistry:
         out: Dict[str, float] = {}
         for m in self._metrics.values():
             if isinstance(m, Histogram):
-                with m._lock:
-                    out[f"{m.name}_count"] = m.count
-                    out[f"{m.name}_sum"] = m.sum
+                out.update(m.snapshot_items())
                 continue
             for labels, v in m.items():
                 if labels:
@@ -270,4 +364,28 @@ workqueue_depth = registry.gauge(
     "training_operator_workqueue_depth",
     "Keys pending in the manager workqueue after the current tick",
     (),
+)
+# Job-lifecycle phase latencies (observe/ tracing, PR 4): bucketed
+# histograms over the spans the timeline tracer records, so the p50/p99 of
+# "where did jobs spend their time" is scrapeable, not just per-job
+# describable. Queue wait and admission use sub-second-heavy buckets (they
+# are control-plane costs); time-to-running keeps the default long tail
+# (it includes gang queueing and container start).
+_FAST_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+job_queue_wait_seconds = registry.histogram(
+    "training_job_queue_wait_seconds",
+    "Wall time a job key spent in the manager workqueue (enqueue -> pop)",
+    buckets=_FAST_BUCKETS,
+)
+job_admission_seconds = registry.histogram(
+    "training_job_admission_seconds",
+    "Wall time of admission hooks (defaulting + validation + speclint) per job create",
+    buckets=_FAST_BUCKETS,
+)
+job_time_to_running_seconds = registry.histogram(
+    "training_job_time_to_running_seconds",
+    "Cluster-clock time from job creation to the Running condition",
 )
