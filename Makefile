@@ -5,13 +5,16 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast lint bench bench-quick bench-wire bench-wire-resume bench-observe dryrun operator-demo ha-demo native clean
+.PHONY: test test-fast test-chaos lint bench bench-quick bench-wire bench-wire-resume bench-observe bench-node-chaos dryrun operator-demo ha-demo native clean
 
 test:            ## full suite (no hardware needed; ~10 min)
 	$(PY) -m pytest tests/ -q
 
 test-fast:       ## the tier-1 fast lane: everything but the `slow`-marked jit-heavy numerics
 	$(PY) -m pytest tests/ -q -m "not slow"
+
+test-chaos:      ## the chaos/fault-injection lane: pod, store, wire, and node tiers
+	$(PY) -m pytest tests/test_chaos.py tests/test_wire_chaos.py tests/test_node_lifecycle.py -q
 
 lint:            ## project code lint: AST discipline rules + ruff (if present)
 	$(PY) -m training_operator_tpu.analysis.codelint training_operator_tpu
@@ -57,6 +60,12 @@ bench-wire-resume:  ## watch-resume reconnect-cost block (one JSON line)
 # instrumentation must stay under 5% to be left enabled in production.
 bench-observe:   ## observability-overhead block (one JSON line)
 	JAX_PLATFORMS=cpu $(PY) bench.py --observe-only
+
+# Kill one host of a whole-slice TPU gang on a virtual clock and measure
+# node-loss MTTR: detect (grace) -> evict (toleration) -> gang re-solve ->
+# Running again, as one JSON line.
+bench-node-chaos:  ## node-loss MTTR block (one JSON line)
+	JAX_PLATFORMS=cpu $(PY) bench.py --node-chaos-only
 
 native:          ## force-rebuild the C++ data-path core (drops the hash cache)
 	$(PY) -c "from training_operator_tpu import native; import glob, os; \

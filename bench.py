@@ -1001,6 +1001,113 @@ def run_observe_overhead(n_jobs: int = 120, pairs: int = 5, seed: int = 11,
     return out
 
 
+# ---------------------------------------------------------------------------
+# Node-loss MTTR: kill one host of a whole-slice TPU gang and measure the
+# recovery pipeline (detect -> evict -> re-solve -> Running again). The
+# failure domain the TPU-first north star creates: one dead host breaks the
+# slice's ICI mesh, so recovery is a whole-gang re-placement.
+# ---------------------------------------------------------------------------
+
+
+def run_node_chaos(heartbeat: float = 10.0, grace: float = 40.0,
+                   toleration: float = 30.0):
+    """The `node_chaos` bench block: deterministic VirtualClock scenario —
+    a 4-host gang running on one of two slices, one host killed, MTTR
+    measured as kill -> the job's Running condition re-transition. The
+    breakdown separates policy cost (grace + toleration, deployment knobs)
+    from mechanism cost (eviction -> re-solve -> rebind -> restart), which
+    is the part this subsystem owns."""
+    import training_operator_tpu.api.common as capi
+    from training_operator_tpu.api.common import (
+        Container, JobConditionType, PodTemplateSpec, ReplicaSpec,
+        RestartPolicy,
+    )
+    from training_operator_tpu.api.jobs import JAXJob, ObjectMeta, TPUPolicy
+    from training_operator_tpu.cluster.chaos import NodeChaos
+    from training_operator_tpu.cluster.inventory import (
+        TPU_RESOURCE as TPU_RES, make_tpu_pool as mk_pool,
+    )
+    from training_operator_tpu.cluster.runtime import (
+        ANNOTATION_SIM_DURATION as SIM_DUR, Cluster as Cl,
+        DefaultScheduler as DefSched, SimKubelet as Kubelet,
+        VirtualClock as VClock,
+    )
+    from training_operator_tpu.controllers.jax import JAXController
+    from training_operator_tpu.controllers.manager import OperatorManager
+    from training_operator_tpu.controllers.nodelifecycle import (
+        NodeLifecycleController,
+    )
+
+    cluster = Cl(VClock())
+    cluster.add_nodes(mk_pool(2, slice_topology="4x4"))
+    DefSched(cluster)
+    kubelet = Kubelet(cluster, heartbeat_interval=heartbeat)
+    NodeLifecycleController(cluster, grace_period=grace,
+                            toleration_seconds=toleration)
+    GangScheduler(cluster, TPUPacker())
+    mgr = OperatorManager(cluster, gang_enabled=True)
+    mgr.register(JAXController(cluster.api))
+
+    tmpl = PodTemplateSpec(
+        containers=[Container(name="jax", image="img",
+                              resources={"cpu": 1.0, TPU_RES: 16.0})],
+        annotations={SIM_DUR: "100000"},
+    )
+    mgr.submit(JAXJob(
+        metadata=ObjectMeta(name="mttr"),
+        replica_specs={"Worker": ReplicaSpec(
+            replicas=4, template=tmpl, restart_policy=RestartPolicy.EXIT_CODE,
+        )},
+        tpu_policy=TPUPolicy(accelerator="v5e-16", topology="4x4"),
+    ))
+
+    def running_after(t):
+        j = cluster.api.get("JAXJob", "default", "mttr")
+        c = capi.get_condition(j.status, JobConditionType.RUNNING)
+        return c is not None and c.status and c.last_transition_time > t
+
+    assert cluster.run_until(lambda: running_after(-1.0), timeout=300)
+    placed = sorted(p.node_name for p in cluster.api.list("Pod")
+                    if not p.is_terminal())
+    victim, victim_slice = placed[0], placed[0].rsplit("-host-", 1)[0]
+    chaos = NodeChaos(cluster, kubelet)
+    kill_t = cluster.clock.now()
+    chaos.kill_node(victim)
+    assert cluster.run_until(lambda: running_after(kill_t), timeout=3000)
+
+    def first_event(reason):
+        evs = [e.timestamp for e in cluster.api.events(reason=reason)
+               if e.timestamp >= kill_t]
+        return min(evs) if evs else None
+
+    j = cluster.api.get("JAXJob", "default", "mttr")
+    running_t = capi.get_condition(
+        j.status, JobConditionType.RUNNING).last_transition_time
+    detect_t = first_event("NodeNotReady")
+    evict_t = first_event("PodEvicted")
+    placed_after = sorted(p.node_name for p in cluster.api.list("Pod")
+                          if not p.is_terminal())
+    return {
+        "grace_period_s": grace,
+        "toleration_seconds": toleration,
+        "heartbeat_interval_s": heartbeat,
+        "killed_node": victim,
+        "kill_schedule": [[round(t, 3), n] for t, n in chaos.kills],
+        "detect_s": round(detect_t - kill_t, 3) if detect_t else None,
+        "evict_s": round(evict_t - kill_t, 3) if evict_t else None,
+        "mttr_s": round(running_t - kill_t, 3),
+        "recovery_mechanism_s": (
+            round(running_t - evict_t, 3) if evict_t else None
+        ),
+        "placement_before": placed,
+        "placement_after": placed_after,
+        "dead_node_absent": victim not in placed_after,
+        "whole_slice_migration": all(
+            not n.startswith(victim_slice) for n in placed_after
+        ),
+    }
+
+
 def _accelerator_reachable(timeout_s: float = 150.0) -> bool:
     """Probe the default JAX backend in a SUBPROCESS with a hard timeout.
 
@@ -1065,6 +1172,15 @@ def main():
                          "reap against a 1k-object cluster)")
     ap.add_argument("--wire-resume-objects", type=int, default=1000,
                     help="cluster size for the wire-resume block")
+    ap.add_argument("--node-chaos-only", action="store_true",
+                    help="run only the node-loss MTTR block (kill one host "
+                         "of a whole-slice TPU gang; measure detect -> "
+                         "evict -> re-solve -> Running again)")
+    ap.add_argument("--node-grace-period", type=float, default=40.0,
+                    help="node-chaos block: heartbeat silence before "
+                         "NotReady + unreachable taint")
+    ap.add_argument("--node-toleration-seconds", type=float, default=30.0,
+                    help="node-chaos block: taint age before eviction")
     ap.add_argument("--no-observe", action="store_true",
                     help="skip the observability-overhead block")
     ap.add_argument("--observe-only", action="store_true",
@@ -1091,6 +1207,21 @@ def main():
             "unit": "x (forced-relist events / delta-resume events per reconnect)",
             "vs_baseline": None,
             "wire_resume": block,
+        }))
+        return
+
+    if args.node_chaos_only:
+        block = run_node_chaos(grace=args.node_grace_period,
+                               toleration=args.node_toleration_seconds)
+        print(json.dumps({
+            "metric": "node_chaos_mttr_s",
+            "value": block["mttr_s"],
+            "unit": "s (node kill -> gang Running again; includes the "
+                    "grace + toleration policy window — "
+                    "recovery_mechanism_s isolates evict -> re-solve -> "
+                    "restart)",
+            "vs_baseline": None,
+            "node_chaos": block,
         }))
         return
 

@@ -140,6 +140,12 @@ RULE_TABLE = [
      job(name="Bad_Name"), rt(), "JOB001", Severity.ERROR),
     ("node001-override-not-whole-slice",
      job(trainer=Trainer(num_nodes=3)), rt(), "NODE001", Severity.WARN),
+    # NODE002: multi-host TPU job whose restart budget can't survive one
+    # host failure — torchrun's max_restarts defaults to 0 when unset.
+    ("node002-torch-max-restarts-unset-defaults-to-zero",
+     job(), rt(torch=TorchPolicy()), "NODE002", Severity.WARN),
+    ("node002-torch-max-restarts-explicit-zero",
+     job(), rt(torch=TorchPolicy(max_restarts=0)), "NODE002", Severity.WARN),
 ]
 
 
@@ -174,6 +180,33 @@ class TestRuleTable:
             assert rule in RULES
             r = RULES[rule]
             assert r.catches and r.fix and r.slug
+
+
+class TestNode002RestartBudget:
+    """NODE002 edges beyond the table: the Never-template arm, the
+    single-host exemption, and the smallest budget that clears it."""
+
+    def test_never_trainer_template_fires(self):
+        from training_operator_tpu.api.common import RestartPolicy
+
+        runtime = rt()
+        runtime.spec.template[0].template.restart_policy = RestartPolicy.NEVER
+        report = analyze_trainjob(job(), runtime)
+        assert report.has("NODE002"), report.render()
+        assert report.ok()  # WARN, not fatal
+
+    def test_single_host_job_is_exempt(self):
+        # One host = no "surviving workers" to cascade; host loss is plain
+        # rescheduling, which node-lost triage covers budget-free.
+        report = analyze_trainjob(
+            job(), rt(num_nodes=1, topology="1x4", accelerator="v5e-4",
+                      torch=TorchPolicy(max_restarts=0)),
+        )
+        assert not report.has("NODE002"), report.render()
+
+    def test_budget_of_one_clears(self):
+        report = analyze_trainjob(job(), rt(torch=TorchPolicy(max_restarts=1)))
+        assert not report.has("NODE002"), report.render()
 
 
 class TestInventoryRules:

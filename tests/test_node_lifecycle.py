@@ -1,0 +1,584 @@
+"""Node lifecycle & failure-domain recovery: heartbeats -> NotReady ->
+unreachable taint -> eviction -> gang re-placement, plus the NodeChaos tier.
+
+The scenario the subsystem exists for: a dead TPU host breaks a whole
+slice's ICI mesh, so recovery is not "restart a pod" but "re-solve the
+gang's placement around the dead hardware". Every test here drives that
+machinery through the same public paths a real deployment uses — kubelet
+heartbeats, the lifecycle controller, engine triage, the gang scheduler —
+never by hand-setting the recovered state.
+"""
+
+import training_operator_tpu.api.common as capi
+from training_operator_tpu.api.common import (
+    Container,
+    JobConditionType,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RestartPolicy,
+)
+from training_operator_tpu.api.jobs import JAXJob, ObjectMeta, TPUPolicy
+from training_operator_tpu.cluster.chaos import ChaosMonkey, NodeChaos
+from training_operator_tpu.cluster.inventory import (
+    TPU_RESOURCE,
+    make_cpu_pool,
+    make_tpu_pool,
+)
+from training_operator_tpu.cluster.objects import (
+    NODE_LEASE_NAMESPACE,
+    TAINT_UNREACHABLE,
+    PodPhase,
+    has_taint,
+    node_ready,
+)
+from training_operator_tpu.cluster.runtime import (
+    ANNOTATION_SIM_DURATION,
+    Cluster,
+    DefaultScheduler,
+    SimKubelet,
+    VirtualClock,
+    bind_pod,
+)
+from training_operator_tpu.controllers.jax import JAXController
+from training_operator_tpu.controllers.manager import OperatorManager
+from training_operator_tpu.controllers.nodelifecycle import NodeLifecycleController
+from training_operator_tpu.engine.core import NODE_LOST_MESSAGE_PREFIX
+from training_operator_tpu.scheduler import GangScheduler, TPUPacker
+from training_operator_tpu.utils import metrics
+
+HEARTBEAT = 5.0
+GRACE = 12.0
+TOLERATION = 6.0
+
+
+def make_env(nodes=None, tpu_slices=0, gang=False):
+    cluster = Cluster(VirtualClock())
+    if tpu_slices:
+        cluster.add_nodes(make_tpu_pool(tpu_slices, slice_topology="4x4"))
+    else:
+        cluster.add_nodes(make_cpu_pool(nodes or 4))
+    DefaultScheduler(cluster)
+    kubelet = SimKubelet(cluster, heartbeat_interval=HEARTBEAT)
+    lifecycle = NodeLifecycleController(
+        cluster, grace_period=GRACE, toleration_seconds=TOLERATION
+    )
+    if gang:
+        GangScheduler(cluster, TPUPacker())
+    mgr = OperatorManager(cluster, gang_enabled=gang)
+    mgr.register(JAXController(cluster.api))
+    return cluster, kubelet, lifecycle, mgr
+
+
+def cpu_job(name, workers=2, duration="20", cpu=1.0):
+    tmpl = PodTemplateSpec(
+        containers=[Container(name="jax", image="img", resources={"cpu": cpu})]
+    )
+    tmpl.annotations[ANNOTATION_SIM_DURATION] = duration
+    return JAXJob(
+        metadata=ObjectMeta(name=name),
+        replica_specs={
+            "Worker": ReplicaSpec(
+                replicas=workers, template=tmpl,
+                restart_policy=RestartPolicy.EXIT_CODE,
+            )
+        },
+    )
+
+
+def gang_job(name, duration="500"):
+    """One whole-slice TPU gang: 4 workers x 4 chips on a 4x4 slice."""
+    tmpl = PodTemplateSpec(
+        containers=[Container(
+            name="jax", image="img",
+            resources={"cpu": 1.0, TPU_RESOURCE: 16.0},
+        )],
+        annotations={ANNOTATION_SIM_DURATION: duration},
+    )
+    return JAXJob(
+        metadata=ObjectMeta(name=name),
+        replica_specs={"Worker": ReplicaSpec(
+            replicas=4, template=tmpl, restart_policy=RestartPolicy.EXIT_CODE,
+        )},
+        tpu_policy=TPUPolicy(accelerator="v5e-16", topology="4x4"),
+    )
+
+
+def running_since(cluster, name, after=-1.0):
+    job = cluster.api.get("JAXJob", "default", name)
+    c = capi.get_condition(job.status, JobConditionType.RUNNING)
+    return c is not None and c.status and c.last_transition_time > after
+
+
+def succeeded(cluster, name):
+    job = cluster.api.get("JAXJob", "default", name)
+    return capi.has_condition(job.status, JobConditionType.SUCCEEDED)
+
+
+class TestHeartbeatDetection:
+    def test_heartbeats_keep_nodes_ready(self):
+        cluster, _, _, _ = make_env(nodes=3)
+        cluster.run_for(GRACE * 4)
+        leases = cluster.api.list("Lease", NODE_LEASE_NAMESPACE)
+        assert len(leases) == 3
+        now = cluster.clock.now()
+        assert all(now - l.renew_time <= HEARTBEAT for l in leases)
+        assert all(node_ready(n) for n in cluster.api.list("Node"))
+        assert not cluster.api.events(reason="NodeNotReady")
+
+    def test_lapsed_heartbeat_flips_notready_and_taints(self):
+        cluster, kubelet, _, _ = make_env(nodes=2)
+        cluster.run_for(HEARTBEAT)
+        kubelet.kill_node("cpu-0")
+        t_kill = cluster.clock.now()
+        assert cluster.run_until(
+            lambda: not node_ready(cluster.api.get("Node", "", "cpu-0")),
+            timeout=GRACE * 3,
+        )
+        node = cluster.api.get("Node", "", "cpu-0")
+        assert has_taint(node, TAINT_UNREACHABLE)
+        detect = [e for e in cluster.api.events(reason="NodeNotReady")]
+        assert detect and detect[0].timestamp >= t_kill + GRACE - HEARTBEAT
+        assert metrics.node_notready.value("cpu-0") >= 1.0
+        # The healthy node is untouched.
+        assert node_ready(cluster.api.get("Node", "", "cpu-1"))
+
+    def test_eviction_after_toleration_fails_pods_with_node_lost(self):
+        cluster, kubelet, _, mgr = make_env(nodes=2)
+        mgr.submit(cpu_job("victim", workers=2, duration="500"))
+        assert cluster.run_until(
+            lambda: sum(
+                p.status.phase == PodPhase.RUNNING
+                for p in cluster.api.list("Pod")
+            ) == 2,
+            timeout=60,
+        )
+        target = next(
+            p.node_name for p in cluster.api.list("Pod")
+            if p.status.phase == PodPhase.RUNNING
+        )
+        kubelet.kill_node(target)
+
+        def evicted():
+            return metrics.node_evictions.value(target) >= 1.0
+
+        before = metrics.node_evictions.value(target)
+        assert cluster.run_until(
+            lambda: metrics.node_evictions.value(target) > before,
+            timeout=(GRACE + TOLERATION) * 3,
+        )
+        assert cluster.api.events(reason="PodEvicted")
+        # The engine recreates the evicted pods on the healthy node and the
+        # job converges without burning its restart budget (EXIT_CODE
+        # policy + no exit code would otherwise fail it permanently).
+        assert cluster.run_until(
+            lambda: all(
+                p.node_name != target
+                for p in cluster.api.list("Pod") if not p.is_terminal()
+            ),
+            timeout=120,
+        )
+
+    def test_recovered_heartbeat_clears_taint(self):
+        cluster, kubelet, _, _ = make_env(nodes=2)
+        cluster.run_for(HEARTBEAT)
+        kubelet.kill_node("cpu-0")
+        assert cluster.run_until(
+            lambda: not node_ready(cluster.api.get("Node", "", "cpu-0")),
+            timeout=GRACE * 3,
+        )
+        kubelet.recover_node("cpu-0")
+        assert cluster.run_until(
+            lambda: node_ready(cluster.api.get("Node", "", "cpu-0")),
+            timeout=GRACE * 3,
+        )
+        node = cluster.api.get("Node", "", "cpu-0")
+        assert not has_taint(node, TAINT_UNREACHABLE)
+        assert cluster.api.events(reason="NodeReady")
+        assert metrics.node_recovered.value("cpu-0") >= 1.0
+
+
+class TestKubeletLiveness:
+    """Satellite bugfixes: the kubelet must not run pods on dead or
+    nonexistent hardware, and exec must see host loss."""
+
+    def test_pod_bound_to_nonexistent_node_stays_pending(self):
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_cpu_pool(1))
+        SimKubelet(cluster, heartbeats=False)
+        pod_tmpl = PodTemplateSpec(
+            containers=[Container(name="c", resources={"cpu": 1.0})],
+            annotations={ANNOTATION_SIM_DURATION: "1"},
+        )
+        from training_operator_tpu.cluster.objects import Pod
+
+        pod = Pod(
+            metadata=ObjectMeta(name="ghost", namespace="default",
+                                labels={"app": "x"}),
+            spec=pod_tmpl,
+        )
+        cluster.api.create(pod)
+        live = cluster.api.get("Pod", "default", "ghost")
+        bind_pod(cluster.api, live, "no-such-node", now=cluster.clock.now())
+        cluster.run_for(30.0)
+        assert (
+            cluster.api.get("Pod", "default", "ghost").status.phase
+            == PodPhase.PENDING
+        )
+
+    def test_dead_node_freezes_pod_until_recovery(self):
+        cluster, kubelet, _, mgr = make_env(nodes=1)
+        mgr.submit(cpu_job("froze", workers=1, duration="30"))
+        assert cluster.run_until(
+            lambda: any(
+                p.status.phase == PodPhase.RUNNING
+                for p in cluster.api.list("Pod")
+            ),
+            timeout=60,
+        )
+        kubelet.kill_node("cpu-0")
+        # complete_pod is the chaos/workload seam: it must refuse too.
+        pod = next(p for p in cluster.api.list("Pod"))
+        assert not kubelet.complete_pod("default", pod.name, exit_code=0)
+        # The annotated 30s finish timer fires during the outage: no exit
+        # code can surface from a dead host, so the pod must NOT complete.
+        cluster.run_for(40.0)
+        # (either still RUNNING-stale or already evicted NodeLost — never
+        # SUCCEEDED off a dead host)
+        p = cluster.api.try_get("Pod", "default", pod.name)
+        if p is not None:
+            assert p.status.phase != PodPhase.SUCCEEDED
+
+    def test_exec_into_pod_on_dead_node_is_nonzero(self):
+        cluster, kubelet, _, mgr = make_env(nodes=2)
+        mgr.submit(cpu_job("mpiish", workers=2, duration="500"))
+        assert cluster.run_until(
+            lambda: sum(
+                p.status.phase == PodPhase.RUNNING
+                for p in cluster.api.list("Pod")
+            ) == 2,
+            timeout=60,
+        )
+        pod = next(
+            p for p in cluster.api.list("Pod")
+            if p.status.phase == PodPhase.RUNNING
+        )
+        rc, _ = cluster.exec.exec_in_pod("default", pod.name, ["hostname"])
+        assert rc == 0
+        kubelet.kill_node(pod.node_name)
+        rc, msg = cluster.exec.exec_in_pod("default", pod.name, ["hostname"])
+        assert rc != 0 and pod.node_name in msg
+
+
+class TestGangNodeLoss:
+    """The acceptance e2e: a multi-host TPU gang survives kill_node with
+    the dead node absent from the re-solved placement."""
+
+    def test_gang_resolved_onto_intact_slice(self):
+        cluster, kubelet, _, mgr = make_env(tpu_slices=2, gang=True)
+        mgr.submit(gang_job("gang"))
+        assert cluster.run_until(
+            lambda: running_since(cluster, "gang"), timeout=120
+        )
+        pods0 = [p for p in cluster.api.list("Pod") if not p.is_terminal()]
+        placed0 = sorted(p.node_name for p in pods0)
+        assert len(placed0) == 4 and len(set(placed0)) == 4
+        slice0 = placed0[0].rsplit("-host-", 1)[0]
+
+        chaos = NodeChaos(cluster, kubelet)
+        kill_t = cluster.clock.now()
+        chaos.kill_node(placed0[0])
+        assert chaos.kills, "kill schedule must be non-empty"
+
+        # Full pipeline: NotReady detected -> pods evicted -> gang re-solved
+        # -> Running again.
+        assert cluster.run_until(
+            lambda: running_since(cluster, "gang", after=kill_t), timeout=600
+        ), cluster.api.get("JAXJob", "default", "gang").status
+        mttr = (
+            capi.get_condition(
+                cluster.api.get("JAXJob", "default", "gang").status,
+                JobConditionType.RUNNING,
+            ).last_transition_time - kill_t
+        )
+        assert GRACE <= mttr <= (GRACE + TOLERATION) * 3
+
+        pods1 = [p for p in cluster.api.list("Pod") if not p.is_terminal()]
+        placed1 = sorted(p.node_name for p in pods1)
+        assert placed0[0] not in placed1, "dead node in new placement"
+        # One host of a whole-slice gang died -> contiguity on slice0 is
+        # broken -> the re-solve must migrate the whole gang to the intact
+        # slice.
+        assert all(not n.startswith(slice0) for n in placed1), placed1
+        pg = cluster.api.get("PodGroup", "default", "gang")
+        assert placed0[0] not in pg.placement.values()
+
+        # Observability: the recovery is visible end to end.
+        assert cluster.api.events(reason="NodeNotReady")
+        assert cluster.api.events(reason="PodEvicted")
+        # Exactly ONE invalidation: the gang's own re-placement evictions
+        # must not re-trigger it (that would discard the fresh placement
+        # and add a full evict->solve cycle to every node-loss MTTR).
+        assert len(cluster.api.events(reason="PlacementInvalidated")) == 1
+        tl = cluster.api.get_timeline("default", "gang")
+        span_names = {s["name"] for s in tl["spans"]}
+        assert "node_evict" in span_names, span_names
+        assert "gang_solve" in span_names
+
+    def test_describe_shows_pod_nodes_and_conditions(self):
+        cluster, kubelet, _, mgr = make_env(tpu_slices=2, gang=True)
+        mgr.submit(gang_job("viz"))
+        assert cluster.run_until(
+            lambda: running_since(cluster, "viz"), timeout=120
+        )
+        target = next(
+            p.node_name for p in cluster.api.list("Pod") if not p.is_terminal()
+        )
+        kubelet.kill_node(target)
+        assert cluster.run_until(
+            lambda: not node_ready(cluster.api.get("Node", "", target)),
+            timeout=GRACE * 3,
+        )
+        from training_operator_tpu.observe import render_describe
+
+        text = render_describe(cluster.api, "default", "viz")
+        assert "Pods:" in text and "NODE-STATE" in text
+        assert "NotReady" in text, text
+
+    def test_pending_placement_on_dead_node_is_resolved(self):
+        """An admitted-but-unbound placement whose node dies before binding:
+        the binder must invalidate and the gang re-admit elsewhere."""
+        cluster, kubelet, _, mgr = make_env(tpu_slices=2, gang=True)
+        # Kill a slice-0 host BEFORE submitting: the packer can still pick
+        # slice-0 only if it ignores readiness — it must not.
+        cluster.run_for(HEARTBEAT)
+        kubelet.kill_node("slice-0-host-1")
+        assert cluster.run_until(
+            lambda: not node_ready(cluster.api.get("Node", "", "slice-0-host-1")),
+            timeout=GRACE * 3,
+        )
+        mgr.submit(gang_job("late"))
+        assert cluster.run_until(
+            lambda: running_since(cluster, "late"), timeout=300
+        )
+        placed = {
+            p.node_name for p in cluster.api.list("Pod") if not p.is_terminal()
+        }
+        assert placed == {f"slice-1-host-{i}" for i in range(4)}, placed
+
+
+class TestNodeChaos:
+    def test_same_seed_same_schedule(self):
+        logs = []
+        for _ in range(2):
+            cluster, kubelet, _, mgr = make_env(nodes=4)
+            chaos = NodeChaos(
+                cluster, kubelet, seed=5, interval=7.0, budget=2,
+                recover_after=20.0,
+            )
+            for i in range(2):
+                mgr.submit(cpu_job(f"det-{i}", workers=2, duration="120"))
+            cluster.run_until(lambda: len(chaos.kills) >= 2, timeout=400)
+            logs.append(list(chaos.kills))
+        assert logs[0] == logs[1]
+        assert len(logs[0]) == 2
+
+    def test_kill_slice_is_a_correlated_failure(self):
+        cluster, kubelet, _, mgr = make_env(tpu_slices=2, gang=True)
+        mgr.submit(gang_job("corr"))
+        assert cluster.run_until(
+            lambda: running_since(cluster, "corr"), timeout=120
+        )
+        placed = sorted(
+            p.node_name for p in cluster.api.list("Pod") if not p.is_terminal()
+        )
+        victim_slice = placed[0].rsplit("-host-", 1)[0]
+        chaos = NodeChaos(cluster, kubelet)
+        kill_t = cluster.clock.now()
+        dead = chaos.kill_slice(victim_slice)
+        assert len(dead) == 4 and len(chaos.kills) == 4
+        assert cluster.run_until(
+            lambda: running_since(cluster, "corr", after=kill_t), timeout=600
+        )
+        survivors = sorted(
+            p.node_name for p in cluster.api.list("Pod") if not p.is_terminal()
+        )
+        assert all(not n.startswith(victim_slice) for n in survivors)
+
+    def test_maintenance_window_cordons_drains_uncordons(self):
+        cluster, kubelet, _, mgr = make_env(nodes=2)
+        mgr.submit(cpu_job("maint", workers=2, duration="60"))
+        assert cluster.run_until(
+            lambda: sum(
+                p.status.phase == PodPhase.RUNNING
+                for p in cluster.api.list("Pod")
+            ) == 2,
+            timeout=60,
+        )
+        target = next(
+            p.node_name for p in cluster.api.list("Pod")
+            if p.status.phase == PodPhase.RUNNING
+        )
+        chaos = NodeChaos(cluster, kubelet)
+        start = cluster.clock.now() + 5.0
+        chaos.maintenance_window(target, start=start, duration=30.0)
+        assert cluster.run_until(
+            lambda: cluster.api.get("Node", "", target).unschedulable,
+            timeout=60,
+        )
+        # Drained pods carry the NODE_LOST marker and get rescheduled off
+        # the cordoned node; the job still converges.
+        assert cluster.api.events(reason="NodeDrained")
+        assert cluster.run_until(
+            lambda: not cluster.api.get("Node", "", target).unschedulable,
+            timeout=120,
+        )
+        assert cluster.run_until(lambda: succeeded(cluster, "maint"), timeout=400)
+        assert ("maintenance_begin", target) in [
+            (a, t) for _, a, t in chaos.log
+        ]
+
+
+class TestDrainVerbs:
+    def test_sdk_cordon_drain_uncordon(self):
+        from training_operator_tpu.sdk import TrainingClient
+
+        cluster, kubelet, _, mgr = make_env(nodes=3)
+        client = TrainingClient(cluster)
+        mgr.submit(cpu_job("drainee", workers=2, duration="300"))
+        assert cluster.run_until(
+            lambda: sum(
+                p.status.phase == PodPhase.RUNNING
+                for p in cluster.api.list("Pod")
+            ) == 2,
+            timeout=60,
+        )
+        target = next(
+            p.node_name for p in cluster.api.list("Pod")
+            if p.status.phase == PodPhase.RUNNING
+        )
+        client.cordon_node(target)
+        assert cluster.api.get("Node", "", target).unschedulable
+        evicted = client.drain_node(target)
+        assert evicted, "drain must evict the running pods"
+        for pod_name in evicted:
+            p = cluster.api.try_get("Pod", "default", pod_name)
+            if p is not None and p.status.phase == PodPhase.FAILED:
+                assert p.status.message.startswith(NODE_LOST_MESSAGE_PREFIX)
+        # Recreated pods land elsewhere; the drained node stays empty.
+        assert cluster.run_until(
+            lambda: all(
+                p.node_name != target
+                for p in cluster.api.list("Pod") if not p.is_terminal()
+            ) and sum(
+                p.status.phase == PodPhase.RUNNING
+                for p in cluster.api.list("Pod")
+            ) == 2,
+            timeout=200,
+        )
+        client.uncordon_node(target)
+        assert not cluster.api.get("Node", "", target).unschedulable
+
+
+class TestChaosMatrix:
+    """Satellite: NodeChaos + WireChaos + ChaosMonkey in one seeded
+    scenario — node deaths, wire faults against a remote operator, and pod
+    SIGKILLs at once — and every job still converges. Kill schedules are
+    asserted non-empty so the pass can't be vacuous."""
+
+    def test_all_three_tiers_at_once(self):
+        import logging
+
+        from training_operator_tpu.cluster.chaos import WireChaos
+        from training_operator_tpu.cluster.httpapi import (
+            ApiHTTPServer,
+            ApiServerError,
+            ApiUnavailableError,
+            RemoteAPIServer,
+            RemoteRuntime,
+        )
+
+        # The storm makes the manager log a traceback per failed reconcile
+        # (~8% of thousands); pytest's log capture formatting those eats
+        # the real-clock deadline. The errors are the EXPECTED chaos, not
+        # diagnostics — silence the logger for the storm's duration.
+        mgr_log = logging.getLogger("training_operator_tpu.controllers.manager")
+        prev_disabled = mgr_log.disabled
+        mgr_log.disabled = True
+
+        host = Cluster()  # real clock: the wire tier needs real HTTP
+        host.add_nodes(make_cpu_pool(4, cpu_per_node=8.0))
+        DefaultScheduler(host)
+        kubelet = SimKubelet(host, heartbeat_interval=0.2)
+        NodeLifecycleController(host, grace_period=0.8, toleration_seconds=0.3)
+        wire = WireChaos(seed=9, error_rate=0.08, reset_rate=0.03)
+        server = ApiHTTPServer(host.api, port=0, chaos=wire)
+        try:
+            remote = RemoteAPIServer(server.url, timeout=10.0)
+            runtime = RemoteRuntime(remote, tick_interval=0.0)
+            for _ in range(50):
+                try:
+                    mgr = OperatorManager(runtime, resync_period=2.0)
+                    mgr.register(JAXController(runtime.api))
+                    break
+                except (ApiUnavailableError, ApiServerError):
+                    continue
+            else:
+                raise AssertionError("operator never booted through the storm")
+
+            monkey = ChaosMonkey(host, kubelet, seed=9, interval=0.6, budget=3)
+            nodes = NodeChaos(host, kubelet, seed=9, interval=1.0, budget=1,
+                              recover_after=2.0)
+            jobs = []
+            for i in range(4):
+                tmpl = PodTemplateSpec(
+                    containers=[Container(name="jax", resources={"cpu": 1.0})],
+                    annotations={ANNOTATION_SIM_DURATION: "1.0"},
+                )
+                jobs.append(JAXJob(
+                    metadata=ObjectMeta(name=f"matrix-{i}"),
+                    replica_specs={"Worker": ReplicaSpec(
+                        replicas=2, template=tmpl,
+                        restart_policy=RestartPolicy.EXIT_CODE,
+                    )},
+                ))
+            for job in jobs:
+                for _ in range(200):
+                    try:
+                        remote.create(job)
+                        break
+                    except (ApiUnavailableError, ApiServerError):
+                        continue
+                else:
+                    raise AssertionError("create never got through the storm")
+
+            def all_done():
+                return all(
+                    (j := host.api.try_get("JAXJob", "default", f"matrix-{i}"))
+                    is not None and capi.is_succeeded(j.status)
+                    for i in range(4)
+                )
+
+            deadline = host.clock.now() + 120.0
+            while host.clock.now() < deadline and not (
+                all_done() and nodes.kills and monkey.kills
+            ):
+                host.step()
+                try:
+                    runtime.step()
+                except (ApiUnavailableError, ApiServerError):
+                    pass
+            assert all_done(), {
+                f"matrix-{i}": getattr(
+                    host.api.try_get("JAXJob", "default", f"matrix-{i}"),
+                    "status", None,
+                )
+                for i in range(4)
+            }
+            # No vacuous pass: every tier actually struck.
+            assert nodes.kills, "NodeChaos never killed a node"
+            assert monkey.kills, "ChaosMonkey never killed a pod"
+            assert sum(wire.injected.values()) > 0, wire.injected
+            mgr.stop()
+        finally:
+            mgr_log.disabled = prev_disabled
+            server.close()

@@ -173,6 +173,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--aging-seconds", type=float, default=None,
                     help="tpu-packer starvation bound: gangs waiting longer "
                          "are promoted to FIFO front (default 300)")
+    ap.add_argument("--node-heartbeat-interval", type=float, default=None,
+                    help="kubelet node-lease renewal period (default 10)")
+    ap.add_argument("--node-grace-period", type=float, default=None,
+                    help="heartbeat silence before a node is NotReady + "
+                         "tainted unreachable (default 40)")
+    ap.add_argument("--node-toleration-seconds", type=float, default=None,
+                    help="taint age before pods on a dead node are evicted "
+                         "(default 30)")
     ap.add_argument("--namespace", default=None, help="namespace scope (default: all)")
     ap.add_argument("--controller-threads", type=int, default=None,
                     help="reconciles drained per manager tick")
@@ -218,6 +226,12 @@ def build_config(args: argparse.Namespace) -> OperatorConfig:
         cfg.aging_seconds = args.aging_seconds
     if args.namespace is not None:
         cfg.namespace = args.namespace
+    if args.node_heartbeat_interval is not None:
+        cfg.node_heartbeat_interval = args.node_heartbeat_interval
+    if args.node_grace_period is not None:
+        cfg.node_grace_period = args.node_grace_period
+    if args.node_toleration_seconds is not None:
+        cfg.node_toleration_seconds = args.node_toleration_seconds
     if args.controller_threads is not None:
         cfg.controller_threads = args.controller_threads
     if args.compact_every is not None:
@@ -281,10 +295,18 @@ def wire_cluster_services(cluster: Cluster, cfg: OperatorConfig) -> None:
     upstream — it acts on HPA objects the controllers create), and the
     configured gang scheduler. Shared by standalone build_stack and the
     host role so the two can't drift."""
+    from training_operator_tpu.controllers.nodelifecycle import (
+        NodeLifecycleController,
+    )
     from training_operator_tpu.scheduler.elastic import HorizontalAutoscaler
 
     DefaultScheduler(cluster)
-    SimKubelet(cluster)
+    SimKubelet(cluster, heartbeat_interval=cfg.node_heartbeat_interval)
+    NodeLifecycleController(
+        cluster,
+        grace_period=cfg.node_grace_period,
+        toleration_seconds=cfg.node_toleration_seconds,
+    )
     HorizontalAutoscaler(cluster)
     if cfg.gang_scheduler_name != "none":
         placer = {
@@ -686,6 +708,51 @@ def run_describe(argv) -> int:
     return 0
 
 
+def run_node_verb(verb: str, argv) -> int:
+    """`python -m training_operator_tpu cordon|uncordon|drain <node>` — the
+    kubectl node-admin verbs against a serving host. Drain = cordon + evict
+    every pod on the node with the NODE_LOST marker, so the engine
+    reschedules them (and gangs re-solve) without burning restart budget."""
+    import os as _os
+
+    ap = argparse.ArgumentParser(
+        prog=f"python -m training_operator_tpu {verb}",
+        description=f"{verb} one node on a serving host",
+    )
+    ap.add_argument("node", help="node name")
+    ap.add_argument("--api-server", required=True, metavar="URL",
+                    help="base URL of the serving host (WIRE_API=...)")
+    ap.add_argument("--api-token", default=None,
+                    help="bearer token (env TPU_OPERATOR_API_TOKEN)")
+    ap.add_argument("--ca-cert", default=None, metavar="PEM",
+                    help="CA bundle pinning an https host (WIRE_CA=...; "
+                         "env TPU_OPERATOR_CA_CERT)")
+    args = ap.parse_args(argv)
+    from training_operator_tpu.cluster.httpapi import RemoteAPIServer
+    from training_operator_tpu.controllers.nodelifecycle import (
+        cordon_node,
+        drain_node,
+        uncordon_node,
+    )
+
+    api = RemoteAPIServer(
+        args.api_server,
+        token=args.api_token or _os.environ.get("TPU_OPERATOR_API_TOKEN") or None,
+        ca_file=args.ca_cert or _os.environ.get("TPU_OPERATOR_CA_CERT") or None,
+    )
+    now = api.server_time()
+    if verb == "cordon":
+        cordon_node(api, args.node, now=now)
+        print(f"node/{args.node} cordoned")
+    elif verb == "uncordon":
+        uncordon_node(api, args.node, now=now)
+        print(f"node/{args.node} uncordoned")
+    else:
+        evicted = drain_node(api, args.node, now=now)
+        print(f"node/{args.node} drained ({len(evicted)} pod(s) evicted)")
+    return 0
+
+
 def main(argv=None) -> int:
     raw = list(sys.argv[1:] if argv is None else argv)
     if raw and raw[0] == "lint":
@@ -696,6 +763,8 @@ def main(argv=None) -> int:
         return lint_run(raw[1:])
     if raw and raw[0] == "describe":
         return run_describe(raw[1:])
+    if raw and raw[0] in ("cordon", "uncordon", "drain"):
+        return run_node_verb(raw[0], raw[1:])
     args = parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,

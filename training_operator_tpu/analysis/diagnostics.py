@@ -139,6 +139,18 @@ RULES: Dict[str, Rule] = {
             "down to a whole slice count",
             "override in whole-slice steps (multiples of numNodes/numSlices)",
         ),
+        Rule(
+            "NODE002", "restart-budget-below-host-failure", Severity.WARN,
+            "a multi-host TPU job's restart budget cannot absorb even one "
+            "host failure: torch maxRestarts is 0 (explicitly, or unset — "
+            "torchrun's default is 0) or the trainer template's restart "
+            "policy is Never. Losing one host breaks the slice's ICI mesh; "
+            "surviving workers then exit, and with zero budget those exits "
+            "fail the job permanently",
+            "set mlPolicy.torch.maxRestarts >= 1 (sized to host-failure "
+            "rate x job duration), or use an OnFailure/ExitCode restart "
+            "policy on the trainer template",
+        ),
     ]
 }
 

@@ -274,6 +274,37 @@ def analyze_trainjob(
                 "trainer.numNodes",
             )
 
+    # Restart budget vs host failure (NODE002): on a multi-host TPU job one
+    # dead host breaks the whole slice's ICI mesh — the gang re-solves and
+    # every worker restarts. Node-lost evictions themselves are budget-free
+    # (engine triage), but the SURVIVING workers' own exits are not: with
+    # torch maxRestarts 0 (explicit, or unset — torchrun defaults to 0) or
+    # a Never trainer restart policy, those exits fail the job permanently.
+    if n_resolved > 1:
+        if torch is not None and (torch.max_restarts or 0) < 1:
+            report.add(
+                "NODE002",
+                f"multi-host TPU job ({n_resolved} hosts) has "
+                f"maxRestarts={'0 (torchrun default)' if torch.max_restarts is None else torch.max_restarts}"
+                " — it cannot survive a single host failure",
+                "mlPolicy.torch.maxRestarts",
+            )
+        else:
+            from training_operator_tpu.api.common import RestartPolicy
+
+            rj = runtime.spec.replicated_job(TRAINER_NODE)
+            if (
+                rj is not None
+                and rj.template.restart_policy == RestartPolicy.NEVER
+            ):
+                report.add(
+                    "NODE002",
+                    f"multi-host TPU job ({n_resolved} hosts) with a Never "
+                    "trainer restart policy — surviving workers' exits after "
+                    "one host failure fail the job permanently",
+                    "spec.template.restartPolicy",
+                )
+
     # -- inventory-dependent rules ------------------------------------------
     if nodes is not None:
         classes = slice_classes_from_nodes(nodes)

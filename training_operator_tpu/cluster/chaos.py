@@ -104,6 +104,147 @@ class ChaosMonkey:
         self._schedule_next()
 
 
+class NodeChaos:
+    """Node-tier fault injection — the fourth chaos tier, next to the pod
+    tier (ChaosMonkey), the store tier (APIChaos), and the wire tier
+    (WireChaos). Kills are HOST deaths, not pod exits: the node's heartbeat
+    goes silent via `SimKubelet.kill_node`, its pods freeze in their last
+    written phase, and everything downstream — NotReady detection, the
+    unreachable taint, eviction, gang re-placement — must be EARNED by the
+    node lifecycle machinery, exactly as a real dead TPU host would demand.
+
+    Three injection shapes, all virtual-clock friendly and logged for
+    replay (`self.log` records (time, action, target); `self.kills` mirrors
+    ChaosMonkey's (time, node) kill schedule):
+
+      kill_node/recover_node     one host down (and optionally back)
+      kill_slice                 a whole TPU slice at once — the correlated
+                                 failure domain ICI-mesh placement creates
+      maintenance_window         planned cordon+drain at `start`, uncordon
+                                 after `duration` (the graceful twin)
+
+    Random mode (budget > 0): every `interval` a seeded strike kills one
+    node currently hosting a RUNNING pod; `recover_after` brings it back,
+    modelling reboot-class outages. Identical seeds replay identical
+    schedules."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        kubelet: SimKubelet,
+        seed: int = 0,
+        interval: float = 60.0,
+        budget: int = 0,
+        recover_after: Optional[float] = None,
+    ):
+        self.cluster = cluster
+        self.kubelet = kubelet
+        self.rng = random.Random(seed)
+        self.interval = interval
+        self.budget = budget
+        self.recover_after = recover_after
+        self.kills: List[Tuple[float, str]] = []
+        self.log: List[Tuple[float, str, str]] = []
+        self.empty_strikes = 0
+        self.max_empty_strikes = 3
+        self._armed = True
+        if budget > 0:
+            self.cluster.schedule_after(self.interval, self._strike)
+
+    def stop(self) -> None:
+        self._armed = False
+
+    # -- explicit injections -------------------------------------------
+
+    def _record(self, action: str, target: str) -> None:
+        self.log.append((self.cluster.clock.now(), action, target))
+
+    def kill_node(self, name: str) -> None:
+        self.kubelet.kill_node(name)
+        now = self.cluster.clock.now()
+        self.kills.append((now, name))
+        self._record("kill", name)
+
+    def recover_node(self, name: str) -> None:
+        self.kubelet.recover_node(name)
+        self._record("recover", name)
+
+    def kill_slice(self, slice_id: str) -> List[str]:
+        """Correlated failure: every host of one TPU slice dies at once."""
+        members = [
+            n.name
+            for n in self.cluster.api.list_refs("Node")
+            if n.accelerator.kind == "tpu" and n.accelerator.tpu_slice == slice_id
+        ]
+        for name in sorted(members):
+            self.kill_node(name)
+        self._record("kill_slice", slice_id)
+        return sorted(members)
+
+    def schedule_kill(self, name: str, at: float) -> None:
+        self.cluster.schedule_at(at, lambda: self._armed and self.kill_node(name))
+
+    def schedule_recover(self, name: str, at: float) -> None:
+        self.cluster.schedule_at(at, lambda: self._armed and self.recover_node(name))
+
+    def maintenance_window(self, name: str, start: float, duration: float) -> None:
+        """Planned outage: cordon+drain at `start` (pods rescheduled
+        gracefully, gangs re-solved), uncordon at `start + duration`."""
+        from training_operator_tpu.controllers.nodelifecycle import (
+            drain_node,
+            uncordon_node,
+        )
+
+        def begin():
+            if not self._armed:
+                return
+            drain_node(self.cluster.api, name, now=self.cluster.clock.now())
+            self._record("maintenance_begin", name)
+
+        def end():
+            if not self._armed:
+                return
+            uncordon_node(self.cluster.api, name, now=self.cluster.clock.now())
+            self._record("maintenance_end", name)
+
+        self.cluster.schedule_at(start, begin)
+        self.cluster.schedule_at(start + duration, end)
+
+    # -- random strikes ------------------------------------------------
+
+    def _strike(self) -> None:
+        if not self._armed or len(self.kills) >= self.budget:
+            return
+        pods = self.cluster.api.list("Pod")
+        busy = sorted({
+            p.node_name
+            for p in pods
+            if p.node_name
+            and p.status.phase == PodPhase.RUNNING
+            and self.kubelet.node_alive(p.node_name)
+        })
+        if busy:
+            victim = self.rng.choice(busy)
+            self.kill_node(victim)
+            self.empty_strikes = 0
+            if self.recover_after is not None:
+                self.schedule_recover(
+                    victim, self.cluster.clock.now() + self.recover_after
+                )
+        elif any(not p.is_terminal() for p in pods):
+            # Pods exist but none RUNNING yet (scheduling/recovery lag):
+            # stay armed, like ChaosMonkey — disarming would quietly strip
+            # chaos from a slow-starting workload.
+            pass
+        else:
+            self.empty_strikes += 1
+        if self.empty_strikes >= self.max_empty_strikes:
+            self._armed = False
+            return
+        if len(self.kills) < self.budget:
+            self.cluster.schedule_after(self.interval, self._strike)
+
+
 class APIChaos:
     """Control-plane fault injection against one APIServer.
 

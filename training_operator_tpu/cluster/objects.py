@@ -146,6 +146,16 @@ class AcceleratorInfo:
         return dataclasses.asdict(self)
 
 
+# Node-lifecycle constants (the kube-node-lease / taint-manager analogue):
+# heartbeat Leases live in their own namespace, keyed by node name; a node
+# whose heartbeat lapses gets Ready=False plus the NoExecute unreachable
+# taint (reference: node.kubernetes.io/unreachable via the k8s node
+# lifecycle controller), which evicts pods after their toleration window.
+NODE_LEASE_NAMESPACE = "node-leases"
+TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
+NODE_CONDITION_READY = "Ready"
+
+
 @dataclass
 class Node:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
@@ -157,6 +167,12 @@ class Node:
     # scheduler, gang placers) refuses NoSchedule/NoExecute taints a pod's
     # tolerations don't cover.
     taints: List[Dict[str, Any]] = field(default_factory=list)
+    # Node conditions, k8s-shaped dicts: {"type", "status" ("True"/"False"/
+    # "Unknown"), "reason", "message", "last_transition_time"}. Written by
+    # the node lifecycle controller from heartbeat observations; a node
+    # with NO Ready condition is treated as Ready (static inventory records
+    # predate the heartbeat machinery and must stay schedulable).
+    conditions: List[Dict[str, Any]] = field(default_factory=list)
 
     KIND = "Node"
 
@@ -169,6 +185,58 @@ class Node:
 
     def matches_selector(self, selector: Dict[str, str]) -> bool:
         return all(self.metadata.labels.get(k) == v for k, v in selector.items())
+
+
+def get_node_condition(node: Node, cond_type: str) -> Optional[Dict[str, Any]]:
+    for c in node.conditions:
+        if c.get("type") == cond_type:
+            return c
+    return None
+
+
+def node_ready(node: Node) -> bool:
+    """Ready unless an explicit Ready condition says otherwise — every
+    placement surface (snapshot, default scheduler, gang binder) and the
+    exec channel must agree on this one predicate."""
+    cond = get_node_condition(node, NODE_CONDITION_READY)
+    return cond is None or cond.get("status") == "True"
+
+
+def set_node_condition(
+    node: Node, cond_type: str, status: str, reason: str, message: str, now: float
+) -> bool:
+    """Set/replace one condition; returns True when the status actually
+    transitioned (callers write + emit events only on transitions)."""
+    cond = get_node_condition(node, cond_type)
+    if cond is not None and cond.get("status") == status:
+        return False
+    fresh = {
+        "type": cond_type,
+        "status": status,
+        "reason": reason,
+        "message": message,
+        "last_transition_time": now,
+    }
+    node.conditions = [c for c in node.conditions if c.get("type") != cond_type]
+    node.conditions.append(fresh)
+    return True
+
+
+def has_taint(node: Node, key: str) -> bool:
+    return any(t.get("key") == key for t in node.taints)
+
+
+def add_taint(node: Node, key: str, effect: str = "NoExecute") -> bool:
+    if has_taint(node, key):
+        return False
+    node.taints.append({"key": key, "effect": effect})
+    return True
+
+
+def remove_taint(node: Node, key: str) -> bool:
+    before = len(node.taints)
+    node.taints = [t for t in node.taints if t.get("key") != key]
+    return len(node.taints) != before
 
 
 def toleration_key(t: Dict[str, Any]) -> tuple:

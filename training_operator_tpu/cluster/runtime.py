@@ -18,10 +18,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from training_operator_tpu.cluster.apiserver import APIServer, SharedInformer
 from training_operator_tpu.cluster.objects import (
+    NODE_LEASE_NAMESPACE,
     ContainerStatus,
+    Lease,
     Node,
     Pod,
     PodPhase,
+    node_ready,
     tolerates,
 )
 
@@ -90,6 +93,9 @@ class Cluster:
         # Substrate exec primitive (see ExecChannel): the MPI launchers'
         # rsh/bootstrap channel into worker pods.
         self.exec = ExecChannel(self)
+        # The attached SimKubelet, if any (set by its constructor): the
+        # authoritative node-liveness source for the exec channel.
+        self.kubelet = None
         self._tickers: List[Callable[[], None]] = []
         self._timers: List[Tuple[float, int, Callable[[], None]]] = []
         self._timer_seq = itertools.count()
@@ -228,6 +234,20 @@ class ExecChannel:
             return 127, f"pod {namespace}/{pod_name} not found"
         if pod.status.phase != PodPhase.RUNNING:
             return 1, f"pod {pod_name} is {pod.status.phase.value}, not Running"
+        # Host-loss gate: exec into a pod whose node is dead/NotReady must
+        # fail like a dropped ssh connection (255), NOT vacuously succeed —
+        # MPI launchers key remote-host health on this status. Three
+        # liveness sources, strongest first: the kubelet's own dead set
+        # (instant truth in sims), node existence, Ready condition.
+        if pod.node_name:
+            kubelet = getattr(self.cluster, "kubelet", None)
+            if kubelet is not None and not kubelet.node_alive(pod.node_name):
+                return 255, f"node {pod.node_name} is down"
+            node = self.cluster.api.try_get("Node", "", pod.node_name)
+            if node is None:
+                return 255, f"node {pod.node_name} no longer exists"
+            if not node_ready(node):
+                return 255, f"node {pod.node_name} is NotReady"
         self.log.append((namespace, pod_name, tuple(argv)))
         return 0, ""
 
@@ -308,7 +328,7 @@ class DefaultScheduler:
                 bucket[k] = bucket.get(k, 0.0) + v
         free: Dict[str, Dict[str, float]] = {}
         for node in self._nodes.values():
-            if node.unschedulable:
+            if node.unschedulable or not node_ready(node):
                 continue
             u = used.get(node.name, {})
             free[node.name] = {
@@ -362,13 +382,28 @@ class SimKubelet:
     """Virtual kubelet: starts bound pods after a latency, optionally completes
     them after an annotated duration with an annotated exit code.
 
+    Node lifecycle duties (the kube-node-lease analogue): every
+    `heartbeat_interval` the kubelet renews one Lease per live node in the
+    `node-leases` namespace. `kill_node` silences a node — its heartbeat
+    stops, its pods neither start nor complete (the processes died with the
+    host), and detection is the node lifecycle controller's job, exactly
+    like a real dead host. `recover_node` resumes the heartbeat.
+
     Tests that want envtest-style manual phase control simply don't attach a
     kubelet (or never annotate durations) and mutate pod phases directly.
     """
 
-    def __init__(self, cluster: Cluster, start_latency: float = 0.0):
+    def __init__(
+        self,
+        cluster: Cluster,
+        start_latency: float = 0.0,
+        heartbeat_interval: float = 10.0,
+        heartbeats: bool = True,
+    ):
         self.cluster = cluster
         self.start_latency = start_latency
+        self.heartbeat_interval = heartbeat_interval
+        self._dead_nodes: set = set()
         self._starting: set = set()
         # Informer pattern: newly-bound pods arrive as watch events instead
         # of a full pod scan per tick (O(events), not O(cluster x steps)).
@@ -376,6 +411,68 @@ class SimKubelet:
         self._watch = cluster.api.watch(kinds=("Pod",))
         self._backlog = list(cluster.api.list("Pod"))
         cluster.add_ticker(self.tick)
+        # The cluster's kubelet handle (ExecChannel's liveness source).
+        cluster.kubelet = self
+        if heartbeats:
+            # First beat immediately-ish via timer (not inline: nodes may be
+            # added right after construction), then every interval.
+            self.cluster.schedule_after(0.0, self._heartbeat)
+
+    # -- node liveness -----------------------------------------------------
+
+    def node_alive(self, name: str) -> bool:
+        return (
+            bool(name)
+            and name not in self._dead_nodes
+            and self.cluster.api.resource_version("Node", "", name) is not None
+        )
+
+    def kill_node(self, name: str) -> None:
+        """The host died: heartbeat stops, nothing on it starts or finishes.
+        Pod objects keep their last written phase — a dead kubelet writes
+        nothing — until the lifecycle controller evicts them."""
+        self._dead_nodes.add(name)
+
+    def recover_node(self, name: str) -> None:
+        self._dead_nodes.discard(name)
+        self._beat_one(name, self.cluster.clock.now())
+        # Pods bound to this node that waited out the outage: re-arm starts
+        # (their bind event was consumed while it was dead) and completion
+        # timers (the finisher that fired during the outage no-op'd).
+        for pod in self.cluster.api.list("Pod"):
+            if pod.node_name != name:
+                continue
+            if pod.status.phase == PodPhase.PENDING:
+                self._maybe_start(pod)
+            elif pod.status.phase == PodPhase.RUNNING:
+                self._maybe_recover(pod)
+
+    def _beat_one(self, name: str, now: float) -> None:
+        api = self.cluster.api
+        lease = api.try_get("Lease", NODE_LEASE_NAMESPACE, name)
+        if lease is None:
+            from training_operator_tpu.api.jobs import ObjectMeta
+
+            lease = Lease(
+                metadata=ObjectMeta(name=name, namespace=NODE_LEASE_NAMESPACE),
+                holder=name,
+                lease_duration=self.heartbeat_interval,
+                acquire_time=now,
+                renew_time=now,
+            )
+            api.create(lease)
+        else:
+            lease.renew_time = now
+            api.update(lease, check_version=False)
+
+    def _heartbeat(self) -> None:
+        now = self.cluster.clock.now()
+        for node in self.cluster.api.list_refs("Node"):
+            if node.name not in self._dead_nodes:
+                self._beat_one(node.name, now)
+        self.cluster.schedule_after(self.heartbeat_interval, self._heartbeat)
+
+    # -- pod lifecycle -----------------------------------------------------
 
     def tick(self) -> None:
         backlog, self._backlog = self._backlog, []
@@ -409,6 +506,7 @@ class SimKubelet:
         if (
             pod.node_name
             and pod.status.phase == PodPhase.PENDING
+            and self.node_alive(pod.node_name)  # dead/vanished host: stay PENDING
             and pod.metadata.uid not in self._starting
         ):
             self._starting.add(pod.metadata.uid)
@@ -423,6 +521,11 @@ class SimKubelet:
         def start():
             pod = self.cluster.api.try_get("Pod", namespace, name)
             if pod is None or pod.metadata.uid != uid or pod.status.phase != PodPhase.PENDING:
+                self._starting.discard(uid)
+                return
+            if not self.node_alive(pod.node_name):
+                # Node died between bind and start: the pod stays PENDING
+                # (recover_node re-arms it; eviction handles the rest).
                 self._starting.discard(uid)
                 return
             pod.status.phase = PodPhase.RUNNING
@@ -464,6 +567,8 @@ class SimKubelet:
         pod = self.cluster.api.try_get("Pod", namespace, name)
         if pod is None or pod.status.phase != PodPhase.RUNNING:
             return False
+        if not self.node_alive(pod.node_name):
+            return False  # nothing on a dead host exits with a code
         if log:
             self.cluster.api.append_pod_log(
                 namespace, name, log, self.cluster.clock.now()
@@ -485,6 +590,11 @@ class SimKubelet:
         def finish():
             pod = self.cluster.api.try_get("Pod", namespace, name)
             if pod is None or pod.metadata.uid != uid or pod.status.phase != PodPhase.RUNNING:
+                return
+            if not self.node_alive(pod.node_name):
+                # The host (and the container's process) is gone: no exit
+                # code will ever surface. Leave the stale RUNNING phase for
+                # the node lifecycle controller to evict.
                 return
             # Honor pod-level restart policy the way the kubelet does:
             # Always restarts in place on any exit; OnFailure on exit != 0;

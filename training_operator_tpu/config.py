@@ -76,6 +76,17 @@ class OperatorConfig:
     # (survives power loss, at the cost of gating every control-plane write
     # on disk latency; etcd batches fsyncs for the same reason).
     journal_fsync: bool = False
+    # Node lifecycle (controllers/nodelifecycle.py + SimKubelet heartbeats):
+    #   node_heartbeat_interval — kubelet Lease renewal period per node.
+    #   node_grace_period — heartbeat silence before a node flips NotReady
+    #       and takes the unreachable NoExecute taint (k8s default 40s).
+    #   node_toleration_seconds — how long tainted pods get before eviction
+    #       (k8s defaults 300s; shorter here because a broken ICI mesh
+    #       stalls the whole gang for exactly this window before recovery
+    #       can even begin).
+    node_heartbeat_interval: float = 10.0
+    node_grace_period: float = 40.0
+    node_toleration_seconds: float = 30.0
     # Probe/metrics HTTP port; 0 disables (reference --health-probe-bind-
     # address / --metrics-bind-address, collapsed to one server here).
     health_port: int = 0
@@ -127,6 +138,16 @@ class OperatorConfig:
             raise ValueError("max_drain_fraction must be in [0, 1]")
         if self.aging_seconds < 0:
             raise ValueError("aging_seconds must be >= 0")
+        if self.node_heartbeat_interval <= 0:
+            raise ValueError("node_heartbeat_interval must be > 0")
+        if self.node_grace_period <= self.node_heartbeat_interval:
+            # A grace shorter than one heartbeat period marks every healthy
+            # node NotReady between beats: permanent flapping, not detection.
+            raise ValueError(
+                "node_grace_period must exceed node_heartbeat_interval"
+            )
+        if self.node_toleration_seconds < 0:
+            raise ValueError("node_toleration_seconds must be >= 0")
         if self.leader_lease_duration <= 0:
             # A non-positive lease is permanently expired: leadership would
             # flap between candidates every tick, each transition firing a

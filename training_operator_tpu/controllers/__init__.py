@@ -10,6 +10,12 @@ from training_operator_tpu.controllers.base import BaseController
 from training_operator_tpu.controllers.jax import JAXController
 from training_operator_tpu.controllers.manager import OperatorManager
 from training_operator_tpu.controllers.mpi import MPIController
+from training_operator_tpu.controllers.nodelifecycle import (
+    NodeLifecycleController,
+    cordon_node,
+    drain_node,
+    uncordon_node,
+)
 from training_operator_tpu.controllers.paddle import PaddleController
 from training_operator_tpu.controllers.pytorch import PyTorchController
 from training_operator_tpu.controllers.tensorflow import TensorFlowController
@@ -37,10 +43,14 @@ __all__ = [
     "BaseController",
     "JAXController",
     "MPIController",
+    "NodeLifecycleController",
     "OperatorManager",
     "PaddleController",
     "PyTorchController",
     "TensorFlowController",
     "XGBoostController",
+    "cordon_node",
+    "drain_node",
     "register_all",
+    "uncordon_node",
 ]

@@ -1,0 +1,329 @@
+"""Node lifecycle: heartbeat-lapse detection, unreachable taint, eviction.
+
+The reference inherits its node-loss story wholesale from Kubernetes: node
+lease heartbeats -> NotReady -> `node.kubernetes.io/unreachable` NoExecute
+taint -> pod eviction -> controller restart triage. This controller is that
+pipeline for the substrate:
+
+  1. `SimKubelet` renews one Lease per live node (cluster/runtime.py); a
+     dead host simply stops renewing — detection, not notification.
+  2. When a node's heartbeat lapses past `grace_period`, the node's Ready
+     condition flips False and the unreachable NoExecute taint is applied.
+  3. After `toleration_seconds` more, every pod stranded on the node is
+     evicted: failed with the NODE_LOST message the engine's triage treats
+     as retryable regardless of restart policy (engine/core.py).
+  4. A resumed heartbeat flips the node back to Ready and removes the taint.
+
+Pods on nodes that no longer EXIST are evicted immediately (the k8s pod-GC
+rule — there is no host to come back). Everything is virtual-clock
+friendly: deadline checks ride the tick, and a wakeup timer is armed at the
+earliest pending deadline so `run_until` can jump straight to it.
+
+The module also carries the cordon/uncordon/drain verbs (shared by the SDK,
+the CLI, and NodeChaos maintenance windows) so every caller agrees on what
+"drain" means: cordon + evict, with the same NODE_LOST marker.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from training_operator_tpu.api.common import JOB_KIND_LABEL, JOB_NAME_LABEL
+from training_operator_tpu.cluster.objects import (
+    NODE_CONDITION_READY,
+    NODE_LEASE_NAMESPACE,
+    TAINT_UNREACHABLE,
+    Event,
+    Node,
+    Pod,
+    add_taint,
+    node_ready,
+    remove_taint,
+    set_node_condition,
+    tolerates,
+)
+from training_operator_tpu.cluster.runtime import Cluster
+from training_operator_tpu.engine.core import NODE_LOST_MESSAGE_PREFIX
+from training_operator_tpu.utils import metrics
+
+
+def evict_pod(api, pod: Pod, reason: str, now: float, node_name: str = "",
+              detect_at: Optional[float] = None) -> bool:
+    """Fail one pod because its node is gone/dead/drained — THE eviction
+    primitive (lifecycle controller, drain verb, and the gang scheduler's
+    re-placement all route through it so the NODE_LOST marker, the metric,
+    the Event, and the timeline span can never diverge). Returns False when
+    the pod is already terminal or deleted."""
+    fresh = api.try_get("Pod", pod.namespace, pod.name)
+    if fresh is None or fresh.is_terminal():
+        return False
+    from training_operator_tpu.cluster.objects import PodPhase
+
+    fresh.status.phase = PodPhase.FAILED
+    fresh.status.finish_time = now
+    fresh.status.message = f"{NODE_LOST_MESSAGE_PREFIX}: {reason}"
+    for cs in fresh.status.container_statuses:
+        cs.running = False
+    api.update(fresh, check_version=False)
+    metrics.node_evictions.inc(node_name or fresh.node_name or "")
+    job_name = fresh.metadata.labels.get(JOB_NAME_LABEL)
+    api.record_event(Event(
+        object_kind=fresh.metadata.labels.get(JOB_KIND_LABEL, "Pod"),
+        object_name=job_name or fresh.name,
+        namespace=fresh.namespace,
+        event_type="Warning",
+        reason="PodEvicted",
+        message=f"pod {fresh.name} evicted: {reason}",
+        timestamp=now,
+    ))
+    if job_name:
+        # Timeline: detect -> evict, on the owning job's lifecycle (the
+        # gang_solve + bind spans that follow complete the recovery story
+        # `describe` renders).
+        api.timelines.record_span(
+            fresh.namespace, job_name, fresh.metadata.owner_uid or "",
+            "node_evict",
+            start=detect_at if detect_at is not None else now, end=now,
+            pod=fresh.name, node=node_name or fresh.node_name or "",
+        )
+    return True
+
+
+def cordon_node(api, name: str, now: float = 0.0) -> Node:
+    """Mark a node unschedulable (kubectl cordon). Running pods stay."""
+    node = api.get("Node", "", name)
+    if not node.unschedulable:
+        node.unschedulable = True
+        api.update(node, check_version=False)
+        api.record_event(Event(
+            object_kind="Node", object_name=name, event_type="Normal",
+            reason="NodeCordoned", message=f"node {name} marked unschedulable",
+            timestamp=now,
+        ))
+    return node
+
+
+def uncordon_node(api, name: str, now: float = 0.0) -> Node:
+    node = api.get("Node", "", name)
+    if node.unschedulable:
+        node.unschedulable = False
+        api.update(node, check_version=False)
+        api.record_event(Event(
+            object_kind="Node", object_name=name, event_type="Normal",
+            reason="NodeUncordoned", message=f"node {name} schedulable again",
+            timestamp=now,
+        ))
+    return node
+
+
+def drain_node(api, name: str, now: float = 0.0) -> List[str]:
+    """kubectl drain: cordon, then evict every non-terminal pod on the node.
+    Evicted pods carry the NODE_LOST marker, so the engine reschedules them
+    (and the gang scheduler re-solves their gangs) without burning restart
+    budget — a planned maintenance window is not a workload failure."""
+    cordon_node(api, name, now=now)
+    evicted: List[str] = []
+    for pod in api.list("Pod"):
+        if pod.node_name != name or pod.is_terminal():
+            continue
+        if evict_pod(api, pod, f"node {name} drained", now, node_name=name):
+            evicted.append(pod.name)
+    api.record_event(Event(
+        object_kind="Node", object_name=name, event_type="Normal",
+        reason="NodeDrained",
+        message=f"drained {len(evicted)} pod(s) off {name}",
+        timestamp=now,
+    ))
+    return evicted
+
+
+class NodeLifecycleController:
+    """Ticker: watches Node/Lease/Pod, drives the detect->taint->evict arc.
+
+    Same informer + caches shape as the other cluster components: state is
+    maintained from watch events (initial LIST, then WATCH), API writes
+    happen only on transitions, and a wakeup timer is armed at the earliest
+    pending deadline so virtual clocks jump to detection instants instead
+    of crawling."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        grace_period: float = 40.0,
+        toleration_seconds: float = 30.0,
+    ):
+        self.cluster = cluster
+        self.api = cluster.api
+        self.grace_period = grace_period
+        self.toleration_seconds = toleration_seconds
+        self._watch = self.api.watch(kinds=("Node", "Lease", "Pod"))
+        self._nodes: Dict[str, Node] = {}
+        self._hb: Dict[str, float] = {}          # node -> last heartbeat
+        self._first_seen: Dict[str, float] = {}  # grace basis pre-heartbeat
+        self._tainted_at: Dict[str, float] = {}  # node -> taint instant
+        self._pods_by_node: Dict[str, Dict[Tuple[str, str], Pod]] = {}
+        self._wakeup_armed = False
+        now = cluster.clock.now()
+        for node in self.api.list("Node"):
+            self._nodes[node.name] = node
+            self._first_seen[node.name] = now
+        for lease in self.api.list("Lease", NODE_LEASE_NAMESPACE):
+            self._hb[lease.name] = lease.renew_time
+        for pod in self.api.list("Pod"):
+            self._observe_pod("Added", pod)
+        cluster.add_ticker(self.tick)
+
+    # ------------------------------------------------------------------
+
+    def _observe_pod(self, ev_type: str, pod: Pod) -> None:
+        key = (pod.namespace, pod.name)
+        # A rebind moves the pod between buckets; scrub the old one.
+        for bucket in self._pods_by_node.values():
+            existing = bucket.get(key)
+            if existing is not None and existing.node_name != pod.node_name:
+                bucket.pop(key, None)
+        if ev_type != "Deleted" and pod.node_name and not pod.is_terminal():
+            self._pods_by_node.setdefault(pod.node_name, {})[key] = pod
+        elif pod.node_name:
+            self._pods_by_node.get(pod.node_name, {}).pop(key, None)
+
+    def _drain_events(self) -> None:
+        now = self.cluster.clock.now()
+        for ev in self._watch.drain():
+            if ev.kind == "Node":
+                name = ev.obj.metadata.name
+                if ev.type == "Deleted":
+                    self._nodes.pop(name, None)
+                    self._hb.pop(name, None)
+                    self._first_seen.pop(name, None)
+                    self._tainted_at.pop(name, None)
+                else:
+                    self._nodes[name] = ev.obj
+                    self._first_seen.setdefault(name, now)
+            elif ev.kind == "Lease":
+                if (
+                    ev.type != "Deleted"
+                    and (ev.obj.metadata.namespace or "") == NODE_LEASE_NAMESPACE
+                ):
+                    self._hb[ev.obj.metadata.name] = ev.obj.renew_time
+            else:
+                self._observe_pod(ev.type, ev.obj)
+
+    def tick(self) -> None:
+        self._drain_events()
+        now = self.cluster.clock.now()
+        next_deadline: Optional[float] = None
+        for name, node in list(self._nodes.items()):
+            hb = self._hb.get(name, self._first_seen.get(name, now))
+            # Inclusive at the boundary: the wakeup timer lands exactly at
+            # hb + grace, and a strict > would re-arm a due-now timer
+            # forever (wedging a virtual clock at the detection instant).
+            stale = now - hb >= self.grace_period
+            if node_ready(node):
+                if stale:
+                    self._mark_notready(name, now)
+                else:
+                    next_deadline = self._min(next_deadline, hb + self.grace_period)
+            else:
+                if not stale:
+                    self._mark_ready(name, now)
+                    continue
+                tainted_at = self._tainted_at.get(name)
+                if tainted_at is None:
+                    # NotReady inherited from a restore/another controller:
+                    # start the toleration window at first observation.
+                    self._tainted_at[name] = tainted_at = now
+                evict_at = tainted_at + self.toleration_seconds
+                if now >= evict_at:
+                    self._evict_node_pods(
+                        name, f"node {name} unreachable", now,
+                        detect_at=tainted_at, honor_tolerations=True,
+                    )
+                else:
+                    next_deadline = self._min(next_deadline, evict_at)
+        # Pods bound to nodes that don't exist at all: no host will ever
+        # come back — evict immediately (the k8s pod-GC rule).
+        for node_name in list(self._pods_by_node):
+            if node_name not in self._nodes and self._pods_by_node[node_name]:
+                self._evict_node_pods(
+                    node_name, f"node {node_name} no longer exists", now,
+                )
+        self._arm_wakeup(now, next_deadline)
+
+    @staticmethod
+    def _min(a: Optional[float], b: float) -> float:
+        return b if a is None else min(a, b)
+
+    def _arm_wakeup(self, now: float, deadline: Optional[float]) -> None:
+        if deadline is None or self._wakeup_armed:
+            return
+        self._wakeup_armed = True
+        self.cluster.schedule_at(max(deadline, now), self._wakeup)
+
+    def _wakeup(self) -> None:
+        # No-op body: exists so a virtual clock has a timer to jump to at
+        # the detection/eviction instant; the tick that follows acts.
+        self._wakeup_armed = False
+
+    # ------------------------------------------------------------------
+
+    def _mark_notready(self, name: str, now: float) -> None:
+        node = self.api.try_get("Node", "", name)
+        if node is None:
+            return
+        changed = set_node_condition(
+            node, NODE_CONDITION_READY, "Unknown", "NodeStatusUnknown",
+            f"heartbeat lapsed > {self.grace_period:g}s", now,
+        )
+        changed |= add_taint(node, TAINT_UNREACHABLE, "NoExecute")
+        if changed:
+            self.api.update(node, check_version=False)
+            self._nodes[name] = node
+            self._tainted_at[name] = now
+            metrics.node_notready.inc(name)
+            self.api.record_event(Event(
+                object_kind="Node", object_name=name, event_type="Warning",
+                reason="NodeNotReady",
+                message=(f"heartbeat lapsed; tainted {TAINT_UNREACHABLE}"
+                         f":NoExecute (evictions in {self.toleration_seconds:g}s)"),
+                timestamp=now,
+            ))
+
+    def _mark_ready(self, name: str, now: float) -> None:
+        node = self.api.try_get("Node", "", name)
+        if node is None:
+            return
+        changed = set_node_condition(
+            node, NODE_CONDITION_READY, "True", "KubeletReady",
+            "heartbeat resumed", now,
+        )
+        changed |= remove_taint(node, TAINT_UNREACHABLE)
+        if changed:
+            self.api.update(node, check_version=False)
+            self._nodes[name] = node
+            self._tainted_at.pop(name, None)
+            metrics.node_recovered.inc(name)
+            self.api.record_event(Event(
+                object_kind="Node", object_name=name, event_type="Normal",
+                reason="NodeReady", message="heartbeat resumed; taint removed",
+                timestamp=now,
+            ))
+
+    def _evict_node_pods(
+        self,
+        node_name: str,
+        reason: str,
+        now: float,
+        detect_at: Optional[float] = None,
+        honor_tolerations: bool = False,
+    ) -> int:
+        taint = {"key": TAINT_UNREACHABLE, "effect": "NoExecute"}
+        evicted = 0
+        for key, pod in list(self._pods_by_node.get(node_name, {}).items()):
+            if honor_tolerations and tolerates([taint], pod.spec.tolerations):
+                continue  # pod declared it rides out unreachable nodes
+            if evict_pod(self.api, pod, reason, now,
+                         node_name=node_name, detect_at=detect_at):
+                evicted += 1
+            self._pods_by_node.get(node_name, {}).pop(key, None)
+        return evicted

@@ -266,32 +266,44 @@ class JobController:
                 self._triage_failed_pod(job, rtype, spec, pod, exp_key)
 
     def _triage_failed_pod(self, job: Job, rtype: str, spec, pod: Pod, exp_key: str) -> None:
-        """Exit-code restart classification (reference common/pod.go:350-374)."""
+        """Exit-code restart classification (reference common/pod.go:350-374).
+
+        Node-lost/evicted pods (NODE_LOST_MESSAGE_PREFIX) are retryable
+        regardless of restart policy — the reference's deleted-pod rule: the
+        hardware died, not the workload — and are NOT charged against the
+        recreate-restart budget that backs past_backoff_limit."""
         policy = spec.restart_policy or RestartPolicy.ON_FAILURE
         exit_code = pod.status.exit_code(self.controller.default_container_name())
+        node_lost = core.pod_failed_node_lost(pod)
         restart = False
-        if policy == RestartPolicy.EXIT_CODE:
+        if node_lost:
+            restart = True
+        elif policy == RestartPolicy.EXIT_CODE:
             if exit_code is not None and capi.is_retryable_exit_code(exit_code):
                 restart = True
             # 1-127: permanent — leave the failed pod; status logic fails job.
         elif policy in (RestartPolicy.ON_FAILURE, RestartPolicy.ALWAYS):
-            # Pod-level failure despite kubelet in-place restarts (e.g. node
-            # loss): recreate.
+            # Pod-level failure despite kubelet in-place restarts: recreate.
             restart = True
         if restart:
+            detail = (
+                pod.status.message if node_lost
+                else f"failed with exit code {exit_code}"
+            )
             self._event(
                 job, "Warning", "RestartingPod",
-                f"Pod {pod.name} failed with exit code {exit_code}; restarting",
+                f"Pod {pod.name} {detail}; restarting",
             )
             self._delete_pod(exp_key, pod, job)
-            job.metadata.annotations[core.RESTART_COUNT_ANNOTATION] = str(
-                core.job_recreate_restarts(job) + 1
-            )
+            if not node_lost:
+                job.metadata.annotations[core.RESTART_COUNT_ANNOTATION] = str(
+                    core.job_recreate_restarts(job) + 1
+                )
             metrics.restarted_pods.inc()
             metrics.jobs_restarted.inc(job.namespace, job.kind)
             update_job_conditions(
                 job.status, JobConditionType.RESTARTING, True, "JobRestarting",
-                f"{job.kind} {job.name} is restarting because pod {pod.name} exited with {exit_code}.",
+                f"{job.kind} {job.name} is restarting because pod {pod.name} {detail}.",
                 now=self.now(),
             )
 
@@ -336,6 +348,15 @@ class JobController:
             self.pod_control.delete_pod(pod.namespace, pod.name, job)
         except NotFoundError:
             self.expectations.deletion_observed(exp_key)
+        except Exception:
+            # Delete failed in flight (wire fault): unwind the expectation
+            # we just raised, or every later reconcile early-returns at the
+            # expectations gate until its TTL — wedging eviction recovery
+            # for minutes (reference DeletePod error path lowers it too).
+            # If the delete actually landed and the response was lost, the
+            # late Deleted event's observation is clamped at zero.
+            self.expectations.deletion_observed(exp_key)
+            raise
 
     def _delete_service(self, svc: Service, job: Job) -> None:
         rtype = svc.metadata.labels.get(capi.REPLICA_TYPE_LABEL, "")
@@ -345,6 +366,9 @@ class JobController:
             self.service_control.delete_service(svc.namespace, svc.name, job)
         except NotFoundError:
             self.expectations.deletion_observed(exp_key)
+        except Exception:
+            self.expectations.deletion_observed(exp_key)  # see _delete_pod
+            raise
 
     def reconcile_services(self, job: Job, services: Sequence[Service], rtype: str, spec) -> None:
         """One headless service per replica giving stable DNS identity

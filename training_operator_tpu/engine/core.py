@@ -111,6 +111,22 @@ def past_active_deadline(job: Job, now: float) -> bool:
     return (now - job.status.start_time) >= deadline
 
 
+# Message prefix stamped onto pods failed by NODE loss rather than their own
+# exit: the node lifecycle controller's eviction, a drain, and the gang
+# scheduler's re-placement eviction all mark pods with it. Triage treats such
+# pods as retryable REGARDLESS of restart policy — the reference's rule for
+# deleted pods (a pod that vanished with its node is not a workload failure)
+# — and does not charge them against the recreate-restart budget.
+NODE_LOST_MESSAGE_PREFIX = "NodeLost"
+
+
+def pod_failed_node_lost(pod: Pod) -> bool:
+    return (
+        pod.status.phase == PodPhase.FAILED
+        and pod.status.message.startswith(NODE_LOST_MESSAGE_PREFIX)
+    )
+
+
 # Annotation tracking engine-driven delete+recreate restarts (ExitCode-policy
 # retryable failures), which recreate pods with restart_count=0 and would
 # otherwise never trip the backoff limit. The reference closes this gap with

@@ -25,6 +25,7 @@ PHASE_ORDER = (
     "reconcile",
     "gang_solve",
     "bind",
+    "node_evict",
     "time_to_running",
     "total",
 )
@@ -87,6 +88,42 @@ def phase_table(timeline: Optional[Dict[str, Any]]) -> List[Dict[str, Any]]:
     )
 
 
+def _node_state(node) -> str:
+    """One-word node condition summary for the pod table: Ready/NotReady
+    from the Ready condition (controllers/nodelifecycle.py), with the
+    cordon flag appended kubectl-style."""
+    if node is None:
+        return "<gone>"
+    from training_operator_tpu.cluster.objects import node_ready
+
+    state = "Ready" if node_ready(node) else "NotReady"
+    if node.unschedulable:
+        state += ",SchedulingDisabled"
+    return state
+
+
+def _pod_rows(api, namespace: str, name: str) -> List[Tuple[str, str, str, str]]:
+    """(pod, phase, node, node state) per pod of the job — where each pod
+    physically sits, and whether that hardware is alive. This is the
+    surface a node-loss investigation starts from."""
+    from training_operator_tpu.api.common import JOB_NAME_LABEL
+
+    rows = []
+    nodes: Dict[str, Any] = {}
+    for pod in sorted(
+        api.list("Pod", namespace or None, {JOB_NAME_LABEL: name}),
+        key=lambda p: p.name,
+    ):
+        node_name = pod.node_name or "<unbound>"
+        state = ""
+        if pod.node_name:
+            if pod.node_name not in nodes:
+                nodes[pod.node_name] = api.try_get("Node", "", pod.node_name)
+            state = _node_state(nodes[pod.node_name])
+        rows.append((pod.name, pod.status.phase.value, node_name, state))
+    return rows
+
+
 def _get_timeline(api, namespace: str, name: str) -> Optional[Dict[str, Any]]:
     getter = getattr(api, "get_timeline", None)
     if getter is None:
@@ -119,6 +156,16 @@ def render_describe(api, namespace: str, name: str, max_events: int = 40) -> str
             lines.append(
                 f"  {ctype:<12} {status:<7} {reason:<24} {at:>12.3f}  {message}"
             )
+    else:
+        lines.append("  <none>")
+
+    lines.append("")
+    lines.append("Pods:")
+    pod_rows = _pod_rows(api, namespace, name)
+    if pod_rows:
+        lines.append(f"  {'NAME':<28} {'PHASE':<10} {'NODE':<20} NODE-STATE")
+        for pname, phase, node_name, state in pod_rows:
+            lines.append(f"  {pname:<28} {phase:<10} {node_name:<20} {state}")
     else:
         lines.append("  <none>")
 

@@ -27,9 +27,22 @@ from training_operator_tpu.cluster.objects import (
     PodGroup,
     PodGroupPhase,
     PodPhase,
+    node_ready,
 )
 from training_operator_tpu.cluster.runtime import Cluster, VirtualClock, bind_pod
 from training_operator_tpu.engine.control import PodGroupControl
+from training_operator_tpu.engine.core import (
+    NODE_LOST_MESSAGE_PREFIX,
+    pod_failed_node_lost,
+)
+
+# Reason this scheduler stamps on the members it evicts during a gang
+# re-placement. _observe_pod filters these out of the lost-gang trigger:
+# without the filter, the gang's own re-placement evictions would re-flag
+# it and a second invalidation would discard the freshly re-solved
+# placement (an extra evict->solve cycle on every node loss).
+GANG_REPLACEMENT_REASON = "gang re-placement"
+_GANG_EVICT_MESSAGE_PREFIX = f"{NODE_LOST_MESSAGE_PREFIX}: {GANG_REPLACEMENT_REASON}"
 from training_operator_tpu.scheduler.snapshot import (
     ClusterSnapshot,
     build_gang_request,
@@ -98,6 +111,11 @@ class GangScheduler:
         # would silently discard) and persisted onto the group only on the
         # Unschedulable transition.
         self._attempts: Dict[str, int] = {}
+        # Gangs whose placement lost a node (member evicted NodeLost, or a
+        # placed node deleted): gkey -> reason. Processed each tick by
+        # _process_invalidations — the gang re-admission arm of node-loss
+        # recovery: evict surviving members, reset to PENDING, re-solve.
+        self._lost_groups: Dict[str, str] = {}
         # Structured per-cycle solve trace (SURVEY §5: the solve path is the
         # subsystem worth observing; the reference has nothing comparable).
         # Ring buffer of dicts — one per solve cycle; see _record_trace.
@@ -141,6 +159,15 @@ class GangScheduler:
                 self._group_pods.get(gkey, {}).pop(pod.name, None)
             else:
                 self._group_pods.setdefault(gkey, {})[pod.name] = pod
+                if pod_failed_node_lost(pod) and not pod.status.message.startswith(
+                    _GANG_EVICT_MESSAGE_PREFIX
+                ):
+                    # A member died WITH its node (lifecycle eviction/drain):
+                    # the gang's placement is stale hardware — re-solve it
+                    # whole rather than re-pinning pods to a dead host. Our
+                    # OWN re-placement evictions are excluded (see
+                    # GANG_REPLACEMENT_REASON) or they would re-trigger this.
+                    self._lost_groups.setdefault(gkey, pod.status.message)
             self._advance_dirty = True
         if (
             ev_type != "Deleted"
@@ -179,10 +206,21 @@ class GangScheduler:
                 else:
                     self._groups[gkey] = obj
             elif kind == "Node":
+                name = obj.metadata.name
                 if ev.type == "Deleted":
-                    self._nodes.pop(obj.metadata.name, None)
+                    self._nodes.pop(name, None)
+                    # Admitted gangs placed on the vanished node can never
+                    # bind there; queue their re-solve now (running members
+                    # are flagged separately by their NodeLost evictions).
+                    for gkey, pg in self._groups.items():
+                        if pg.phase in (PodGroupPhase.INQUEUE, PodGroupPhase.RUNNING) and (
+                            name in pg.placement.values() or name in pg.reserved_nodes
+                        ):
+                            self._lost_groups.setdefault(
+                                gkey, f"node {name} deleted"
+                            )
                 else:
-                    self._nodes[obj.metadata.name] = obj
+                    self._nodes[name] = obj
                 self._solve_dirty = True
                 self._bind_dirty = True
                 self._capacity_freed = True
@@ -201,6 +239,7 @@ class GangScheduler:
             self._needs_prewarm = False
             self.placer.prewarm(self._snapshot())
         self._drain_events()
+        self._process_invalidations()
         self._admit_pending()
         # Repack runs on job-spec resizes AND retries unsatisfied deltas
         # whenever capacity frees — a grown gang whose delta didn't fit must
@@ -372,6 +411,47 @@ class GangScheduler:
         # watch but do not match any dirty rule, so they don't force a
         # redundant re-solve next tick.
 
+    def _process_invalidations(self) -> None:
+        if not self._lost_groups:
+            return
+        lost, self._lost_groups = self._lost_groups, {}
+        for gkey, reason in lost.items():
+            self._invalidate_group(gkey, reason)
+
+    def _invalidate_group(self, gkey: str, reason: str) -> None:
+        """Gang re-admission after node loss: evict the surviving members
+        (their hosts' capacity must be free for the re-solve — a one-host
+        loss breaks the whole slice's ICI mesh, so recovery is re-solving
+        the GANG's placement, not restarting one pod), clear the placement,
+        and reset the group to Pending. The placer then re-admits it against
+        the surviving inventory — preferring a whole intact slice when the
+        dead host broke contiguity — and the engine recreates pods pinned
+        to the fresh assignments."""
+        pg = self._groups.get(gkey)
+        if pg is None or pg.phase not in (PodGroupPhase.INQUEUE, PodGroupPhase.RUNNING):
+            return
+        live = self._fresh_for_write(pg)
+        if live is None or live.phase not in (
+            PodGroupPhase.INQUEUE, PodGroupPhase.RUNNING
+        ):
+            return
+        from training_operator_tpu.controllers.nodelifecycle import evict_pod
+
+        now = self.cluster.clock.now()
+        for pod in list(self._group_pods.get(gkey, {}).values()):
+            evict_pod(
+                self.api, pod, f"{GANG_REPLACEMENT_REASON}: {reason}", now,
+                node_name=pod.node_name,
+            )
+        live.placement = {}
+        live.reserved_nodes = []
+        live.phase = PodGroupPhase.PENDING
+        if self._persist(live):
+            self._event(live, "Warning", "PlacementInvalidated",
+                        f"{reason}; re-solving gang")
+        self._solve_dirty = True
+        self._bind_dirty = True
+
     def _fresh_for_write(self, pg: PodGroup) -> Optional[PodGroup]:
         """Re-read a cached PodGroup before mutating it for a write. Watch-
         event caches lag writes made earlier in the same tick (e.g. a repack
@@ -440,7 +520,13 @@ class GangScheduler:
         if not self._unbound:
             return
         groups = self._groups
-        nodes = {n.name for n in self._nodes.values() if not n.unschedulable}
+        # NotReady nodes are as unusable as cordoned ones: a bind onto a
+        # dead host would start nothing and re-evict later.
+        nodes = {
+            n.name
+            for n in self._nodes.values()
+            if not n.unschedulable and node_ready(n)
+        }
         for key, pod in list(self._unbound.items()):
             pg_name = pod.spec.annotations.get(PodGroupControl.POD_GROUP_ANNOTATION)
             if not pg_name:
@@ -453,15 +539,13 @@ class GangScheduler:
             if target is None:
                 continue
             if target not in nodes:
-                # Placed node vanished before binding: re-solve the gang.
-                live = self._fresh_for_write(pg)
-                if live is None:
-                    continue
-                live.phase = PodGroupPhase.PENDING
-                live.placement = {}
-                if self._persist(live):  # conflict: re-derived next tick
-                    self._event(live, "Warning", "PlacementInvalidated",
-                                f"node {target} is gone; re-solving")
+                # Placed node vanished/died before binding: re-solve the
+                # whole gang (evicts any members already running, so the
+                # solve sees the gang's full demand against live capacity).
+                self._invalidate_group(
+                    f"{pod.namespace}/{pg_name}",
+                    f"node {target} is gone",
+                )
                 continue
             bind_now = self.cluster.clock.now()
             bind_pod(self.api, pod, target, now=bind_now)

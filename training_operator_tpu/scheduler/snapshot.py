@@ -19,6 +19,7 @@ from training_operator_tpu.cluster.objects import (
     Node,
     PodGroup,
     PodGroupPhase,
+    node_ready,
     toleration_key,
     tolerates,
 )
@@ -153,10 +154,15 @@ class ClusterSnapshot:
         # population that accumulates until TTL cleanup.
         node_iter = nodes if nodes is not None else api.list("Node")
         self.nodes: Dict[str, Node] = {n.name: n for n in node_iter}
+        # NotReady nodes (lapsed heartbeat; see controllers/nodelifecycle)
+        # contribute NO free capacity, same as cordoned ones: a dead TPU
+        # host must be absent from every new placement, so a gang re-solve
+        # routes around it (whole-slice migration when the loss breaks ICI
+        # contiguity of the remaining hosts).
         self.free: Dict[str, Dict[str, float]] = {
             name: dict(n.capacity)
             for name, n in self.nodes.items()
-            if not n.unschedulable
+            if not n.unschedulable and node_ready(n)
         }
         self._podgroups = list(podgroups) if podgroups is not None else api.list("PodGroup")
         bound = self._subtract_bound_pods(bound_pods)

@@ -383,6 +383,29 @@ class TrainingClient:
 
         return render_describe(self.api, namespace or self.namespace, name)
 
+    # -- node admin --------------------------------------------------------
+
+    def cordon_node(self, name: str):
+        """Mark a node unschedulable (kubectl cordon); running pods stay.
+        Works in-process and against a serving host alike (the CLI twin is
+        `python -m training_operator_tpu cordon <node> --api-server URL`)."""
+        from training_operator_tpu.controllers.nodelifecycle import cordon_node
+
+        return cordon_node(self.api, name, now=self.cluster.clock.now())
+
+    def uncordon_node(self, name: str):
+        from training_operator_tpu.controllers.nodelifecycle import uncordon_node
+
+        return uncordon_node(self.api, name, now=self.cluster.clock.now())
+
+    def drain_node(self, name: str) -> List[str]:
+        """kubectl drain: cordon + evict every pod on the node (NODE_LOST
+        marker — the engine reschedules, gangs re-solve, no restart budget
+        burned). Returns the evicted pod names."""
+        from training_operator_tpu.controllers.nodelifecycle import drain_node
+
+        return drain_node(self.api, name, now=self.cluster.clock.now())
+
     # -- static analysis ---------------------------------------------------
 
     def lint(self, job: Union[TrainJob, str], namespace: Optional[str] = None):

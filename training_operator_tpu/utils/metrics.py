@@ -389,3 +389,22 @@ job_time_to_running_seconds = registry.histogram(
     "training_job_time_to_running_seconds",
     "Cluster-clock time from job creation to the Running condition",
 )
+# Node lifecycle (controllers/nodelifecycle.py): heartbeat-lapse detection,
+# taint-driven eviction, and recovery — the observable pipeline behind
+# "a dead TPU host" (detect -> evict -> re-solve). Labeled by node so a
+# correlated slice failure reads as N distinct hosts, not one counter blip.
+node_notready = registry.counter(
+    "training_node_notready_total",
+    "Nodes marked NotReady after their heartbeat lapsed",
+    ("node",),
+)
+node_evictions = registry.counter(
+    "training_node_evictions_total",
+    "Pods evicted (failed) off dead, drained, or vanished nodes",
+    ("node",),
+)
+node_recovered = registry.counter(
+    "training_node_recovered_total",
+    "Nodes whose heartbeat resumed and were marked Ready again",
+    ("node",),
+)
