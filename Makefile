@@ -5,13 +5,20 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast test-chaos lint bench bench-quick bench-wire bench-wire-resume bench-observe bench-node-chaos dryrun operator-demo ha-demo native clean
+.PHONY: test test-fast test-wire test-chaos lint bench bench-quick bench-wire bench-wire-v2 bench-wire-resume bench-observe bench-node-chaos dryrun operator-demo ha-demo native clean
 
 test:            ## full suite (no hardware needed; ~10 min)
 	$(PY) -m pytest tests/ -q
 
 test-fast:       ## the tier-1 fast lane: everything but the `slow`-marked jit-heavy numerics
 	$(PY) -m pytest tests/ -q -m "not slow"
+
+# Deterministic wire protocol-conformance lane (no timing asserts): framing,
+# batch/coalesce/pagination semantics, codec, resume — catches protocol
+# regressions in CI without the machine-load-sensitive wire benches.
+test-wire:       ## fast deterministic wire protocol lane (framing/codec/resume)
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_wire.py tests/test_wire_v2.py \
+	  tests/test_wire_fastpath.py tests/test_wire_resume.py -q
 
 test-chaos:      ## the chaos/fault-injection lane: pod, store, wire, and node tiers
 	$(PY) -m pytest tests/test_chaos.py tests/test_wire_chaos.py tests/test_node_lifecycle.py -q
@@ -50,6 +57,21 @@ bench-wire:      ## wire fast-path block standalone (quick-sized, one JSON line)
 	JAX_PLATFORMS=cpu $(PY) bench.py --wire-overhead-only --wire-jobs 100
 
 wire-bench: bench-wire  ## back-compat alias for bench-wire
+
+# Wire protocol v2 before/after evidence: interleaved pairs against a
+# pre-change worktree carrying this same harness (BENCH_SELF_WIRE_r06
+# method; no TLS dep needed — the wire leg auto-falls back to --insecure
+# loopback HTTP). BEFORE_REF defaults to HEAD: run BEFORE committing, or
+# point it at the pre-PR commit afterwards.
+BEFORE_REF ?= HEAD
+WIRE_V2_PAIRS ?= 5
+bench-wire-v2:   ## interleaved wire-v2 A/B pairs -> BENCH_SELF_WIRE_V2_r09.json
+	git worktree add --force .bench-before $(BEFORE_REF)
+	cp bench.py .bench-before/bench.py
+	JAX_PLATFORMS=cpu $(PY) bench.py --wire-ab $(WIRE_V2_PAIRS) \
+	  --before-repo .bench-before --wire-jobs 100 \
+	  --ab-out BENCH_SELF_WIRE_V2_r09.json; \
+	rc=$$?; git worktree remove --force .bench-before; exit $$rc
 
 # Reap every watch session against a 1k-object cluster and compare the
 # reconnect cost of ResourceVersion delta-resume vs the forced full relist.

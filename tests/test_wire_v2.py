@@ -1,0 +1,740 @@
+"""Wire protocol v2 semantics: pipelined batch envelopes, status-write
+coalescing, paginated + projected LISTs, and the v1<->v2 compat matrix.
+
+These are the deterministic protocol-conformance tests (`make test-wire`):
+no timing assertions, so CI catches framing regressions without the noisy
+wire benches. The perf evidence lives in BENCH_SELF_WIRE_V2_r09.json.
+
+Compat matrix proven here (the fourth cell — old client against the new
+server — is the entire pre-existing wire suite, which never sends
+limit/fields/batch and must keep passing unchanged):
+
+  client \\ server |  v2 host            |  v1 host (no /batch)
+  ----------------+---------------------+----------------------------
+  v2 (pipeline)   |  batch + coalesce   |  degrades to per-request
+  v1 (pipeline=F) |  per-request (v1)   |  per-request (v1)
+"""
+
+import json
+
+import pytest
+
+import training_operator_tpu.api.common as capi
+from training_operator_tpu.api.common import (
+    Container,
+    PodTemplateSpec,
+    ReplicaSpec,
+)
+from training_operator_tpu.api.jobs import JAXJob, ObjectMeta
+from training_operator_tpu.cluster import wire
+from training_operator_tpu.cluster.apiserver import NotFoundError
+from training_operator_tpu.cluster.httpapi import (
+    ApiHTTPServer,
+    ApiUnavailableError,
+    RemoteAPIServer,
+    RemoteRuntime,
+)
+from training_operator_tpu.cluster.objects import (
+    ConfigMap,
+    Pod,
+    PodPhase,
+    PodStatus,
+)
+from training_operator_tpu.cluster.runtime import (
+    ANNOTATION_SIM_DURATION,
+    Cluster,
+    DefaultScheduler,
+    SimKubelet,
+)
+from training_operator_tpu.cluster.wire_server import (
+    decode_continue_token,
+    encode_continue_token,
+)
+from training_operator_tpu.cluster.wire_transport import quote_seg
+from training_operator_tpu.controllers.jax import JAXController
+from training_operator_tpu.controllers.manager import OperatorManager
+from training_operator_tpu.utils import metrics
+
+
+@pytest.fixture()
+def served():
+    cluster = Cluster()
+    server = ApiHTTPServer(cluster.api, port=0)
+    try:
+        yield cluster, server
+    finally:
+        server.close()
+
+
+def _job(name: str, replicas: int = 1) -> JAXJob:
+    tmpl = PodTemplateSpec(
+        containers=[Container(name="jax", image="t", resources={"cpu": 0.5})],
+        annotations={ANNOTATION_SIM_DURATION: "0"},
+    )
+    return JAXJob(
+        metadata=ObjectMeta(name=name),
+        replica_specs={"Worker": ReplicaSpec(replicas=replicas, template=tmpl)},
+    )
+
+
+def _put_op(obj, check_version: bool = True):
+    ns = getattr(obj.metadata, "namespace", "") or ""
+    body = json.dumps(wire.encode(obj), separators=(",", ":")).encode()
+    return (
+        "PUT",
+        f"/objects/{quote_seg(obj.KIND)}/{ns or '-'}/{quote_seg(obj.metadata.name)}",
+        {"check_version": "1" if check_version else "0", "status_only": "1"},
+        body,
+    )
+
+
+def _fake_v1_server(server: ApiHTTPServer) -> None:
+    """Patch a live ApiHTTPServer instance to answer like a PRE-v2 host:
+    404 on /batch, and LISTs that ignore limit/continue/fields entirely."""
+
+    def no_batch(h):
+        h._send(404, {"error": "NotFound", "message": "no route batch"})
+
+    def v1_list(kind, q):
+        selector = None
+        if q.get("labelSelector"):
+            selector = dict(
+                pair.split("=", 1)
+                for pair in q["labelSelector"].split(",") if "=" in pair
+            )
+        refs = server.api.list_refs(kind, q.get("namespace") or None, selector)
+        return (
+            b'{"items":['
+            + b",".join(server._object_bytes(o) for o in refs)
+            + b"]}"
+        )
+
+    server._batch = no_batch
+    server._list_bytes = v1_list
+
+
+class TestBatchEnvelope:
+    def test_per_op_conflict_isolation(self, served):
+        """One stale-version PUT inside a batch answers 409 in ITS slot;
+        the ops before and after it land normally."""
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0)
+        for name in ("a", "b", "c"):
+            remote.create(_job(name))
+        fresh = {n: cluster.api.get("JAXJob", "default", n) for n in "abc"}
+        # Make b's client copy stale: bump it server-side once more.
+        cluster.api.update(cluster.api.get("JAXJob", "default", "b"))
+        for n, t in (("a", 1.0), ("b", 2.0), ("c", 3.0)):
+            fresh[n].status.start_time = t
+        results = remote._channel.execute(
+            [_put_op(fresh["a"]), _put_op(fresh["b"]), _put_op(fresh["c"])]
+        )
+        assert [s for s, _ in results] == [200, 409, 200]
+        assert cluster.api.get("JAXJob", "default", "a").status.start_time == 1.0
+        assert cluster.api.get("JAXJob", "default", "b").status.start_time is None
+        assert cluster.api.get("JAXJob", "default", "c").status.start_time == 3.0
+
+    def test_ops_execute_in_order_and_split_by_depth(self, served):
+        """An envelope deeper than pipeline_depth splits into several
+        round trips but preserves op order end to end; mixed verbs
+        (create/update/delete) keep their per-op status codes."""
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0, pipeline_depth=2)
+        cm = ConfigMap(metadata=ObjectMeta(name="mixed"), data={"v": "0"})
+        before = metrics.wire_batch_requests.total()
+        ops = [(
+            "POST", "/objects", None,
+            json.dumps(wire.encode(cm), separators=(",", ":")).encode(),
+        )]
+        for i in range(4):
+            step = ConfigMap(metadata=ObjectMeta(name="mixed"),
+                             data={"v": str(i + 1)})
+            op = ("PUT", "/objects/ConfigMap/default/mixed",
+                  {"check_version": "0"},
+                  json.dumps(wire.encode(step), separators=(",", ":")).encode())
+            ops.append(op)
+        ops.append(("DELETE", "/objects/ConfigMap/default/mixed", None, b""))
+        results = remote._channel.execute(ops)
+        assert [s for s, _ in results] == [201, 200, 200, 200, 200, 200]
+        # 6 ops at depth 2 -> 3 envelopes, one wire round trip each.
+        assert metrics.wire_batch_requests.total() - before == 3
+        # The DELETE ran last: the final state reflects the full sequence.
+        assert cluster.api.try_get("ConfigMap", "default", "mixed") is None
+        gone = wire.decode(json.loads(results[-1][1]))
+        assert gone.data == {"v": "4"}  # the last PUT won before the delete
+
+    def test_unknown_batched_route_is_per_op_404(self, served):
+        _, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0)
+        results = remote._channel.execute([("GET", "/timelines/x/y", None, b"")])
+        assert results[0][0] == 404
+
+    def test_transport_failure_raises_unavailable_without_retry(self, served):
+        """POST /batch is NOT idempotent: a mid-flight transport failure
+        must surface as ApiUnavailableError — never the stale-keep-alive
+        transparent replay idempotent GETs get — because the server may
+        have executed any prefix of the lost envelope."""
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0)
+        remote.create(_job("lost"))
+        served_before = metrics.wire_batch_requests.total()
+
+        class _DeadConn:
+            def request(self, *a, **k):
+                pass  # request "sent"...
+
+            def getresponse(self):
+                raise ConnectionResetError("wire cut mid-response")
+
+            def close(self):
+                pass
+
+        remote._local.conn_main = _DeadConn()
+        j = cluster.api.get("JAXJob", "default", "lost")
+        j.status.start_time = 7.0
+        with pytest.raises(ApiUnavailableError):
+            remote._channel.execute([_put_op(j)])
+        # No transparent second envelope was sent.
+        assert metrics.wire_batch_requests.total() == served_before
+
+
+class TestWriteCoalescing:
+    def test_last_write_wins_one_round_trip(self, served):
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0,
+                                 coalesce_window_ms=60_000.0)
+        remote.create(_job("lww"))
+        job = cluster.api.get("JAXJob", "default", "lww")
+        reqs = metrics.wire_batch_requests.total()
+        merged = metrics.wire_batch_coalesced.total()
+        for t in (1.0, 2.0, 3.0):
+            job.status.start_time = t
+            remote.update(job, status_only=True)
+        # Buffered: nothing on the wire yet, server state untouched.
+        assert cluster.api.get("JAXJob", "default", "lww").status.start_time is None
+        remote.flush_writes()
+        got = cluster.api.get("JAXJob", "default", "lww")
+        assert got.status.start_time == 3.0  # the LAST write, never reordered
+        assert metrics.wire_batch_requests.total() - reqs == 1
+        assert metrics.wire_batch_coalesced.total() - merged == 2
+
+    def test_same_key_history_never_reordered(self, served):
+        """Interleaved writes to two keys: each key's flushed value is its
+        newest, and a second flush after new writes never resurrects an
+        older buffered state (the re-enqueue arm keeps newer values)."""
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0,
+                                 coalesce_window_ms=60_000.0)
+        remote.create(_job("k1"))
+        remote.create(_job("k2"))
+        j1 = cluster.api.get("JAXJob", "default", "k1")
+        j2 = cluster.api.get("JAXJob", "default", "k2")
+        for t in (1.0, 2.0):
+            j1.status.start_time = t
+            remote.update(j1, status_only=True)
+            j2.status.start_time = t * 10
+            remote.update(j2, status_only=True)
+        remote.flush_writes()
+        assert cluster.api.get("JAXJob", "default", "k1").status.start_time == 2.0
+        assert cluster.api.get("JAXJob", "default", "k2").status.start_time == 20.0
+        j1.status.start_time = 5.0
+        remote.update(j1, status_only=True)
+        remote.flush_writes()
+        assert cluster.api.get("JAXJob", "default", "k1").status.start_time == 5.0
+
+    def test_conflict_resolved_at_flush_boundary(self, served):
+        """A stale-version coalesced write resolves per-op with the
+        engine's own arm (re-get, graft status, unconditional write) —
+        the controller's tally is the truth source."""
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0,
+                                 coalesce_window_ms=60_000.0)
+        remote.create(_job("cfl"))
+        stale = cluster.api.get("JAXJob", "default", "cfl")
+        cluster.api.update(cluster.api.get("JAXJob", "default", "cfl"))
+        stale.status.start_time = 4.0
+        remote.update(stale, status_only=True)
+        remote.flush_writes()
+        assert cluster.api.get("JAXJob", "default", "cfl").status.start_time == 4.0
+
+    def test_conflict_graft_keeps_annotation_bump(self, served):
+        """The restart-budget annotation rides the same write as the
+        status: a stale-rv retry must carry BOTH through the graft, or a
+        crash-looping job's budget would reset on every raced write and
+        never reach its backoff limit."""
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0,
+                                 coalesce_window_ms=60_000.0)
+        remote.create(_job("ann"))
+        stale = cluster.api.get("JAXJob", "default", "ann")
+        cluster.api.update(cluster.api.get("JAXJob", "default", "ann"))
+        stale.status.start_time = 2.0
+        stale.metadata.annotations["training.tpu.dev/total-restarts"] = "3"
+        remote.update(stale, status_only=True)
+        remote.flush_writes()
+        got = cluster.api.get("JAXJob", "default", "ann")
+        assert got.status.start_time == 2.0
+        assert got.metadata.annotations["training.tpu.dev/total-restarts"] == "3"
+
+    def test_coalesce_opt_out_stays_synchronous_and_conflicts(self, served):
+        """update(..., coalesce=False) pins one write synchronous on a
+        coalescing client — the v2 TrainJob controller's abandon-on-
+        conflict contract (ConflictError must propagate, never be
+        force-resolved at flush)."""
+        from training_operator_tpu.cluster.apiserver import ConflictError
+
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0,
+                                 coalesce_window_ms=60_000.0)
+        remote.create(_job("sync"))
+        j = cluster.api.get("JAXJob", "default", "sync")
+        j.status.start_time = 1.0
+        remote.update(j, status_only=True, coalesce=False)
+        # Synchronous: visible without a flush.
+        assert cluster.api.get("JAXJob", "default", "sync").status.start_time == 1.0
+        stale = cluster.api.get("JAXJob", "default", "sync")
+        cluster.api.update(cluster.api.get("JAXJob", "default", "sync"))
+        stale.status.start_time = 2.0
+        with pytest.raises(ConflictError):
+            remote.update(stale, status_only=True, coalesce=False)
+        assert cluster.api.get("JAXJob", "default", "sync").status.start_time == 1.0
+
+    def test_deleted_object_write_is_dropped(self, served):
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0,
+                                 coalesce_window_ms=60_000.0)
+        remote.create(_job("gone"))
+        j = cluster.api.get("JAXJob", "default", "gone")
+        j.status.start_time = 1.0
+        remote.update(j, status_only=True)
+        cluster.api.delete("JAXJob", "default", "gone")
+        remote.flush_writes()  # per-op 404: dropped, batch unharmed
+        assert cluster.api.try_get("JAXJob", "default", "gone") is None
+
+    def test_unacked_writes_reenqueued_on_transport_failure(self, served):
+        """Satellite fix: a lost envelope re-enqueues every unacknowledged
+        write (the batch is exempt from the stale-keep-alive auto-retry);
+        the next flush delivers them."""
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0,
+                                 coalesce_window_ms=60_000.0)
+        remote.create(_job("requeue"))
+        j = cluster.api.get("JAXJob", "default", "requeue")
+        j.status.start_time = 6.0
+        remote.update(j, status_only=True)
+
+        class _DeadConn:
+            def request(self, *a, **k):
+                pass
+
+            def getresponse(self):
+                raise ConnectionResetError("host restarted")
+
+            def close(self):
+                pass
+
+        remote._local.conn_main = _DeadConn()
+        with pytest.raises(ApiUnavailableError):
+            remote.flush_writes()
+        assert len(remote._coalescer) == 1  # held for the next flush
+        remote._drop_conn("main")  # fresh connection heals
+        remote.flush_writes()
+        assert cluster.api.get("JAXJob", "default", "requeue").status.start_time == 6.0
+
+    def test_events_ride_the_batch_envelope(self, served):
+        """Lifecycle events buffer with the coalesced writes and travel in
+        the same envelope; the client's own events() read flushes first
+        (read-your-writes), so nothing is observably lost."""
+        from training_operator_tpu.cluster.objects import Event
+
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0,
+                                 coalesce_window_ms=60_000.0)
+        ops_before = metrics.wire_batch_ops.total()
+        for i in range(3):
+            remote.record_event(Event(
+                object_kind="JAXJob", object_name="evj", namespace="default",
+                event_type="Normal", reason=f"R{i}", message="m",
+            ))
+        assert cluster.api.events(object_name="evj") == []  # still buffered
+        got = remote.events(object_name="evj")  # flushes, then reads
+        assert [e.reason for e in got] == ["R0", "R1", "R2"]  # order kept
+        assert metrics.wire_batch_ops.total() - ops_before == 3
+
+    def test_job_read_served_from_mirror(self, served):
+        """try_get_cached (the engine's get_job path on the remote
+        operator) answers from the watch-fed mirror — a deep copy, and no
+        direct GET per reconcile."""
+        from training_operator_tpu.cluster.httpapi import CachedReadAPI
+
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0)
+        capi_view = CachedReadAPI(remote)
+        remote.create(_job("mirror-j"))
+        capi_view.list("JAXJob")  # prime + pump the shared session
+        got = capi_view.try_get_cached("JAXJob", "default", "mirror-j")
+        assert got is not None and got.metadata.name == "mirror-j"
+        got.metadata.labels["mutated"] = "yes"  # copies never alias the mirror
+        again = capi_view.try_get_cached("JAXJob", "default", "mirror-j")
+        assert "mutated" not in again.metadata.labels
+        assert capi_view.try_get_cached("JAXJob", "default", "nope") is None
+
+    def test_window_and_depth_bounds_trigger_flush(self, served):
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0,
+                                 coalesce_window_ms=60_000.0, pipeline_depth=2)
+        remote.create(_job("d1"))
+        remote.create(_job("d2"))
+        j1 = cluster.api.get("JAXJob", "default", "d1")
+        j2 = cluster.api.get("JAXJob", "default", "d2")
+        j1.status.start_time = 1.0
+        remote.update(j1, status_only=True)
+        assert cluster.api.get("JAXJob", "default", "d1").status.start_time is None
+        j2.status.start_time = 2.0
+        remote.update(j2, status_only=True)  # buffer hit depth: auto-flush
+        assert cluster.api.get("JAXJob", "default", "d1").status.start_time == 1.0
+        assert cluster.api.get("JAXJob", "default", "d2").status.start_time == 2.0
+
+
+class TestPaginatedList:
+    def _seed(self, api, n: int, prefix: str = "pg"):
+        for i in range(n):
+            api.create(ConfigMap(metadata=ObjectMeta(name=f"{prefix}-{i:03d}")))
+
+    def test_pages_partition_the_collection(self, served):
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0)
+        self._seed(cluster.api, 10)
+        pages_before = metrics.wire_list_pages.total()
+        out = remote.list("ConfigMap", limit=3)
+        assert sorted(o.metadata.name for o in out) == [
+            f"pg-{i:03d}" for i in range(10)
+        ]
+        assert metrics.wire_list_pages.total() - pages_before == 4  # 3+3+3+1
+
+    def test_continue_token_stable_under_concurrent_create_delete(self, served):
+        """The k8s chunked-LIST contract: an object that exists for the
+        whole walk appears exactly once, no matter what is created or
+        deleted around the cursor between pages."""
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0)
+        self._seed(cluster.api, 12)
+        seen = []
+        payload = remote._request("GET", "/objects/ConfigMap",
+                                  query={"limit": "4"})
+        seen += [d["metadata"]["name"] for d in payload["items"]]
+        token = payload["continue"]
+        # Churn on BOTH sides of the cursor between pages: a create before
+        # it (must not be revisited), a create after it (fair game), and a
+        # delete of a not-yet-walked object.
+        cluster.api.create(ConfigMap(metadata=ObjectMeta(name="pg-000a")))
+        cluster.api.create(ConfigMap(metadata=ObjectMeta(name="pg-0105")))
+        cluster.api.delete("ConfigMap", "default", "pg-006")
+        while token:
+            payload = remote._request(
+                "GET", "/objects/ConfigMap",
+                query={"limit": "4", "continue": token},
+            )
+            seen += [d["metadata"]["name"] for d in payload["items"]]
+            token = payload.get("continue")
+        survivors = {f"pg-{i:03d}" for i in range(12)} - {"pg-006"}
+        assert len(seen) == len(set(seen)), "pagination produced duplicates"
+        assert survivors <= set(seen), "a stable object was skipped"
+        assert "pg-000a" not in seen  # created behind the cursor
+        assert "pg-0105" in seen  # created ahead of the cursor
+
+    def test_continue_token_stable_across_resume_ring_eviction(self):
+        """Watch-resume ring evictions (a tiny ring outrun by churn) must
+        not disturb an in-flight chunked walk: the token is keyed on the
+        collection order, not on the event stream."""
+        cluster = Cluster()
+        server = ApiHTTPServer(cluster.api, port=0, resume_ring_size=2)
+        try:
+            remote = RemoteAPIServer(server.url, timeout=10.0)
+            self._seed(cluster.api, 8)
+            evicted_before = metrics.wire_resume_ring_evictions.total()
+            payload = remote._request("GET", "/objects/ConfigMap",
+                                      query={"limit": "3"})
+            seen = [d["metadata"]["name"] for d in payload["items"]]
+            token = payload["continue"]
+            # Outrun the 2-event ring mid-walk.
+            for i in range(6):
+                cluster.api.create(Pod(metadata=ObjectMeta(name=f"churn-{i}")))
+            server._ring.sync()
+            assert metrics.wire_resume_ring_evictions.total() > evicted_before
+            while token:
+                payload = remote._request(
+                    "GET", "/objects/ConfigMap",
+                    query={"limit": "3", "continue": token},
+                )
+                seen += [d["metadata"]["name"] for d in payload["items"]]
+                token = payload.get("continue")
+            assert sorted(seen) == [f"pg-{i:03d}" for i in range(8)]
+        finally:
+            server.close()
+
+    def test_token_for_wrong_kind_rejected(self, served):
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0)
+        self._seed(cluster.api, 2)
+        token = encode_continue_token("Pod", 7, ("default", "x"))
+        with pytest.raises(ValueError):
+            remote._request("GET", "/objects/ConfigMap",
+                            query={"limit": "1", "continue": token})
+        after, rv = decode_continue_token(token, "Pod")
+        assert after == ("default", "x") and rv == 7
+        with pytest.raises(ValueError):
+            decode_continue_token("garbage!!", "Pod")
+
+    def test_too_old_relist_rides_pages(self, served):
+        """Satellite fix: the full-relist fallback arm lists in pages of
+        list_page_limit instead of one giant body."""
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0, resume=False,
+                                 list_page_limit=3)
+        self._seed(cluster.api, 7)
+        wq = remote.watch(kinds=["ConfigMap"])
+        wq.drain(timeout=0.0)
+        pages_before = metrics.wire_list_pages.total()
+        server.reap_all_sessions()
+        # resume=False pins the full-relist heal; the poll discovers the
+        # reap and relists every registry kind — ConfigMap in 3 pages.
+        events = wq.drain(timeout=0.0)
+        assert {e.obj.metadata.name for e in events} == {
+            f"pg-{i:03d}" for i in range(7)
+        }
+        assert metrics.wire_list_pages.total() - pages_before >= 3
+
+
+class TestProjection:
+    def _pod(self) -> Pod:
+        return Pod(
+            metadata=ObjectMeta(name="proj-0", namespace="ns1",
+                                labels={"a": "b"}),
+            spec=PodTemplateSpec(
+                containers=[Container(name="c", image="i",
+                                      resources={"cpu": 2.0})],
+            ),
+            status=PodStatus(phase=PodPhase.RUNNING, message="placed"),
+        )
+
+    def test_projection_round_trip_vs_reflect_codec(self, served):
+        """A projected body decodes through the SAME kind registry the
+        reflection reference codec defines: requested paths round-trip
+        exactly, absent fields take dataclass defaults."""
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0)
+        cluster.api.create(self._pod())
+        out = remote.list("Pod", "ns1", fields="metadata,status.phase")
+        assert len(out) == 1
+        got = out[0]
+        reference = wire.reflect_decode(wire.reflect_encode(self._pod()))
+        # Projected paths match the reflect-codec round trip field for field.
+        assert got.metadata.name == reference.metadata.name
+        assert got.metadata.namespace == reference.metadata.namespace
+        assert got.metadata.labels == reference.metadata.labels
+        assert got.status.phase is reference.status.phase
+        # Pruned fields came back as defaults: the spec bytes were never paid.
+        assert got.spec.containers == []
+        assert got.status.message == ""
+
+    def test_project_encoded_matches_manual_prune(self):
+        pod = self._pod()
+        full = wire.encode(pod)
+        paths = wire.parse_field_paths("status.phase, metadata")
+        projected = wire.project_encoded(full, paths)
+        assert projected["kind"] == "Pod"
+        assert projected["metadata"] == full["metadata"]
+        assert projected["status"] == {"phase": full["status"]["phase"]}
+        assert "spec" not in projected
+        # Selector spelling doesn't matter: canonical path tuples agree.
+        assert paths == wire.parse_field_paths("metadata,status.phase")
+
+    def test_projected_bodies_served_from_lru(self, served):
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0)
+        cluster.api.create(self._pod())
+        full_hits = metrics.wire_body_cache_hits.total()
+        full_misses = metrics.wire_body_cache_misses.total()
+        remote.list("Pod", "ns1", fields="metadata")
+        hits_before = metrics.wire_proj_cache_hits.total()
+        remote.list("Pod", "ns1", fields="metadata")
+        assert metrics.wire_proj_cache_hits.total() > hits_before
+        assert len(server._proj_cache) == 1
+        # Projection traffic must not pollute the FULL-body cache family.
+        assert metrics.wire_body_cache_hits.total() == full_hits
+        assert metrics.wire_body_cache_misses.total() == full_misses
+
+    def test_projected_and_full_bodies_are_distinct_cache_entries(self, served):
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0)
+        cluster.api.create(self._pod())
+        slim = remote.list("Pod", "ns1", fields="metadata")[0]
+        full = remote.list("Pod", "ns1")[0]
+        assert slim.spec.containers == []
+        assert full.spec.containers[0].resources == {"cpu": 2.0}
+
+
+class TestCompatMatrix:
+    def test_v1_pinned_client_stays_synchronous(self, served):
+        """RemoteAPIServer(pipeline=False) pins protocol v1: no /batch
+        envelopes, no coalescing, update() is one synchronous round trip —
+        whatever the coalesce knob says."""
+        cluster, server = served
+        remote = RemoteAPIServer(server.url, timeout=10.0, pipeline=False,
+                                 coalesce_window_ms=60_000.0)
+        assert remote._channel is None and remote._coalescer is None
+        remote.create(_job("v1pin"))
+        reqs_before = metrics.wire_batch_requests.total()
+        j = cluster.api.get("JAXJob", "default", "v1pin")
+        j.status.start_time = 8.0
+        remote.update(j, status_only=True)
+        # Synchronous: visible immediately, and no envelope was involved.
+        assert cluster.api.get("JAXJob", "default", "v1pin").status.start_time == 8.0
+        assert metrics.wire_batch_requests.total() == reqs_before
+
+    def test_v2_client_degrades_against_old_server(self, served):
+        """New client, old host: the first POST /batch answers 404; the
+        client pins per-request HTTP for its lifetime but KEEPS the
+        last-write-wins merge (duplicates were dropped client-side)."""
+        cluster, server = served
+        _fake_v1_server(server)
+        remote = RemoteAPIServer(server.url, timeout=10.0,
+                                 coalesce_window_ms=60_000.0)
+        remote.create(_job("compat"))
+        j = cluster.api.get("JAXJob", "default", "compat")
+        for t in (1.0, 2.0):
+            j.status.start_time = t
+            remote.update(j, status_only=True)
+        remote.flush_writes()
+        assert remote._channel.supported is False
+        assert cluster.api.get("JAXJob", "default", "compat").status.start_time == 2.0
+        # Later flushes skip the doomed probe and still deliver.
+        j.status.start_time = 3.0
+        remote.update(j, status_only=True)
+        remote.flush_writes()
+        assert cluster.api.get("JAXJob", "default", "compat").status.start_time == 3.0
+
+    def test_v2_degraded_conflicts_still_resolve(self, served):
+        cluster, server = served
+        _fake_v1_server(server)
+        remote = RemoteAPIServer(server.url, timeout=10.0,
+                                 coalesce_window_ms=60_000.0)
+        remote.create(_job("compat-cfl"))
+        stale = cluster.api.get("JAXJob", "default", "compat-cfl")
+        cluster.api.update(cluster.api.get("JAXJob", "default", "compat-cfl"))
+        stale.status.start_time = 4.0
+        remote.update(stale, status_only=True)
+        remote.flush_writes()
+        assert (
+            cluster.api.get("JAXJob", "default", "compat-cfl").status.start_time
+            == 4.0
+        )
+
+    def test_paginated_client_against_old_server_terminates(self, served):
+        """Old hosts ignore limit/continue and answer the FULL collection
+        in one page: the client's page walk must see no continue token and
+        stop — not loop, not double-count."""
+        cluster, server = served
+        _fake_v1_server(server)
+        remote = RemoteAPIServer(server.url, timeout=10.0, list_page_limit=2)
+        for i in range(5):
+            cluster.api.create(ConfigMap(metadata=ObjectMeta(name=f"o-{i}")))
+        out = remote.list("ConfigMap", limit=2)
+        assert sorted(o.metadata.name for o in out) == [f"o-{i}" for i in range(5)]
+
+    def test_remote_manager_converges_with_v2_coalescing(self):
+        """End to end: an OperatorManager on a coalescing v2 client (the
+        operator-role deployment shape) converges a job. The coalesce
+        window is set absurdly high, so ONLY the tick-end flush hook and
+        the engine's terminal-condition flush deliver status writes — and
+        the terminal state must be visible on the host immediately after
+        the reconcile that produced it (the SDK-poller contract)."""
+        host = Cluster()
+        from training_operator_tpu.cluster.inventory import make_cpu_pool
+
+        host.add_nodes(make_cpu_pool(2, cpu_per_node=8.0))
+        DefaultScheduler(host)
+        SimKubelet(host)
+        server = ApiHTTPServer(host.api, port=0)
+        try:
+            reqs_before = metrics.wire_batch_requests.total()
+            remote = RemoteAPIServer(server.url, timeout=10.0,
+                                     coalesce_window_ms=3_600_000.0,
+                                     list_page_limit=100)
+            runtime = RemoteRuntime(remote, tick_interval=0.0)
+            mgr = OperatorManager(runtime, gang_enabled=False)
+            mgr.register(JAXController(runtime.api))
+            remote.create(_job("v2-conv", replicas=2))
+
+            deadline = host.clock.now() + 30.0
+
+            def succeeded():
+                j = host.api.try_get("JAXJob", "default", "v2-conv")
+                return j is not None and capi.is_succeeded(j.status)
+
+            while host.clock.now() < deadline and not succeeded():
+                host.step()
+                runtime.step()
+            assert succeeded(), host.api.try_get(
+                "JAXJob", "default", "v2-conv"
+            ).status
+            # The status writes rode batch envelopes, not bare PUTs.
+            assert metrics.wire_batch_requests.total() > reqs_before
+            # Nothing terminal is stranded in the buffer.
+            assert len(remote._coalescer) == 0
+            mgr.stop()
+        finally:
+            server.close()
+
+
+class TestWireV2Knobs:
+    def test_cli_flags_reach_the_wire_client(self):
+        from training_operator_tpu.__main__ import (
+            build_config,
+            make_remote_api,
+            parse_args,
+        )
+
+        cfg = build_config(parse_args([
+            "--wire-pipeline-depth", "16",
+            "--coalesce-window-ms", "7",
+            "--list-page-limit", "42",
+        ]))
+        client = make_remote_api(cfg, "http://127.0.0.1:1")
+        assert client.pipeline is True
+        assert client._channel.depth == 16
+        assert client._coalescer is not None
+        assert client._coalescer.window == pytest.approx(0.007)
+        assert client.list_page_limit == 42
+
+    def test_pipeline_depth_zero_pins_v1(self):
+        from training_operator_tpu.__main__ import (
+            build_config,
+            make_remote_api,
+            parse_args,
+        )
+
+        cfg = build_config(parse_args(["--wire-pipeline-depth", "0"]))
+        client = make_remote_api(cfg, "http://127.0.0.1:1")
+        assert client.pipeline is False
+        assert client._channel is None and client._coalescer is None
+        # ALL of v2 is pinned off — chunked LISTs included — so the escape
+        # hatch reproduces real v1 wire traffic.
+        assert client.list_page_limit == 0
+
+    def test_defaults_and_validation(self):
+        from training_operator_tpu.config import OperatorConfig
+
+        cfg = OperatorConfig()
+        assert cfg.wire_pipeline_depth == 64
+        assert cfg.coalesce_window_ms == 20.0
+        assert cfg.list_page_limit == 500
+        for field, bad in (
+            ("wire_pipeline_depth", -1),
+            ("coalesce_window_ms", -0.5),
+            ("list_page_limit", -2),
+        ):
+            broken = OperatorConfig(**{field: bad})
+            with pytest.raises(ValueError):
+                broken.validate()

@@ -129,6 +129,21 @@ def parse_args(argv=None) -> argparse.Namespace:
                          "(default 8192)")
     ap.add_argument("--api-server", default=None, metavar="URL",
                     help="operator role: base URL of the serving host")
+    ap.add_argument("--wire-pipeline-depth", type=int, default=None,
+                    help="operator role: max requests framed into one "
+                         "POST /batch envelope (wire protocol v2 request "
+                         "pipelining); 0 pins wire v1 — per-request HTTP, "
+                         "no batching or coalescing (default 64)")
+    ap.add_argument("--coalesce-window-ms", type=float, default=None,
+                    help="operator role: worst-case ms a status write may "
+                         "sit in the client-side last-write-wins coalesce "
+                         "buffer (the manager flushes every tick and "
+                         "terminal writes flush immediately); 0 disables "
+                         "coalescing (default 20)")
+    ap.add_argument("--list-page-limit", type=int, default=None,
+                    help="operator role: page size for chunked LISTs "
+                         "(limit/continue) on relist and informer-prime "
+                         "paths; 0 disables pagination (default 500)")
     ap.add_argument("--api-token", default=None,
                     help="bearer token for the wire API: required of clients "
                          "when the host sets it (env TPU_OPERATOR_API_TOKEN)")
@@ -242,6 +257,12 @@ def build_config(args: argparse.Namespace) -> OperatorConfig:
         cfg.journal_fsync = args.journal_fsync
     if args.watch_ring_size is not None:
         cfg.watch_ring_size = args.watch_ring_size
+    if args.wire_pipeline_depth is not None:
+        cfg.wire_pipeline_depth = args.wire_pipeline_depth
+    if args.coalesce_window_ms is not None:
+        cfg.coalesce_window_ms = args.coalesce_window_ms
+    if args.list_page_limit is not None:
+        cfg.list_page_limit = args.list_page_limit
     if args.health_probe_port is not None:
         cfg.health_port = args.health_probe_port
     if args.health_probe_bind_address is not None:
@@ -472,6 +493,27 @@ def make_host_store(cfg: OperatorConfig, state_dir: str):
     )
 
 
+def make_remote_api(cfg: OperatorConfig, url: str, token: "str | None" = None,
+                    ca_file: "str | None" = None):
+    """The wire client exactly as run_operator constructs it — factored out
+    so the knob round-trip tests exercise the REAL flag->config->client
+    path (make_host_store pattern). wire_pipeline_depth=0 pins protocol v1
+    (no batch envelopes, no coalescing), whatever the other knobs say."""
+    from training_operator_tpu.cluster.httpapi import RemoteAPIServer
+
+    return RemoteAPIServer(
+        url,
+        token=token,
+        ca_file=ca_file,
+        pipeline=cfg.wire_pipeline_depth > 0,
+        pipeline_depth=max(1, cfg.wire_pipeline_depth),
+        coalesce_window_ms=cfg.coalesce_window_ms,
+        # Depth 0 pins ALL of v2 — including chunked LISTs — so the escape
+        # hatch really reproduces v1 wire traffic, not a hybrid.
+        list_page_limit=cfg.list_page_limit if cfg.wire_pipeline_depth > 0 else 0,
+    )
+
+
 def run_host(args, cfg) -> int:
     """Host role: the substrate process — API server over HTTP, default
     scheduler, sim kubelet, gang scheduler; admission (defaulting +
@@ -600,7 +642,7 @@ def run_operator(args, cfg) -> int:
     API server — the reference's operator-pod deployment shape. Two of
     these processes racing one lease is real HA: kill -9 the leader and
     the standby converges the same jobs."""
-    from training_operator_tpu.cluster.httpapi import RemoteAPIServer, RemoteRuntime
+    from training_operator_tpu.cluster.httpapi import RemoteRuntime
 
     if not args.api_server:
         raise SystemExit("--role operator requires --api-server URL")
@@ -612,7 +654,7 @@ def run_operator(args, cfg) -> int:
     ca_file = args.ca_cert or _os.environ.get("TPU_OPERATOR_CA_CERT") or None
     from training_operator_tpu.cluster.httpapi import CachedReadAPI
 
-    remote = RemoteAPIServer(args.api_server, token=token, ca_file=ca_file)
+    remote = make_remote_api(cfg, args.api_server, token=token, ca_file=ca_file)
     runtime = RemoteRuntime(remote)
     # Reads from the informer mirror, writes direct (client-go listers):
     # reconciles stop paying wire round trips for every pod/service list.

@@ -57,6 +57,28 @@ class NotFoundError(Exception):
     pass
 
 
+def graft_status_retry(try_get: Callable, update: Callable, obj: Any) -> None:
+    """THE conflict arm for status writes, shared by the engine's
+    synchronous retry and the wire coalescer's flush-boundary retry so the
+    two can never diverge: re-get the current stored version, graft the
+    writer's status AND its annotation changes (the recreate-restart
+    budget rides an annotation — dropping its bump on a raced write would
+    let a crash-looping job restart past its backoff limit forever), then
+    write unconditionally (the controller's tally is the truth source).
+    NotFoundError from either call means the object was deleted in the
+    race window — nothing left to write; callers decide what that means."""
+    fresh = try_get(
+        obj.KIND, getattr(obj.metadata, "namespace", "") or "", obj.metadata.name
+    )
+    if fresh is None:
+        return
+    fresh.status = obj.status
+    merged = dict(fresh.metadata.annotations)
+    merged.update(obj.metadata.annotations)
+    fresh.metadata.annotations = merged
+    update(fresh, check_version=False, status_only=True)
+
+
 @dataclass
 class WatchEvent:
     type: str  # Added | Modified | Deleted
@@ -450,7 +472,11 @@ class APIServer:
             obj = self._objects.get((kind, namespace or "", name))
             return obj.metadata.resource_version if obj is not None else None
 
-    def update(self, obj: Any, check_version: bool = True, status_only: bool = False) -> Any:
+    def update(self, obj: Any, check_version: bool = True, status_only: bool = False,
+               coalesce: bool = True) -> Any:
+        # `coalesce` is part of the APIServer duck-type for the wire
+        # client's sake (RemoteAPIServer.update): in-process writes are
+        # always synchronous, so it is accepted and ignored here.
         with self._lock:
             key = self._key(obj)
             current = self._objects.get(key)
@@ -513,11 +539,22 @@ class APIServer:
         kind: str,
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
+        limit: int = 0,
+        after: Optional[Tuple[str, str]] = None,
     ) -> List[Any]:
         """list() without the copies — STORED references, read-only by the
         same contract as get_ref. The wire layer encodes these directly
         (and caches the bytes by resourceVersion), skipping one full deep
-        copy per object per LIST."""
+        copy per object per LIST.
+
+        `limit`/`after` are the chunked-LIST support (apiserver limit/
+        continue lineage): with limit > 0 the result is ordered by
+        (namespace, name) and truncated to the first `limit` entries whose
+        key sorts strictly after `after`. Key-ordered resumption is what
+        makes a continue token stable under concurrent writes: an object
+        neither created nor deleted during the walk is returned exactly
+        once, because its sort position doesn't depend on the churn around
+        it (unlike an offset, which shifts under every insert/delete)."""
         with self._lock:
             by_kind = self._by_kind.get(kind, {})
             if label_selector:
@@ -538,12 +575,37 @@ class APIServer:
                     labels = obj.metadata.labels
                     if all(labels.get(lk) == lv for lk, lv in label_selector.items()):
                         out.append(obj)
-                return out
-            return [
-                obj
-                for (ns, _), obj in by_kind.items()
-                if namespace is None or ns == namespace
-            ]
+            else:
+                out = [
+                    obj
+                    for (ns, _), obj in by_kind.items()
+                    if namespace is None or ns == namespace
+                ]
+        if limit > 0:
+            # Sort + slice OUTSIDE the store lock: the captured refs are a
+            # consistent snapshot (frozen versions), and a 10k-object walk
+            # re-sorts per page — O(N log N) per page is tolerable off-lock
+            # but would serialize every concurrent API call on-lock.
+            out.sort(
+                key=lambda o: (
+                    getattr(o.metadata, "namespace", "") or "",
+                    o.metadata.name,
+                )
+            )
+            if after is not None:
+                lo = 0
+                hi = len(out)
+                while lo < hi:  # first key strictly after the cursor
+                    mid = (lo + hi) // 2
+                    md = out[mid].metadata
+                    if ((getattr(md, "namespace", "") or "", md.name)
+                            <= after):
+                        lo = mid + 1
+                    else:
+                        hi = mid
+                out = out[lo:]
+            out = out[:limit]
+        return out
 
     # -- pod logs ----------------------------------------------------------
 

@@ -385,6 +385,57 @@ def _reflect_decode_value(value: Any, hint: Any) -> Any:
 
 
 # ---------------------------------------------------------------------------
+# Wire protocol v2 batch framing (shared client/server vocabulary)
+# ---------------------------------------------------------------------------
+
+# POST /batch envelopes: a sequence of length-prefixed sub-requests in one
+# HTTP body, one wire round trip for N ops. Framing (request and response
+# symmetric): one header line of JSON, then per op a JSON control line
+# followed by exactly `l` raw body bytes. The sub-bodies are the compiled
+# codec's output verbatim — the envelope never re-encodes.
+BATCH_CONTENT_TYPE = "application/x-wire-batch"
+BATCH_VERSION = 2
+
+
+# ---------------------------------------------------------------------------
+# Field-selector projections (wire protocol v2)
+# ---------------------------------------------------------------------------
+
+
+def parse_field_paths(fields: str) -> tuple:
+    """Normalize a `fields=` selector string ("metadata,status.phase") into a
+    sorted tuple of dotted paths — THE canonical form both the server's
+    projected-body cache key and the projection itself use, so two spellings
+    of the same selector share cache entries."""
+    return tuple(sorted({p.strip() for p in fields.split(",") if p.strip()}))
+
+
+def project_encoded(data: Dict[str, Any], paths: tuple) -> Dict[str, Any]:
+    """Prune an already-encoded wire dict down to the requested dotted paths
+    (plus the `kind` discriminator, which decode() needs). Runs on the
+    compiled codec's OUTPUT, so projection never re-walks the dataclass —
+    and a projected body decodes through the same kind registry: absent
+    fields take their dataclass defaults, which is exactly the contract a
+    lister that only reads metadata + status.phase relies on."""
+    out: Dict[str, Any] = {}
+    if "kind" in data:
+        out["kind"] = data["kind"]
+    for path in paths:
+        src: Any = data
+        dst = out
+        segs = path.split(".")
+        for i, seg in enumerate(segs):
+            if not isinstance(src, dict) or seg not in src:
+                break
+            if i == len(segs) - 1:
+                dst[seg] = src[seg]
+            else:
+                src = src[seg]
+                dst = dst.setdefault(seg, {})
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Watch events
 # ---------------------------------------------------------------------------
 

@@ -18,6 +18,7 @@ doesn't leak an ever-growing event queue.
 
 from __future__ import annotations
 
+import base64
 import json
 import logging
 import threading
@@ -41,6 +42,49 @@ from training_operator_tpu.cluster.wire_transport import seg_ns
 from training_operator_tpu.utils import metrics
 
 log = logging.getLogger(__name__)
+
+# Wire protocol v2 batch envelope framing: see wire.BATCH_VERSION (the
+# vocabulary is shared with the client transport, like the path segments).
+BATCH_CONTENT_TYPE = wire.BATCH_CONTENT_TYPE
+BATCH_VERSION = wire.BATCH_VERSION
+
+# THE exception -> HTTP status mapping, consumed by both the per-request
+# route arms and the per-op batch executor so the same operation can never
+# answer different statuses depending on which framing it rode. Order is
+# most-specific-first (AlreadyExists before its sibling Conflict).
+API_ERROR_STATUS = (
+    (NotFoundError, 404, "NotFound"),
+    (AlreadyExistsError, 409, "AlreadyExists"),
+    (ConflictError, 409, "Conflict"),
+    (ValueError, 422, "Invalid"),
+)
+
+
+def encode_continue_token(kind: str, rv: int, after: Tuple[str, str]) -> str:
+    """Opaque LIST continue token: kind (so a token can't be replayed
+    against another collection), the resourceVersion watermark the walk
+    started at (diagnostic), and the (namespace, name) cursor the next page
+    resumes strictly after. Key-ordered resumption keeps the token stable
+    under concurrent create/delete (see APIServer.list_refs)."""
+    payload = json.dumps({"k": kind, "rv": rv, "a": list(after)},
+                         separators=(",", ":"))
+    return base64.urlsafe_b64encode(payload.encode()).decode()
+
+
+def decode_continue_token(token: str, kind: str) -> Tuple[Tuple[str, str], int]:
+    """((namespace, name) cursor, rv watermark); raises ValueError (-> 422)
+    on garbage or a token minted for a different kind."""
+    try:
+        data = json.loads(base64.urlsafe_b64decode(token.encode()))
+        after = (str(data["a"][0]), str(data["a"][1]))
+        tok_kind, rv = data["k"], int(data.get("rv", 0))
+    except (ValueError, KeyError, IndexError, TypeError):
+        raise ValueError(f"malformed continue token {token!r}") from None
+    if tok_kind != kind:
+        raise ValueError(
+            f"continue token was minted for kind {tok_kind!r}, not {kind!r}"
+        )
+    return after, rv
 
 
 class _ResumeRing:
@@ -212,6 +256,13 @@ class ApiHTTPServer:
         self._body_cache: "OrderedDict[Tuple[str, str, str, int], bytes]" = OrderedDict()
         self._body_cache_max = 16384
         self._body_lock = threading.Lock()
+        # Projected-body LRU, alongside (not inside) the full-body cache:
+        # keyed by the same frozen (kind, ns, name, rv) identity PLUS the
+        # canonical field-path tuple, so projected LISTs (`fields=`) get the
+        # same encode-once treatment as full bodies without polluting the
+        # full-body keyspace. Same staleness-free property: a new rv misses.
+        self._proj_cache: "OrderedDict[Tuple, bytes]" = OrderedDict()
+        self._proj_cache_max = 16384
         # Parsed-route memo keyed by the raw request target: watch polls and
         # burst-time LISTs repeat identical paths thousands of times, and
         # urlsplit+unquote+parse_qsl per request shows up at that scale.
@@ -243,10 +294,12 @@ class ApiHTTPServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _body(self) -> Any:
+            def _raw_body(self) -> bytes:
                 n = int(self.headers.get("Content-Length") or 0)
-                raw = self.rfile.read(n) if n else b"{}"
-                return json.loads(raw or b"{}")
+                return self.rfile.read(n) if n else b""
+
+            def _body(self) -> Any:
+                return json.loads(self._raw_body() or b"{}")
 
             def _route(self, method: str) -> None:
                 try:
@@ -268,19 +321,16 @@ class ApiHTTPServer:
                     else:
                         parts, q = cached
                         outer._dispatch(self, method, parts, q)
-                except NotFoundError as e:
-                    self._send(404, {"error": "NotFound", "message": str(e)})
-                except ConflictError as e:
-                    self._send(409, {"error": "Conflict", "message": str(e)})
-                except AlreadyExistsError as e:
-                    self._send(409, {"error": "AlreadyExists", "message": str(e)})
-                except ValueError as e:
-                    self._send(422, {"error": "Invalid", "message": str(e)})
                 except BrokenPipeError:
                     pass
                 except Exception as e:  # noqa: BLE001 — wire boundary
-                    log.exception("httpapi handler error")
-                    self._send(500, {"error": "Internal", "message": str(e)})
+                    for exc_type, code, kind in API_ERROR_STATUS:
+                        if isinstance(e, exc_type):
+                            self._send(code, {"error": kind, "message": str(e)})
+                            break
+                    else:
+                        log.exception("httpapi handler error")
+                        self._send(500, {"error": "Internal", "message": str(e)})
 
             def do_GET(self):
                 self._route("GET")
@@ -424,6 +474,8 @@ class ApiHTTPServer:
             self._route_cache[memo_key] = (parts, q)
         if head == "objects":
             self._objects(h, method, parts[1:], q)
+        elif head == "batch" and method == "POST":
+            self._batch(h)
         elif head == "watches":
             self._watches(h, method, parts[1:], q)
         elif head == "logs":
@@ -478,49 +530,196 @@ class ApiHTTPServer:
                 self._body_cache.popitem(last=False)
         return body
 
-    def _objects(self, h, method: str, parts: List[str], q: Dict[str, str]) -> None:
+    def _projected_bytes(self, obj, paths: tuple) -> bytes:
+        """Encoded JSON bytes of one stored reference pruned to `paths`, via
+        the projected-body LRU (same frozen-version contract as
+        _object_bytes — a new resourceVersion misses, no invalidation)."""
+        md = obj.metadata
+        key = (
+            obj.KIND,
+            getattr(md, "namespace", "") or "",
+            md.name,
+            md.resource_version,
+            paths,
+        )
+        with self._body_lock:
+            body = self._proj_cache.get(key)
+            if body is not None:
+                self._proj_cache.move_to_end(key)
+        if body is not None:
+            metrics.wire_proj_cache_hits.inc()
+            return body
+        body = json.dumps(
+            wire.project_encoded(wire.encode(obj), paths), separators=(",", ":")
+        ).encode()
+        metrics.wire_proj_cache_misses.inc()
+        with self._body_lock:
+            self._proj_cache[key] = body
+            while len(self._proj_cache) > self._proj_cache_max:
+                self._proj_cache.popitem(last=False)
+        return body
+
+    def _list_bytes(self, kind: str, q: Dict[str, str]) -> bytes:
+        """One LIST response body: full collection (v1), or one page of a
+        chunked walk (`limit`/`continue`), optionally field-projected
+        (`fields=`). Response elements are byte concatenation from the
+        (full or projected) body caches either way."""
+        selector = None
+        if q.get("labelSelector"):
+            selector = dict(
+                pair.split("=", 1) for pair in q["labelSelector"].split(",") if "=" in pair
+            )
+        namespace = q.get("namespace") or None
+        paths = wire.parse_field_paths(q["fields"]) if q.get("fields") else None
+        limit = int(q.get("limit") or 0)
+        after = None
+        if q.get("continue"):
+            after, _ = decode_continue_token(q["continue"], kind)
+        token = None
+        if limit > 0 or after is not None:
+            # Over-fetch by one to learn whether a next page exists without
+            # a count pass; the +1 ref is dropped from the response.
+            refs = self.api.list_refs(
+                kind, namespace, selector, limit=max(limit, 1) + 1, after=after
+            )
+            metrics.wire_list_pages.inc()
+            if len(refs) > max(limit, 1):
+                refs = refs[: max(limit, 1)]
+                last = refs[-1].metadata
+                token = encode_continue_token(
+                    kind, self.api.version(),
+                    (getattr(last, "namespace", "") or "", last.name),
+                )
+        else:
+            refs = self.api.list_refs(kind, namespace, selector)
+        encode_one = (
+            self._object_bytes if paths is None
+            else (lambda o: self._projected_bytes(o, paths))
+        )
+        # Byte concatenation, not re-encoding: each element's bytes come
+        # from the version-keyed cache, so a burst of identical LISTs
+        # costs one serialization per changed object, total.
+        body = b'{"items":[' + b",".join(encode_one(o) for o in refs)
+        if token is not None:
+            return body + b'],"continue":' + json.dumps(token).encode() + b"}"
+        return body + b"]}"
+
+    def _objects_op(
+        self, method: str, parts: List[str], q: Dict[str, str], raw: bytes
+    ) -> Tuple[int, bytes]:
+        """One /objects operation -> (status, body bytes). Shared by the
+        per-request HTTP path (_objects) and the batch executor (_exec_op),
+        so v1 and v2 framings cannot drift semantically. API errors
+        propagate; each caller maps them to statuses at its own boundary
+        (the route's except arms, or per-op isolation inside a batch)."""
         if method == "POST" and not parts:
-            obj = wire.decode(h._body())
+            obj = wire.decode(json.loads(raw or b"{}"))
             created = self.api.create(obj)
             # Respond through the body cache: `created` carries the assigned
             # uid/resourceVersion and is content-identical to the stored
             # clone, so this both serves the response and SEEDS the cache —
             # the operator's next LIST of this version is a hit.
-            h._send_bytes(201, self._object_bytes(created))
-        elif method == "GET" and len(parts) == 1:
-            selector = None
-            if q.get("labelSelector"):
-                selector = dict(
-                    pair.split("=", 1) for pair in q["labelSelector"].split(",") if "=" in pair
-                )
-            refs = self.api.list_refs(parts[0], q.get("namespace") or None, selector)
-            # Byte concatenation, not re-encoding: each element's bytes come
-            # from the version-keyed cache, so a burst of identical LISTs
-            # costs one serialization per changed object, total.
-            h._send_bytes(
-                200,
-                b'{"items":[' + b",".join(self._object_bytes(o) for o in refs) + b"]}",
+            return 201, self._object_bytes(created)
+        if method == "GET" and len(parts) == 1:
+            return 200, self._list_bytes(parts[0], q)
+        if method == "GET" and len(parts) == 3:
+            return 200, self._object_bytes(
+                self.api.get_ref(parts[0], seg_ns(parts[1]), parts[2])
             )
-        elif method == "GET" and len(parts) == 3:
-            h._send_bytes(
-                200,
-                self._object_bytes(self.api.get_ref(parts[0], seg_ns(parts[1]), parts[2])),
-            )
-        elif method == "PUT" and len(parts) == 3:
-            obj = wire.decode(h._body())
+        if method == "PUT" and len(parts) == 3:
+            obj = wire.decode(json.loads(raw or b"{}"))
             updated = self.api.update(
                 obj,
                 check_version=q.get("check_version", "1") != "0",
                 status_only=q.get("status_only") == "1",
             )
             # Seeds the cache with the fresh version (see POST above).
-            h._send_bytes(200, self._object_bytes(updated))
-        elif method == "DELETE" and len(parts) == 3:
+            return 200, self._object_bytes(updated)
+        if method == "DELETE" and len(parts) == 3:
             gone = self.api.delete(parts[0], seg_ns(parts[1]), parts[2])
             # The deleted object's final version is usually already cached.
-            h._send_bytes(200, self._object_bytes(gone))
-        else:
-            h._send(404, {"error": "NotFound", "message": "bad objects route"})
+            return 200, self._object_bytes(gone)
+        raise NotFoundError("bad objects route")
+
+    def _objects(self, h, method: str, parts: List[str], q: Dict[str, str]) -> None:
+        code, body = self._objects_op(method, parts, q, h._raw_body())
+        h._send_bytes(code, body)
+
+    # -- batch envelopes (wire protocol v2) --------------------------------
+
+    def _exec_op(
+        self, method: str, path: str, q: Dict[str, str], raw: bytes
+    ) -> Tuple[int, bytes]:
+        """Execute one batch sub-request with PER-OP status isolation: a
+        conflict (or any API error) on one op maps to that op's status
+        slot, exactly as it would have mapped to an HTTP status on its own
+        request — the rest of the batch proceeds in order."""
+        parts = [urllib.parse.unquote(p) for p in path.split("/") if p]
+        try:
+            if parts and parts[0] == "objects":
+                return self._objects_op(method, parts[1:], q, raw)
+            if parts and parts[0] == "events" and method == "POST":
+                self.api.record_event(wire.decode(json.loads(raw or b"{}"), Event))
+                return 201, b'{"ok":true}'
+            if (parts and parts[0] == "timelines" and method == "POST"
+                    and len(parts) == 3):
+                body = json.loads(raw or b"{}")
+                self.api.record_spans(
+                    seg_ns(parts[1]), parts[2], list(body.get("spans", [])),
+                    marks=list(body.get("marks", [])),
+                )
+                return 200, b'{"ok":true}'
+            raise NotFoundError(f"no batched route {path}")
+        except Exception as e:  # noqa: BLE001 — per-op wire boundary
+            for exc_type, code, kind in API_ERROR_STATUS:
+                if isinstance(e, exc_type):
+                    return code, json.dumps(
+                        {"error": kind, "message": str(e)}
+                    ).encode()
+            log.exception("batch op handler error")
+            return 500, json.dumps(
+                {"error": "Internal", "message": str(e)}
+            ).encode()
+
+    def _batch(self, h) -> None:
+        """POST /batch: execute a pipelined envelope of sub-requests in
+        order, answering per-op status + body in one response. NOT
+        idempotent (it carries writes) — the client transport never
+        auto-retries it; lost-response recovery belongs to the write
+        coalescer's re-enqueue arm."""
+        raw = h._raw_body()
+        nl = raw.find(b"\n")
+        if nl < 0:
+            raise ValueError("batch envelope: missing header line")
+        head = json.loads(raw[:nl])
+        if int(head.get("v", 0)) != BATCH_VERSION:
+            raise ValueError(f"batch envelope: unsupported version {head.get('v')!r}")
+        coalesced = int(head.get("c", 0))
+        if coalesced > 0:
+            metrics.wire_batch_coalesced.inc(amount=coalesced)
+        metrics.wire_batch_requests.inc()
+        pos = nl + 1
+        out = [json.dumps({"v": BATCH_VERSION}).encode() + b"\n"]
+        for _ in range(int(head.get("n", 0))):
+            nl = raw.find(b"\n", pos)
+            if nl < 0:
+                raise ValueError("batch envelope: truncated op header")
+            op = json.loads(raw[pos:nl])
+            body_len = int(op.get("l", 0))
+            body = raw[nl + 1: nl + 1 + body_len]
+            if len(body) != body_len:
+                raise ValueError("batch envelope: truncated op body")
+            pos = nl + 1 + body_len
+            status, resp = self._exec_op(
+                str(op.get("m", "")), str(op.get("p", "")),
+                {str(k): str(v) for k, v in (op.get("q") or {}).items()}, body,
+            )
+            metrics.wire_batch_ops.inc()
+            out.append(
+                json.dumps({"s": status, "l": len(resp)}).encode() + b"\n"
+            )
+            out.append(resp)
+        h._send_bytes(200, b"".join(out), ctype=BATCH_CONTENT_TYPE)
 
     def _watches(self, h, method: str, parts: List[str], q: Dict[str, str]) -> None:
         self._gc_sessions()

@@ -306,12 +306,19 @@ class _SharedWatch:
         (re)opened BEFORE the lists, so an object written in between can be
         seen twice (consumers are idempotent; expectations tolerate
         over-observation) but never lost. Only a FULLY successful relist
-        clears the flag — a 5xx mid-relist retries on the next drain."""
+        clears the flag — a 5xx mid-relist retries on the next drain.
+
+        The lists ride pagination (pages of the client's list_page_limit)
+        when configured: the too-old arm is exactly where a 10k-object
+        cluster would otherwise force the server to materialize one giant
+        LIST body per watched kind. Pages served are counted server-side
+        in training_wire_list_pages_total."""
         from training_operator_tpu.cluster.apiserver import WatchEvent
 
+        page = getattr(self._remote, "list_page_limit", 0) or None
         events = []
         for kind in wire.KIND_REGISTRY:
-            for obj in self._remote.list(kind):
+            for obj in self._remote.list(kind, limit=page):
                 events.append(WatchEvent("Added", kind, obj))
         self._needs_relist = False  # only cleared on a FULLY successful relist
         # Opt-in subscribers (mirror builders) get the reset marker FIRST:
@@ -420,9 +427,11 @@ class CachedReadAPI:
     def _prime_locked(self, kind: str) -> None:
         """Initial LIST for a kind (the informer's ListAndWatch seed). The
         watch was opened before priming, so an object created in between
-        appears in both — upsert order makes that harmless."""
+        appears in both — upsert order makes that harmless. Paginated like
+        the relist arm when the client configures a page limit."""
         bucket = self._mirror.setdefault(kind, {})
-        for obj in self._remote.list(kind):
+        page = getattr(self._remote, "list_page_limit", 0) or None
+        for obj in self._remote.list(kind, limit=page):
             ns = getattr(obj.metadata, "namespace", "") or ""
             bucket[(ns, obj.metadata.name)] = obj
         self._primed.add(kind)
@@ -449,6 +458,24 @@ class CachedReadAPI:
                         continue
                 out.append(self._copy(obj))
             return out
+
+    def try_get_cached(self, kind: str, namespace: str, name: str) -> Optional[Any]:
+        """One object from the watch-fed mirror (deep copy; None when
+        absent) — the lister-read the reference's reconcilers use for the
+        JOB itself, not just its dependents. Explicitly a SEPARATE verb
+        from try_get, which stays a direct wire read: lease arbitration and
+        the optimistic-concurrency conflict arm need the CURRENT stored
+        version, but a reconcile triggered BY a watch event reading the
+        event's own object is exactly as fresh from the mirror (events are
+        distributed to the manager queue and the mirror atomically), and a
+        stale read here costs one resolvable status conflict, never a spin.
+        """
+        with self._cache_lock:
+            self._sync_locked()
+            if kind not in self._primed:
+                self._prime_locked(kind)
+            obj = self._mirror.get(kind, {}).get((namespace or "", name))
+            return self._copy(obj) if obj is not None else None
 
     # -- everything else: delegate ----------------------------------------
 

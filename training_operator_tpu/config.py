@@ -64,6 +64,22 @@ class OperatorConfig:
     # expected reconnect window. The default absorbs a full 1k-job burst's
     # pod events with headroom.
     watch_ring_size: int = 8192
+    # Wire protocol v2 (cluster/wire_transport.py; operator role only — the
+    # host serves both protocols and standalone mode has no wire at all):
+    #   wire_pipeline_depth — max ops framed into one POST /batch envelope
+    #       (request pipelining on the persistent channel). 0 pins wire
+    #       protocol v1: per-request HTTP, no batching, no coalescing.
+    #   coalesce_window_ms — bound on how long a status write may sit in
+    #       the client-side last-write-wins buffer before a flush; the
+    #       manager also flushes every tick and the engine flushes terminal
+    #       writes immediately, so this is the worst case, not the norm.
+    #       0 disables coalescing (every update is its own round trip).
+    #   list_page_limit — page size for chunked LISTs (limit/continue) on
+    #       the full-relist and informer-prime arms, so a 10k-object relist
+    #       never materializes one giant body server-side. 0 = unpaginated.
+    wire_pipeline_depth: int = 64
+    coalesce_window_ms: float = 20.0
+    list_page_limit: int = 500
     # Host durability knobs (cluster/store.py HostStore; --state-dir role).
     # Compaction fires when EITHER bound is exceeded: record count (the
     # original knob) or journal BYTES — a few huge objects (big ConfigMaps,
@@ -130,6 +146,12 @@ class OperatorConfig:
             # O(cluster) — that degradation should be impossible to
             # configure by accident; disable resume client-side instead.
             raise ValueError("watch_ring_size must be >= 1")
+        if self.wire_pipeline_depth < 0:
+            raise ValueError("wire_pipeline_depth must be >= 0 (0 pins wire v1)")
+        if self.coalesce_window_ms < 0:
+            raise ValueError("coalesce_window_ms must be >= 0 (0 disables)")
+        if self.list_page_limit < 0:
+            raise ValueError("list_page_limit must be >= 0 (0 disables)")
         if self.compact_every < 1:
             raise ValueError("compact_every must be >= 1")
         if self.compact_max_journal_bytes < 0:

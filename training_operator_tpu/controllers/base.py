@@ -41,6 +41,14 @@ class BaseController:
     # -- ControllerInterface ------------------------------------------------
 
     def get_job(self, namespace: str, name: str) -> Optional[Job]:
+        # Prefer the watch-fed mirror when the API client has one (the
+        # remote operator's CachedReadAPI): the reconcile was triggered by
+        # a watch event, so the mirror is exactly as fresh as the trigger —
+        # and the direct GET per reconcile was pure wire latency. Falls
+        # back to the live read everywhere else (in-process, SDK).
+        getter = getattr(self.api, "try_get_cached", None)
+        if getter is not None:
+            return getter(self.kind, namespace, name)
         return self.api.try_get(self.kind, namespace, name)
 
     def default_container_name(self) -> str:

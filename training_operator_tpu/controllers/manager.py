@@ -135,6 +135,14 @@ class OperatorManager:
                 flush()
             except Exception:  # noqa: BLE001 — best-effort, host may be gone
                 pass
+        # Same for coalesced status writes (wire v2): a clean shutdown must
+        # not strand the last tick's buffered writes.
+        wflush = getattr(self.api, "flush_writes", None)
+        if wflush is not None:
+            try:
+                wflush()
+            except Exception:  # noqa: BLE001 — best-effort, host may be gone
+                pass
         self.api.unwatch(self._watch)
         for kind in self.controllers:
             self.api.unregister_admission(kind, validate_job)
@@ -222,6 +230,14 @@ class OperatorManager:
             for key in keys:
                 self._process(key)
         metrics.workqueue_depth.set(value=float(len(self.queue)))
+        # One reconcile flush ends here: push the tick's coalesced status
+        # writes as one batch envelope (wire protocol v2). In-process API
+        # servers have no flush_writes — nothing was deferred. A transport
+        # failure propagates to run_forever's retry arm; the coalescer has
+        # already re-enqueued the unacknowledged writes.
+        flush = getattr(self.api, "flush_writes", None)
+        if flush is not None:
+            flush()
 
     def _handle_event(self, ev) -> None:
         kind = ev.kind

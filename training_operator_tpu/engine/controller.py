@@ -620,11 +620,20 @@ class JobController:
         except NotFoundError:
             return  # job deleted mid-reconcile (e.g. TTL GC in this pass)
         except ConflictError:
-            fresh = self.api.try_get(job.kind, job.namespace, job.name)
-            if fresh is None:
-                return
-            fresh.status = job.status
-            self.api.update(fresh, check_version=False, status_only=True)
+            # Shared graft arm (carries the restart-budget annotation bump
+            # through the retry, not just status — see graft_status_retry).
+            from training_operator_tpu.cluster.apiserver import graft_status_retry
+
+            graft_status_retry(self.api.try_get, self.api.update, job)
+        if capi.is_finished(job.status):
+            # Terminal-condition flush hook (wire protocol v2): a coalescing
+            # API client buffers status writes until its window/tick flush —
+            # fine for intermediate tallies, wrong for the job's closing
+            # chapter, which SDK pollers and TTL timers key off. Push it out
+            # now. No-op on the in-process APIServer (no flush_writes).
+            flush = getattr(self.api, "flush_writes", None)
+            if flush is not None:
+                flush()
 
     def _event(self, job: Job, etype: str, reason: str, message: str) -> None:
         self.api.record_event(

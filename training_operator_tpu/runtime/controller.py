@@ -155,8 +155,12 @@ class TrainJobController:
         try:
             # Version-checked: `job` was read at reconcile start. A conflict
             # (client spec update raced this reconcile) propagates to the
-            # manager loop, which backs off and re-enqueues.
-            self.api.update(job, check_version=True, status_only=True)
+            # manager loop, which backs off and re-enqueues — so this write
+            # must stay SYNCHRONOUS (coalesce=False): the wire coalescer's
+            # graft-at-flush arm would instead force-write a status computed
+            # against the superseded spec.
+            self.api.update(job, check_version=True, status_only=True,
+                            coalesce=False)
         except NotFoundError:
             pass
 

@@ -360,6 +360,40 @@ wire_resume_ring_evictions = registry.counter(
     "training_wire_resume_ring_evictions_total",
     "watch events evicted from the bounded resume ring", (),
 )
+# Wire protocol v2 (pipelined batch envelopes + coalesced writes + paginated
+# LISTs). Counted SERVER-side so a remote bench reads them from the host's
+# GET /metrics: ops/requests > 1 means round trips saved by pipelining, and
+# coalesced_total (client-reported in the envelope head — the server cannot
+# see writes that were merged away before the wire) is the direct evidence
+# for the status-write-storm claim.
+wire_batch_requests = registry.counter(
+    "training_wire_batch_requests_total",
+    "POST /batch envelopes served (one wire round trip each)", (),
+)
+wire_batch_ops = registry.counter(
+    "training_wire_batch_ops_total",
+    "operations executed inside batch envelopes (per-op status isolation)", (),
+)
+wire_batch_coalesced = registry.counter(
+    "training_wire_batch_coalesced_total",
+    "status writes merged away client-side by last-write-wins coalescing "
+    "(reported in the batch envelope head)", (),
+)
+wire_list_pages = registry.counter(
+    "training_wire_list_pages_total",
+    "paginated LIST pages served (limit/continue chunked responses)", (),
+)
+# Projected bodies get their OWN family: folding them into the full-body
+# counters would let a projection-heavy workload mask a full-body hit-rate
+# regression in the wire_cache bench block.
+wire_proj_cache_hits = registry.counter(
+    "training_wire_proj_cache_hits_total",
+    "field-projected LIST bodies served from the projected-body LRU", (),
+)
+wire_proj_cache_misses = registry.counter(
+    "training_wire_proj_cache_misses_total",
+    "field-projected LIST bodies pruned+encoded fresh", (),
+)
 workqueue_depth = registry.gauge(
     "training_operator_workqueue_depth",
     "Keys pending in the manager workqueue after the current tick",
