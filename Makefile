@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast test-wire test-chaos lint bench bench-quick bench-wire bench-wire-v2 bench-wire-resume bench-observe bench-node-chaos dryrun operator-demo ha-demo native clean
+.PHONY: test test-fast test-wire test-chaos test-fleet lint bench bench-quick bench-wire bench-wire-v2 bench-wire-resume bench-observe bench-audit bench-node-chaos dryrun operator-demo ha-demo native clean
 
 test:            ## full suite (no hardware needed; ~10 min)
 	$(PY) -m pytest tests/ -q
@@ -22,6 +22,9 @@ test-wire:       ## fast deterministic wire protocol lane (framing/codec/resume)
 
 test-chaos:      ## the chaos/fault-injection lane: pod, store, wire, and node tiers
 	$(PY) -m pytest tests/test_chaos.py tests/test_wire_chaos.py tests/test_node_lifecycle.py -q
+
+test-fleet:      ## the fleet introspection lane: invariant rules, /fleet, top, event dedup
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py -q
 
 lint:            ## project code lint: AST discipline rules + ruff (if present)
 	$(PY) -m training_operator_tpu.analysis.codelint training_operator_tpu
@@ -82,6 +85,13 @@ bench-wire-resume:  ## watch-resume reconnect-cost block (one JSON line)
 # instrumentation must stay under 5% to be left enabled in production.
 bench-observe:   ## observability-overhead block (one JSON line)
 	JAX_PLATFORMS=cpu $(PY) bench.py --observe-only
+
+# Invariant auditor on vs off over the same 120-job gang burst (the
+# BENCH_SELF_OBSERVE method): direct self-timed audit share decides the
+# <2% budget; the burst itself runs with the auditor fail-fast, so a single
+# violation fails the lane.
+bench-audit:     ## auditor-overhead block (one JSON line + BENCH_SELF_AUDIT artifact)
+	JAX_PLATFORMS=cpu $(PY) bench.py --audit-only
 
 # Kill one host of a whole-slice TPU gang on a virtual clock and measure
 # node-loss MTTR: detect (grace) -> evict (toleration) -> gang re-solve ->

@@ -376,8 +376,15 @@ def granular_oracle(
     return {"p50_s": round(_pct(starts, 0.50), 3), "p90_s": round(_pct(starts, 0.90), 3), "p99_s": round(_pct(starts, 0.99), 3)}
 
 
+# Module default for run_burst's fail-fast auditor (set by --audit): every
+# headline burst then runs under the standing invariant checker, and one
+# violation anywhere fails the whole bench run.
+AUDIT_BURSTS = False
+AUDIT_INTERVAL_S = 15.0
+
+
 def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nodes=CPU_NODES,
-              return_latencies=False, chrome_trace=None):
+              return_latencies=False, chrome_trace=None, audit=None):
     cluster = Cluster(VirtualClock())
     cluster.add_nodes(make_tpu_pool(tpu_slices, slice_topology=SLICE_TOPOLOGY))
     cluster.add_nodes(make_gpu_pool(gpu_nodes, gpus_per_node=GPUS_PER_NODE, nodes_per_nvlink_domain=4))
@@ -389,6 +396,22 @@ def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nod
     )
     mgr = OperatorManager(cluster, gang_enabled=True, reconciles_per_tick=4096)
     register_all(mgr)
+    auditor = None
+    audit_enabled = AUDIT_BURSTS if audit is None else audit
+    if audit_enabled:
+        # Standing invariant checker in fail-fast mode: the rule catalog
+        # audits the live store every AUDIT_INTERVAL_S of virtual time and
+        # a single violation raises out of the tick — the burst becomes an
+        # invariant regression test, not just a latency measurement.
+        from training_operator_tpu.observe import FleetSources, InvariantAuditor
+
+        auditor = InvariantAuditor(
+            cluster.api,
+            cluster.clock.now,
+            sources=FleetSources(expectations=mgr.unfulfilled_expectations),
+            interval=AUDIT_INTERVAL_S,
+            fail_fast=True,
+        ).attach(cluster)
 
     jobs = [make_job(s) for s in specs]
     t_wall = time.perf_counter()
@@ -464,6 +487,10 @@ def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nod
     wall = time.perf_counter() - t_wall
     if not ok:
         raise RuntimeError(f"burst did not finish: {len(jobs) - len(finished)} jobs pending")
+    if auditor is not None:
+        # Closing audit at quiescence: the converged fleet must be clean
+        # too (orphans/wedged expectations would survive the burst).
+        auditor.audit()
 
     latencies = []
     by_name = {} if return_latencies else None
@@ -500,6 +527,12 @@ def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nod
         "bench_wall_s": round(wall, 1),
         "jobs_measured": len(latencies),
     }
+    if auditor is not None:
+        out["audit"] = {
+            "audits": auditor.audits,
+            "violations": len(auditor.last_violations),
+            "fail_fast": True,
+        }
     if return_latencies:
         # Diagnostic-only (never serialized into the headline JSON): the
         # per-job latencies behind the percentiles, for tail analysis.
@@ -1130,6 +1163,85 @@ def run_observe_overhead(n_jobs: int = 120, pairs: int = 5, seed: int = 11,
     return out
 
 
+def run_audit_overhead(n_jobs: int = 120, pairs: int = 5, seed: int = 11):
+    """The `audit` bench block (BENCH_SELF_OBSERVE method, applied to the
+    standing invariant auditor): the SAME 120-job gang burst with the
+    fail-fast auditor off vs on, overhead reported two ways —
+
+    - direct: every `InvariantAuditor.audit` call self-timed during one
+      audited burst; `overhead_pct` is that time as a share of the burst
+      wall. Deterministic and conservative (probe cost charged to the
+      auditor). This is the number the <2% acceptance budget reads.
+    - wall pairs: alternating off/on pairs, median per-pair ratio with
+      spread, as end-to-end corroboration (burst wall on a shared box
+      swings more than the true cost).
+
+    The audited legs run fail-fast, so the block doubles as the invariant
+    regression gate: any violation in any audited burst fails the bench."""
+    from training_operator_tpu.observe import invariants as _inv
+
+    specs = build_workload(n_jobs, seed)
+
+    def leg(audit):
+        t0 = time.perf_counter()
+        out = run_burst(specs, TPUPacker(), audit=audit)
+        return time.perf_counter() - t0, out
+
+    leg(True)  # warmup: codec + placer compiles land outside the measurement
+
+    counters = {"calls": 0, "time": 0.0}
+    orig_audit = _inv.InvariantAuditor.audit
+
+    def probe(self):
+        t0 = time.perf_counter()
+        try:
+            return orig_audit(self)
+        finally:
+            counters["calls"] += 1
+            counters["time"] += time.perf_counter() - t0
+
+    _inv.InvariantAuditor.audit = probe
+    try:
+        direct_wall, audited = leg(True)
+    finally:
+        _inv.InvariantAuditor.audit = orig_audit
+    direct_share = counters["time"] / direct_wall if direct_wall > 0 else 0.0
+
+    off, on, ratios = [], [], []
+    for i in range(max(1, pairs)):
+        if i % 2 == 0:
+            d, _ = leg(False)
+            e, _ = leg(True)
+        else:
+            e, _ = leg(True)
+            d, _ = leg(False)
+        off.append(d)
+        on.append(e)
+        ratios.append(e / d if d > 0 else 1.0)
+    ratios.sort()
+    return {
+        "jobs": n_jobs,
+        "pairs": pairs,
+        "audit_interval_s": AUDIT_INTERVAL_S,
+        "direct": {
+            "audit_calls": counters["calls"],
+            "audit_time_s": round(counters["time"], 4),
+            "burst_wall_s": round(direct_wall, 3),
+            "share_pct": round(100 * direct_share, 3),
+        },
+        "wall_pairs": {
+            "disabled_wall_s": [round(v, 3) for v in off],
+            "enabled_wall_s": [round(v, 3) for v in on],
+            "pair_ratios": [round(r, 4) for r in ratios],  # sorted above
+            "median_pair_ratio": round(ratios[len(ratios) // 2], 4),
+        },
+        "burst_audit": audited.get("audit"),
+        "violations": (audited.get("audit") or {}).get("violations", 0),
+        "overhead_pct": round(100 * direct_share, 3),
+        "under_2pct": direct_share < 0.02,
+    }
+
+
 # ---------------------------------------------------------------------------
 # Node-loss MTTR: kill one host of a whole-slice TPU gang and measure the
 # recovery pipeline (detect -> evict -> re-solve -> Running again). The
@@ -1319,6 +1431,18 @@ def main():
                          "NotReady + unreachable taint")
     ap.add_argument("--node-toleration-seconds", type=float, default=30.0,
                     help="node-chaos block: taint age before eviction")
+    ap.add_argument("--audit", action="store_true",
+                    help="run every burst under the standing invariant "
+                         "auditor in fail-fast mode (observe/invariants.py): "
+                         "one INV violation anywhere fails the bench")
+    ap.add_argument("--audit-only", action="store_true",
+                    help="run only the auditor-overhead block (on/off over "
+                         "the same 120-job burst, BENCH_SELF_OBSERVE "
+                         "method) and write --audit-out")
+    ap.add_argument("--audit-jobs", type=int, default=120,
+                    help="burst size for the audit-overhead block")
+    ap.add_argument("--audit-out", default="BENCH_SELF_AUDIT_r10.json",
+                    help="artifact path for --audit-only")
     ap.add_argument("--no-observe", action="store_true",
                     help="skip the observability-overhead block")
     ap.add_argument("--observe-only", action="store_true",
@@ -1336,6 +1460,25 @@ def main():
                                help="run only the trainer compute benchmark")
     args = ap.parse_args()
     n = 100 if args.quick else args.jobs
+    if args.audit:
+        global AUDIT_BURSTS
+        AUDIT_BURSTS = True
+
+    if args.audit_only:
+        block = run_audit_overhead(args.audit_jobs)
+        doc = {
+            "metric": "audit_overhead_pct",
+            "value": block["overhead_pct"],
+            "unit": "% of burst wall spent in InvariantAuditor.audit "
+                    "(direct self-timed share; wall_pairs = on/off "
+                    "corroboration with spread)",
+            "vs_baseline": None,
+            "audit": block,
+        }
+        print(json.dumps(doc))
+        with open(args.audit_out, "w") as f:
+            json.dump(doc, f, indent=1)
+        return
 
     if args.wire_ab:
         if not args.before_repo:

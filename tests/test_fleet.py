@@ -1,0 +1,845 @@
+"""Fleet introspection plane (PR 7): the standing invariant auditor
+(INV001-INV006, table-driven per rule), the /fleet wire route + its
+version-keyed byte cache, the `top` renderer against a live host, Event
+aggregation, the metric satellite (shared Gauge render, labeled
+histograms), and the four-tier chaos matrix green under a fail-fast
+auditor."""
+
+from __future__ import annotations
+
+import pytest
+
+from training_operator_tpu import observe
+from training_operator_tpu.api import common as capi
+from training_operator_tpu.api.common import (
+    Container,
+    JOB_KIND_LABEL,
+    JOB_NAME_LABEL,
+    JobConditionType,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RestartPolicy,
+    update_job_conditions,
+)
+from training_operator_tpu.api.jobs import JAXJob, ObjectMeta, TPUPolicy
+from training_operator_tpu.cluster.inventory import (
+    TPU_RESOURCE,
+    make_cpu_pool,
+    make_tpu_pool,
+)
+from training_operator_tpu.cluster.objects import (
+    Event,
+    Pod,
+    PodGroup,
+    PodGroupPhase,
+    PodPhase,
+    set_node_condition,
+)
+from training_operator_tpu.cluster.runtime import (
+    ANNOTATION_SIM_DURATION,
+    Cluster,
+    DefaultScheduler,
+    SimKubelet,
+    VirtualClock,
+)
+from training_operator_tpu.controllers import OperatorManager, register_all
+from training_operator_tpu.observe.invariants import (
+    FleetSources,
+    InvariantAuditor,
+    InvariantViolationError,
+    RULES,
+)
+from training_operator_tpu.utils import metrics
+
+AUDIT_INTERVAL = 10.0
+
+
+def make_cluster(tpu_slices: int = 2):
+    cluster = Cluster(VirtualClock())
+    if tpu_slices:
+        cluster.add_nodes(make_tpu_pool(tpu_slices, slice_topology="4x4"))
+    cluster.add_nodes(make_cpu_pool(2))
+    return cluster
+
+
+def make_auditor(cluster, sources=None, toleration=30.0, **kw):
+    return InvariantAuditor(
+        cluster.api, cluster.clock.now, sources=sources,
+        interval=AUDIT_INTERVAL, toleration_seconds=toleration, **kw,
+    )
+
+
+def detect(cluster, auditor, grace):
+    """One audit to open the grace window, advance past it, audit again —
+    'detected within one audit interval' once the transient window has
+    provably passed."""
+    first = auditor.audit()
+    cluster.clock.advance(grace + 0.001)
+    return first, auditor.audit()
+
+
+def orphan_pod(api, name="orphan", kind="JAXJob", job="ghost"):
+    return api.create(Pod(metadata=ObjectMeta(
+        name=name, namespace="default",
+        labels={JOB_KIND_LABEL: kind, JOB_NAME_LABEL: job},
+    )))
+
+
+def rule_by_id(rule_id):
+    return next(r for r in RULES if r.rule_id == rule_id)
+
+
+# ---------------------------------------------------------------------------
+# Rule catalog, table-driven per INV id
+# ---------------------------------------------------------------------------
+
+
+class TestInvariantRules:
+    def test_catalog_is_complete_and_unique(self):
+        ids = [r.rule_id for r in RULES]
+        assert ids == sorted(set(ids))
+        assert ids == [f"INV00{i}" for i in range(1, 7)]
+
+    def test_inv001_orphaned_pod(self):
+        cluster = make_cluster(tpu_slices=0)
+        auditor = make_auditor(cluster)
+        orphan_pod(cluster.api)
+        first, second = detect(cluster, auditor, rule_by_id("INV001").grace)
+        assert first == [], "grace must absorb the cascade-GC window"
+        assert [v.rule for v in second] == ["INV001"]
+        assert second[0].name == "orphan"
+
+    def test_inv001_owned_pod_is_clean(self):
+        cluster = make_cluster(tpu_slices=0)
+        auditor = make_auditor(cluster)
+        cluster.api.create(JAXJob(
+            metadata=ObjectMeta(name="alive"),
+            replica_specs={"Worker": ReplicaSpec(
+                replicas=1, template=PodTemplateSpec(
+                    containers=[Container(name="jax")]
+                ),
+            )},
+        ))
+        orphan_pod(cluster.api, name="owned", job="alive")
+        _, second = detect(cluster, auditor, rule_by_id("INV001").grace)
+        assert second == []
+
+    @pytest.mark.parametrize("placement,num_slices,expect", [
+        # Gang split across two failure domains while asking for one slice.
+        ({"p0": "slice-0-host-0", "p1": "slice-1-host-0"}, 1, "failure domains"),
+        # Hosts 0 and 2 of one slice: a hole in the ICI mesh.
+        ({"p0": "slice-0-host-0", "p1": "slice-0-host-2"}, 1, "ICI-contiguous"),
+        # A recorded placement onto a node that no longer exists.
+        ({"p0": "slice-0-host-0", "p1": "gone-host"}, 1, "no longer exists"),
+    ])
+    def test_inv002_broken_placement(self, placement, num_slices, expect):
+        cluster = make_cluster()
+        auditor = make_auditor(cluster)
+        pg = PodGroup(
+            metadata=ObjectMeta(name="gang", namespace="default"),
+            min_member=len(placement),
+            topology_request="2x4",
+            num_slices=num_slices,
+            phase=PodGroupPhase.INQUEUE,
+            placement=dict(placement),
+        )
+        cluster.api.create(pg)
+        _, second = detect(cluster, auditor, rule_by_id("INV002").grace)
+        assert [v.rule for v in second] == ["INV002"], second
+        assert expect in second[0].message
+
+    def test_inv002_contiguous_single_slice_is_clean(self):
+        cluster = make_cluster()
+        auditor = make_auditor(cluster)
+        cluster.api.create(PodGroup(
+            metadata=ObjectMeta(name="gang", namespace="default"),
+            min_member=2,
+            topology_request="2x4",
+            num_slices=1,
+            phase=PodGroupPhase.INQUEUE,
+            placement={"p0": "slice-0-host-1", "p1": "slice-0-host-2"},
+        ))
+        _, second = detect(cluster, auditor, rule_by_id("INV002").grace)
+        assert second == []
+
+    def test_inv003_running_pod_on_dead_node(self):
+        cluster = make_cluster()
+        toleration = 30.0
+        auditor = make_auditor(cluster, toleration=toleration)
+        node = cluster.api.get("Node", "", "slice-0-host-0")
+        set_node_condition(node, "Ready", "Unknown", "NodeStatusUnknown",
+                           "heartbeat lapsed", cluster.clock.now())
+        cluster.api.update(node, check_version=False)
+        pod = Pod(metadata=ObjectMeta(name="stale", namespace="default"))
+        pod.node_name = "slice-0-host-0"
+        pod.status.phase = PodPhase.RUNNING
+        cluster.api.create(pod)
+        # Within the toleration: not even a candidate.
+        cluster.clock.advance(toleration / 2)
+        assert auditor.audit() == []
+        # Past toleration the candidate opens; past the grace it reports.
+        cluster.clock.advance(toleration)
+        _, second = detect(cluster, auditor, rule_by_id("INV003").grace)
+        assert [v.rule for v in second] == ["INV003"]
+        assert "NotReady" in second[0].message
+
+    def test_inv003_vanished_node(self):
+        cluster = make_cluster(tpu_slices=0)
+        auditor = make_auditor(cluster)
+        pod = Pod(metadata=ObjectMeta(name="lost", namespace="default"))
+        pod.node_name = "never-existed"
+        pod.status.phase = PodPhase.RUNNING
+        cluster.api.create(pod)
+        _, second = detect(cluster, auditor, rule_by_id("INV003").grace)
+        assert [v.rule for v in second] == ["INV003"]
+        assert "vanished" in second[0].message
+
+    def test_inv004_wedged_expectation(self):
+        cluster = make_cluster(tpu_slices=0)
+        ages = {"JAXJob|default/j/worker/pods": 400.0}
+        auditor = make_auditor(
+            cluster, sources=FleetSources(expectations=lambda: dict(ages))
+        )
+        # grace 0: the 5-minute TTL in the age check IS the grace.
+        out = auditor.audit()
+        assert [v.rule for v in out] == ["INV004"]
+        # A young expectation is normal informer asynchrony.
+        ages = {"JAXJob|default/j/worker/pods": 5.0}
+        assert auditor.audit() == []
+
+    def test_inv004_live_manager_feed(self):
+        """The real provider chain: a raised-but-never-observed expectation
+        in a live manager trips INV004 once it ages past the TTL."""
+        from training_operator_tpu.engine.expectations import (
+            EXPECTATION_TIMEOUT_SECONDS,
+        )
+
+        cluster = make_cluster(tpu_slices=0)
+        mgr = OperatorManager(cluster, resync_period=None)
+        register_all(mgr)
+        _, jc = mgr.controllers["JAXJob"]
+        jc.expectations.raise_expectations("default/wedged/worker/pods", 1, 0)
+        auditor = make_auditor(
+            cluster,
+            sources=FleetSources(expectations=mgr.unfulfilled_expectations),
+        )
+        assert auditor.audit() == []
+        cluster.clock.advance(EXPECTATION_TIMEOUT_SECONDS + 1)
+        out = auditor.audit()
+        assert [v.rule for v in out] == ["INV004"]
+        assert "default/wedged" in out[0].name
+
+    def test_inv005_journal_and_ring_bounds(self):
+        cluster = make_cluster(tpu_slices=0)
+        state = {"bytes": 10, "ring": {"Pod": (4, 8192)}}
+        auditor = make_auditor(cluster, sources=FleetSources(
+            journal_bytes=lambda: state["bytes"],
+            journal_bound=lambda: 64,
+            resume_ring=lambda: dict(state["ring"]),
+        ))
+        _, clean = detect(cluster, auditor, rule_by_id("INV005").grace)
+        assert clean == []
+        state["bytes"] = 1024  # compaction wedged
+        state["ring"] = {"Pod": (9000, 8192)}  # ring over its bound
+        _, second = detect(cluster, auditor, rule_by_id("INV005").grace)
+        assert sorted(v.name for v in second) == ["Pod", "journal"]
+        assert all(v.rule == "INV005" for v in second)
+
+    def test_inv006_condition_disagreement(self):
+        from training_operator_tpu.runtime.api import (
+            TrainJob,
+            TrainJobConditionType,
+        )
+
+        cluster = make_cluster(tpu_slices=0)
+        auditor = make_auditor(cluster)
+        tj = TrainJob(metadata=ObjectMeta(name="split", namespace="default"))
+        tj.set_condition(TrainJobConditionType.COMPLETE, True,
+                         "JobsSucceeded", "done", now=1.0)
+        cluster.api.create(tj)
+        wj = JAXJob(
+            metadata=ObjectMeta(name="split", namespace="default"),
+            replica_specs={"Worker": ReplicaSpec(
+                replicas=1,
+                template=PodTemplateSpec(containers=[Container(name="jax")]),
+            )},
+        )
+        update_job_conditions(wj.status, JobConditionType.FAILED, True,
+                              "JobFailed", "boom", now=1.0)
+        cluster.api.create(wj)
+        _, second = detect(cluster, auditor, rule_by_id("INV006").grace)
+        assert [v.rule for v in second] == ["INV006"]
+        assert second[0].object_kind == "TrainJob"
+
+    def test_healed_candidate_never_reports(self):
+        cluster = make_cluster(tpu_slices=0)
+        auditor = make_auditor(cluster)
+        orphan_pod(cluster.api)
+        before = metrics.invariant_violations.value("INV001")
+        assert auditor.audit() == []
+        cluster.api.delete("Pod", "default", "orphan")  # healed in time
+        cluster.clock.advance(rule_by_id("INV001").grace + 1)
+        assert auditor.audit() == []
+        assert metrics.invariant_violations.value("INV001") == before
+
+    def test_report_side_effects_once_per_incident(self):
+        cluster = make_cluster(tpu_slices=0)
+        auditor = make_auditor(cluster)
+        orphan_pod(cluster.api)
+        before = metrics.invariant_violations.value("INV001")
+        _, second = detect(cluster, auditor, rule_by_id("INV001").grace)
+        assert second
+        # Persisting violation: stays active, but counts ONE incident.
+        cluster.clock.advance(AUDIT_INTERVAL)
+        third = auditor.audit()
+        assert [v.rule for v in third] == ["INV001"]
+        assert metrics.invariant_violations.value("INV001") == before + 1
+        events = cluster.api.events(object_name="orphan", reason="INV001")
+        assert len(events) == 1 and events[0].event_type == "Warning"
+        assert metrics.fleet_violations.value() == 1.0
+        # Healing zeroes the active gauge.
+        cluster.api.delete("Pod", "default", "orphan")
+        assert auditor.audit() == []
+        assert metrics.fleet_violations.value() == 0.0
+
+    def test_fail_fast_raises(self):
+        cluster = make_cluster(tpu_slices=0)
+        auditor = make_auditor(cluster, fail_fast=True)
+        orphan_pod(cluster.api)
+        auditor.audit()
+        cluster.clock.advance(rule_by_id("INV001").grace + 1)
+        with pytest.raises(InvariantViolationError, match="INV001"):
+            auditor.audit()
+
+    def test_attached_auditor_runs_on_the_virtual_clock(self):
+        cluster = make_cluster(tpu_slices=0)
+        auditor = make_auditor(cluster).attach(cluster)
+        cluster.run_for(AUDIT_INTERVAL * 3 + 1)
+        assert auditor.audits >= 3
+        auditor.detach()
+
+    def test_inv002_span_lands_on_the_gang_timeline(self):
+        cluster = make_cluster()
+        auditor = make_auditor(cluster)
+        cluster.api.create(PodGroup(
+            metadata=ObjectMeta(name="gang", namespace="default"),
+            min_member=2, topology_request="2x4", num_slices=1,
+            phase=PodGroupPhase.INQUEUE,
+            placement={"p0": "slice-0-host-0", "p1": "slice-1-host-0"},
+        ))
+        detect(cluster, auditor, rule_by_id("INV002").grace)
+        tl = cluster.api.get_timeline("default", "gang")
+        assert tl is not None
+        spans = [s for s in tl["spans"] if s["name"] == "invariant"]
+        assert spans and spans[0]["attrs"]["rule"] == "INV002"
+
+
+# ---------------------------------------------------------------------------
+# A clean, fully-converged stack audits clean over time
+# ---------------------------------------------------------------------------
+
+
+class TestCleanFleetAuditsClean:
+    def test_gang_burst_stays_audit_clean(self):
+        from training_operator_tpu.scheduler import GangScheduler, TPUPacker
+
+        cluster = make_cluster()
+        DefaultScheduler(cluster)
+        SimKubelet(cluster)
+        GangScheduler(cluster, TPUPacker())
+        mgr = OperatorManager(cluster, gang_enabled=True)
+        register_all(mgr)
+        auditor = make_auditor(
+            cluster,
+            sources=FleetSources(expectations=mgr.unfulfilled_expectations),
+            fail_fast=True,
+        ).attach(cluster)
+        tmpl = PodTemplateSpec(
+            containers=[Container(name="jax", image="img",
+                                  resources={"cpu": 1.0, TPU_RESOURCE: 4.0})],
+            annotations={ANNOTATION_SIM_DURATION: "5"},
+        )
+        jobs = []
+        for i in range(3):
+            jobs.append(mgr.submit(JAXJob(
+                metadata=ObjectMeta(name=f"clean-{i}"),
+                replica_specs={"Worker": ReplicaSpec(
+                    replicas=2, template=tmpl.copy(),
+                    restart_policy=RestartPolicy.EXIT_CODE,
+                )},
+                tpu_policy=TPUPolicy(accelerator="v5e-8", topology="2x4"),
+            )))
+
+        def all_done():
+            return all(
+                (j := cluster.live(job)) is not None
+                and capi.is_succeeded(j.status)
+                for job in jobs
+            )
+
+        # A fail-fast auditor is ticking throughout: any violation raises
+        # out of run_until and fails this test.
+        assert cluster.run_until(all_done, timeout=600)
+        cluster.run_for(AUDIT_INTERVAL * 6)  # post-convergence soak
+        assert auditor.audits >= 5
+        assert auditor.last_violations == []
+
+
+# ---------------------------------------------------------------------------
+# Fleet snapshot + gauges
+# ---------------------------------------------------------------------------
+
+
+class TestFleetSnapshot:
+    def test_collect_counts_nodes_slices_chips(self):
+        cluster = make_cluster(tpu_slices=2)
+        pod = Pod(metadata=ObjectMeta(name="busy", namespace="default"))
+        pod.spec.containers = [Container(name="c", resources={TPU_RESOURCE: 4.0})]
+        pod.node_name = "slice-0-host-0"
+        pod.status.phase = PodPhase.RUNNING
+        cluster.api.create(pod)
+        fleet = observe.collect_fleet(cluster.api, cluster.clock.now())
+        assert fleet["nodes"]["total"] == 10  # 8 TPU hosts + 2 CPU
+        assert fleet["chips"] == {"total": 32.0, "used": 4.0}
+        s0 = next(s for s in fleet["slices"] if s["slice"] == "slice-0")
+        assert s0["free_hosts"] == 3 and s0["chips_used"] == 4.0
+        assert fleet["whole_free_slices"] == 1
+        assert fleet["objects"]["Node"] == 10
+        assert fleet["free_tpu_hosts"] == 7
+
+    def test_job_states_by_kind(self):
+        cluster = make_cluster(tpu_slices=0)
+        tmpl = PodTemplateSpec(containers=[Container(name="jax")])
+        run = JAXJob(metadata=ObjectMeta(name="r"),
+                     replica_specs={"Worker": ReplicaSpec(replicas=1, template=tmpl)})
+        update_job_conditions(run.status, JobConditionType.RUNNING, True,
+                              "JobRunning", "", now=1.0)
+        done = JAXJob(metadata=ObjectMeta(name="d"),
+                      replica_specs={"Worker": ReplicaSpec(replicas=1, template=tmpl)})
+        update_job_conditions(done.status, JobConditionType.SUCCEEDED, True,
+                              "JobSucceeded", "", now=2.0)
+        pend = JAXJob(metadata=ObjectMeta(name="p"),
+                      replica_specs={"Worker": ReplicaSpec(replicas=1, template=tmpl)})
+        for j in (run, done, pend):
+            cluster.api.create(j)
+        fleet = observe.collect_fleet(cluster.api, cluster.clock.now())
+        assert fleet["jobs"]["JAXJob"] == {
+            "running": 1, "succeeded": 1, "pending": 1,
+        }
+
+    def test_collector_publishes_gauges(self):
+        cluster = make_cluster(tpu_slices=1)
+        collector = observe.FleetCollector(cluster, interval=AUDIT_INTERVAL)
+        collector.collect()
+        assert metrics.fleet_chips_total.value() == 16.0
+        assert metrics.fleet_nodes.value("ready") == 6.0  # 4 TPU + 2 CPU
+        assert metrics.fleet_objects.value("Node") == 6.0
+        collector.stop()
+
+    def test_emptied_gauge_buckets_are_zeroed(self):
+        """A label bucket that empties (every Pending gang admitted, a job
+        population drained) must read 0 on the next publish, not hold its
+        last value — a phantom pending-gang gauge would tell an autoscaler
+        there is work forever."""
+        cluster = make_cluster(tpu_slices=0)
+        collector = observe.FleetCollector(cluster, interval=AUDIT_INTERVAL)
+        pg = PodGroup(metadata=ObjectMeta(name="g", namespace="default"),
+                      phase=PodGroupPhase.PENDING)
+        cluster.api.create(pg)
+        collector.collect()
+        assert metrics.fleet_podgroups.value("Pending") == 1.0
+        assert metrics.fleet_objects.value("PodGroup") == 1.0
+        live = cluster.api.get("PodGroup", "default", "g")
+        live.phase = PodGroupPhase.RUNNING
+        cluster.api.update(live, check_version=False)
+        collector.collect()
+        assert metrics.fleet_podgroups.value("Pending") == 0.0
+        assert metrics.fleet_podgroups.value("Running") == 1.0
+        cluster.api.delete("PodGroup", "default", "g")
+        collector.collect()
+        assert metrics.fleet_podgroups.value("Running") == 0.0
+        assert metrics.fleet_objects.value("PodGroup") == 0.0
+        collector.stop()
+
+    def test_collector_ticks_on_the_clock(self):
+        cluster = make_cluster(tpu_slices=0)
+        collector = observe.FleetCollector(cluster, interval=AUDIT_INTERVAL)
+        assert collector.last is None
+        cluster.run_for(AUDIT_INTERVAL + 1)
+        assert collector.last is not None
+        collector.stop()
+
+
+# ---------------------------------------------------------------------------
+# /fleet over the wire + its version-keyed cache, and `top`
+# ---------------------------------------------------------------------------
+
+
+class TestFleetWire:
+    @pytest.fixture()
+    def served(self):
+        from training_operator_tpu.cluster.httpapi import (
+            ApiHTTPServer,
+            RemoteAPIServer,
+        )
+
+        cluster = Cluster()
+        cluster.add_nodes(make_tpu_pool(1, slice_topology="2x4"))
+        server = ApiHTTPServer(cluster.api, port=0)
+        remote = RemoteAPIServer(server.url, timeout=10.0)
+        try:
+            yield cluster, server, remote
+        finally:
+            server.close()
+
+    def test_fleet_round_trips(self, served):
+        cluster, server, remote = served
+        fleet = remote.get_fleet()
+        assert fleet["nodes"]["total"] == 2
+        assert fleet["chips"]["total"] == 8.0
+        assert [s["slice"] for s in fleet["slices"]] == ["slice-0"]
+        # The server contributed its own occupancy sources.
+        assert "watch_sessions" in fleet["store"]
+        assert "resume_ring_events" in fleet["store"]
+        assert fleet["violations"] == []
+
+    def test_fleet_cache_hits_until_a_write(self, served):
+        cluster, server, remote = served
+        hits0 = metrics.wire_fleet_cache_hits.total()
+        misses0 = metrics.wire_fleet_cache_misses.total()
+        remote.get_fleet()
+        remote.get_fleet()
+        remote.get_fleet()
+        assert metrics.wire_fleet_cache_misses.total() - misses0 == 1
+        assert metrics.wire_fleet_cache_hits.total() - hits0 == 2
+        # Any store write moves the version and invalidates the snapshot.
+        orphan_pod(cluster.api, name="inval")
+        remote.get_fleet()
+        assert metrics.wire_fleet_cache_misses.total() - misses0 == 2
+
+    def test_fleet_cache_is_age_bounded(self, served):
+        """Out-of-store feeds (sessions, journal bytes, the snapshot's own
+        clock) change without a store write; with the auditor disabled the
+        audit seq never moves either — validity must be age-bounded or a
+        quiet store serves a frozen snapshot forever."""
+        import time as _t
+
+        cluster, server, remote = served
+        server.fleet_cache_max_age = 0.05
+        misses0 = metrics.wire_fleet_cache_misses.total()
+        t1 = remote.get_fleet()["t"]
+        _t.sleep(0.1)
+        t2 = remote.get_fleet()["t"]  # no store write in between
+        assert metrics.wire_fleet_cache_misses.total() - misses0 == 2
+        assert t2 > t1
+
+    def test_violations_ride_fleet_and_invalidate_cache(self, served):
+        cluster, server, remote = served
+        auditor = InvariantAuditor(
+            cluster.api, cluster.clock.now, interval=1.0,
+        )
+        server.auditor = auditor
+        orphan_pod(cluster.api)
+        assert remote.get_fleet()["violations"] == []
+        auditor.audit()
+        # Force the grace window shut deterministically (real clock here):
+        # backdate the candidate's first-seen stamp.
+        for key in auditor._first_seen:
+            auditor._first_seen[key] -= rule_by_id("INV001").grace + 1
+        auditor.audit()  # seq moved -> cached bytes invalid
+        fleet = remote.get_fleet()
+        assert [v["rule"] for v in fleet["violations"]] == ["INV001"]
+
+    def test_top_cli_renders_live_host(self, served, capsys):
+        from training_operator_tpu.__main__ import main
+
+        cluster, server, remote = served
+        rc = main(["top", "--api-server", server.url])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "fleet @" in out
+        assert "slice-0" in out
+        assert "violations: none" in out
+
+    def test_render_top_shows_violations(self):
+        fleet = observe.collect_fleet(Cluster(VirtualClock()).api, 0.0)
+        fleet["violations"] = [{
+            "rule": "INV003", "object_kind": "Pod", "namespace": "default",
+            "name": "stale", "message": "RUNNING on NotReady node", "since": 1.0,
+        }]
+        text = observe.render_top(fleet)
+        assert "1 ACTIVE" in text and "INV003" in text and "default/stale" in text
+
+
+# ---------------------------------------------------------------------------
+# Event aggregation (k8s parity)
+# ---------------------------------------------------------------------------
+
+
+def ev(reason="Backoff", message="restarting", ts=1.0):
+    return Event(object_kind="Pod", object_name="p0", namespace="default",
+                 event_type="Warning", reason=reason, message=message,
+                 timestamp=ts)
+
+
+class TestEventAggregation:
+    def test_identical_events_aggregate(self):
+        cluster = make_cluster(tpu_slices=0)
+        for ts in (1.0, 2.0, 3.0):
+            cluster.api.record_event(ev(ts=ts))
+        out = cluster.api.events(object_name="p0")
+        assert len(out) == 1
+        assert out[0].count == 3
+        assert out[0].first_timestamp == 1.0
+        assert out[0].timestamp == 3.0
+
+    def test_distinct_messages_stay_distinct(self):
+        cluster = make_cluster(tpu_slices=0)
+        cluster.api.record_event(ev(message="exit 137"))
+        cluster.api.record_event(ev(message="exit 1"))
+        cluster.api.record_event(ev(reason="Started", message="exit 137"))
+        out = cluster.api.events(object_name="p0")
+        assert len(out) == 3
+        assert all(e.count == 1 for e in out)
+
+    def test_journal_replay_preserves_counts(self, tmp_path):
+        from training_operator_tpu.cluster.apiserver import APIServer
+        from training_operator_tpu.cluster.store import HostStore
+
+        api = APIServer()
+        store = HostStore(str(tmp_path))
+        store.attach(api)
+        for ts in (1.0, 2.0, 3.0):
+            api.record_event(ev(ts=ts))
+        store.close()
+
+        api2 = APIServer()
+        store2 = HostStore(str(tmp_path))
+        store2.load_into(api2)
+        out = api2.events(object_name="p0")
+        assert len(out) == 1 and out[0].count == 3
+        assert out[0].first_timestamp == 1.0 and out[0].timestamp == 3.0
+        store2.close()
+
+    def test_describe_shows_aggregated_count(self):
+        cluster = make_cluster(tpu_slices=0)
+        cluster.api.create(JAXJob(
+            metadata=ObjectMeta(name="noisy"),
+            replica_specs={"Worker": ReplicaSpec(
+                replicas=1,
+                template=PodTemplateSpec(containers=[Container(name="jax")]),
+            )},
+        ))
+        for ts in (1.0, 2.0, 3.0):
+            cluster.api.record_event(Event(
+                object_kind="JAXJob", object_name="noisy", namespace="default",
+                event_type="Warning", reason="Flapping", message="again",
+                timestamp=ts,
+            ))
+        text = observe.render_describe(cluster.api, "default", "noisy")
+        assert "Flapping" in text and "(x3)" in text
+
+
+# ---------------------------------------------------------------------------
+# Metric satellite: shared Gauge render + labeled histograms
+# ---------------------------------------------------------------------------
+
+
+class TestMetricSatellite:
+    def test_gauge_text_and_json_share_one_view(self):
+        from training_operator_tpu.utils.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        g = reg.gauge("g_demo", "demo", labels=("state",))
+        g.set("ready", value=3.0)
+        text = reg.render()
+        assert "# TYPE g_demo gauge" in text
+        assert 'g_demo{state="ready"} 3.0' in text
+        assert reg.snapshot()['g_demo{state="ready"}'] == 3.0
+
+    def test_gauge_render_is_the_shared_counter_renderer(self):
+        from training_operator_tpu.utils.metrics import Counter, Gauge
+
+        # The ONLY difference is the TYPE line (satellite: dedup'd render).
+        assert Gauge.render is Counter.render
+        assert Gauge.METRIC_TYPE == "gauge" and Counter.METRIC_TYPE == "counter"
+
+    def test_labeled_histogram_exposition(self):
+        from training_operator_tpu.utils.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        h = reg.histogram("h_demo", "demo", buckets=(0.1, 1.0),
+                          labels=("kind",))
+        h.observe(0.05, "JAXJob")
+        h.observe(0.5, "JAXJob")
+        h.observe(2.0, "TFJob")
+        snap = reg.snapshot()
+        assert snap['h_demo_bucket{kind="JAXJob",le="0.1"}'] == 1.0
+        assert snap['h_demo_bucket{kind="JAXJob",le="+Inf"}'] == 2.0
+        assert snap['h_demo_count{kind="JAXJob"}'] == 2.0
+        assert snap['h_demo_sum{kind="TFJob"}'] == 2.0
+        text = reg.render()
+        assert "# TYPE h_demo histogram" in text
+        # One view: every rendered sample is the snapshot's number.
+        for line in text.splitlines():
+            if line.startswith("h_demo"):
+                key, val = line.rsplit(" ", 1)
+                assert snap[key] == float(val)
+
+    def test_labeled_histogram_registry_guard(self):
+        from training_operator_tpu.utils.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.histogram("h_guard", "demo", labels=("kind",))
+        assert reg.histogram("h_guard", labels=("kind",)) is not None
+        with pytest.raises(ValueError):
+            reg.histogram("h_guard", labels=("other",))
+        with pytest.raises(ValueError):
+            reg.histogram("h_guard")  # plain histogram under the same name
+
+    def test_reconcile_duration_by_kind_observed(self):
+        cluster = make_cluster(tpu_slices=0)
+        mgr = OperatorManager(cluster, resync_period=None)
+        register_all(mgr)
+        before = metrics.reconcile_duration.labels("JAXJob").count
+        mgr.submit(JAXJob(
+            metadata=ObjectMeta(name="timed"),
+            replica_specs={"Worker": ReplicaSpec(
+                replicas=1,
+                template=PodTemplateSpec(containers=[Container(
+                    name="jax", image="img", resources={"cpu": 0.5},
+                )]),
+            )},
+        ))
+        cluster.step()
+        cluster.step()
+        assert metrics.reconcile_duration.labels("JAXJob").count > before
+        snap = metrics.registry.snapshot()
+        assert 'training_reconcile_duration_seconds_count{kind="JAXJob"}' in snap
+
+
+# ---------------------------------------------------------------------------
+# All four chaos tiers at once, under a fail-fast auditor
+# ---------------------------------------------------------------------------
+
+
+class TestChaosMatrixWithAuditor:
+    def test_four_tiers_green_under_fail_fast_audit(self):
+        import logging
+
+        from training_operator_tpu.cluster.chaos import (
+            APIChaos,
+            ChaosMonkey,
+            NodeChaos,
+            WireChaos,
+        )
+        from training_operator_tpu.cluster.httpapi import (
+            ApiHTTPServer,
+            ApiServerError,
+            ApiUnavailableError,
+            RemoteAPIServer,
+            RemoteRuntime,
+        )
+        from training_operator_tpu.controllers.jax import JAXController
+        from training_operator_tpu.controllers.nodelifecycle import (
+            NodeLifecycleController,
+        )
+
+        mgr_log = logging.getLogger("training_operator_tpu.controllers.manager")
+        prev_disabled = mgr_log.disabled
+        mgr_log.disabled = True
+
+        host = Cluster()  # real clock: the wire tier needs real HTTP
+        host.add_nodes(make_cpu_pool(4, cpu_per_node=8.0))
+        DefaultScheduler(host)
+        kubelet = SimKubelet(host, heartbeat_interval=0.2)
+        NodeLifecycleController(host, grace_period=0.8, toleration_seconds=0.3)
+        wire = WireChaos(seed=9, error_rate=0.08, reset_rate=0.03)
+        server = ApiHTTPServer(host.api, port=0, chaos=wire)
+        # The standing auditor in fail-fast mode: any invariant violation
+        # raises out of host.step() and fails this test. Toleration matches
+        # the lifecycle controller's so INV003 measures the same contract.
+        auditor = InvariantAuditor(
+            host.api, host.clock.now, sources=server.fleet_sources,
+            interval=0.5, fail_fast=True, toleration_seconds=0.3,
+        ).attach(host)
+        # Fourth tier: store-level conflict injection on version-checked
+        # writes (the remote operator's status writes see injected 409s and
+        # must heal through the graft arm).
+        api_chaos = APIChaos(host, seed=9, conflict_rate=0.05)
+        try:
+            remote = RemoteAPIServer(server.url, timeout=10.0)
+            runtime = RemoteRuntime(remote, tick_interval=0.0)
+            for _ in range(50):
+                try:
+                    mgr = OperatorManager(runtime, resync_period=2.0)
+                    mgr.register(JAXController(runtime.api))
+                    break
+                except (ApiUnavailableError, ApiServerError):
+                    continue
+            else:
+                raise AssertionError("operator never booted through the storm")
+
+            monkey = ChaosMonkey(host, kubelet, seed=9, interval=0.6, budget=3)
+            nodes = NodeChaos(host, kubelet, seed=9, interval=1.0, budget=1,
+                              recover_after=2.0)
+            jobs = []
+            for i in range(4):
+                tmpl = PodTemplateSpec(
+                    containers=[Container(name="jax", resources={"cpu": 1.0})],
+                    annotations={ANNOTATION_SIM_DURATION: "1.0"},
+                )
+                jobs.append(JAXJob(
+                    metadata=ObjectMeta(name=f"audited-{i}"),
+                    replica_specs={"Worker": ReplicaSpec(
+                        replicas=2, template=tmpl,
+                        restart_policy=RestartPolicy.EXIT_CODE,
+                    )},
+                ))
+            for job in jobs:
+                for _ in range(200):
+                    try:
+                        remote.create(job)
+                        break
+                    except (ApiUnavailableError, ApiServerError):
+                        continue
+                else:
+                    raise AssertionError("create never got through the storm")
+
+            def all_done():
+                return all(
+                    (j := host.api.try_get("JAXJob", "default", f"audited-{i}"))
+                    is not None and capi.is_succeeded(j.status)
+                    for i in range(4)
+                )
+
+            deadline = host.clock.now() + 120.0
+            while host.clock.now() < deadline and not (
+                all_done() and nodes.kills and monkey.kills
+            ):
+                host.step()  # auditor violations raise straight through
+                try:
+                    runtime.step()
+                except (ApiUnavailableError, ApiServerError):
+                    pass
+            assert all_done(), {
+                f"audited-{i}": getattr(
+                    host.api.try_get("JAXJob", "default", f"audited-{i}"),
+                    "status", None,
+                )
+                for i in range(4)
+            }
+            # No vacuous pass: every tier actually struck, and the auditor
+            # actually audited the storm.
+            assert nodes.kills, "NodeChaos never killed a node"
+            assert monkey.kills, "ChaosMonkey never killed a pod"
+            assert sum(wire.injected.values()) > 0, wire.injected
+            assert api_chaos.injected_conflicts > 0
+            assert auditor.audits >= 3  # the auditor lived through the storm
+            assert auditor.last_violations == []
+            mgr.stop()
+        finally:
+            mgr_log.disabled = prev_disabled
+            auditor.detach()
+            api_chaos.stop()
+            server.close()

@@ -424,10 +424,12 @@ class TestFailureEventStream:
             client.wait_for_job_conditions("boom", timeout=60)
         cluster.run_for(1.0)  # let the terminal pass settle
         evs = cluster.api.events(object_name="boom", reason="JobFailed")
-        assert len(evs) == 1, evs
+        # count==1 too: aggregation would fold duplicate emissions into one
+        # record, so the length alone no longer pins "emitted once".
+        assert len(evs) == 1 and evs[0].count == 1, evs
         assert evs[0].event_type == "Warning"
         created = cluster.api.events(object_name="boom", reason="JobCreated")
-        assert len(created) == 1
+        assert len(created) == 1 and created[0].count == 1
         # Terminal span landed with the failure outcome.
         tl = cluster.api.get_timeline("default", "boom")
         totals = [s for s in tl["spans"] if s["name"] == "total"]

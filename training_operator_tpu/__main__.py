@@ -196,6 +196,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--node-toleration-seconds", type=float, default=None,
                     help="taint age before pods on a dead node are evicted "
                          "(default 30)")
+    ap.add_argument("--audit-interval", type=float, default=None,
+                    help="standing invariant auditor + training_fleet_* "
+                         "gauge cadence in cluster seconds (default 30; "
+                         "0 disables the auditor — GET /fleet still serves "
+                         "the snapshot, without live violations)")
     ap.add_argument("--namespace", default=None, help="namespace scope (default: all)")
     ap.add_argument("--controller-threads", type=int, default=None,
                     help="reconciles drained per manager tick")
@@ -247,6 +252,8 @@ def build_config(args: argparse.Namespace) -> OperatorConfig:
         cfg.node_grace_period = args.node_grace_period
     if args.node_toleration_seconds is not None:
         cfg.node_toleration_seconds = args.node_toleration_seconds
+    if args.audit_interval is not None:
+        cfg.fleet_audit_interval = args.audit_interval
     if args.controller_threads is not None:
         cfg.controller_threads = args.controller_threads
     if args.compact_every is not None:
@@ -348,6 +355,37 @@ def wire_cluster_services(cluster: Cluster, cfg: OperatorConfig) -> None:
         )
 
 
+def wire_fleet_plane(cluster: Cluster, cfg: OperatorConfig, sources=None):
+    """The standing fleet plane (observe/): periodic invariant audits +
+    training_fleet_* gauge republish on the cluster clock. Shared by the
+    standalone stack and the host role; returns (collector, auditor) or
+    (None, None) when disabled."""
+    if cfg.fleet_audit_interval <= 0:
+        return None, None
+    from training_operator_tpu.observe import (
+        FleetCollector,
+        FleetSources,
+        InvariantAuditor,
+    )
+
+    sources = sources or FleetSources()
+    auditor = InvariantAuditor(
+        cluster.api,
+        cluster.clock.now,
+        sources=sources,
+        interval=cfg.fleet_audit_interval,
+        toleration_seconds=cfg.node_toleration_seconds,
+    )
+    # One timer drives both halves: the collector's tick audits, then
+    # collects + republishes — audit seq, violations gauge, and gauges
+    # stay coherent, and the store is walked once per interval, not twice.
+    collector = FleetCollector(
+        cluster, sources=sources, interval=cfg.fleet_audit_interval,
+        auditor=auditor,
+    )
+    return collector, auditor
+
+
 def build_stack(cluster: Cluster, cfg: OperatorConfig):
     wire_cluster_services(cluster, cfg)
     gang_enabled = cfg.gang_scheduler_name != "none"
@@ -367,6 +405,14 @@ def build_stack(cluster: Cluster, cfg: OperatorConfig):
         from training_operator_tpu.runtime.controller import TrainJobManager
 
         v2 = TrainJobManager(cluster)
+    from training_operator_tpu.observe import FleetSources
+
+    # In-process deployment: the manager's expectation caches are local, so
+    # the auditor can watch for wedged entries (INV004) directly.
+    wire_fleet_plane(
+        cluster, cfg,
+        sources=FleetSources(expectations=mgr.unfulfilled_expectations),
+    )
     return mgr, v2
 
 
@@ -587,6 +633,18 @@ def run_host(args, cfg) -> int:
         now_fn=cluster.clock.now, tls=tls, chaos=chaos,
         resume_ring_size=cfg.watch_ring_size,
     )
+    # Fleet plane: the server already contributes session/ring occupancy to
+    # its fleet_sources; the durable store adds the journal feeds, and the
+    # standing auditor's violations ride GET /fleet for `top`.
+    if store is not None:
+        server.fleet_sources.journal_bytes = store.journal_bytes
+        server.fleet_sources.journal_bound = (
+            lambda: cfg.compact_max_journal_bytes
+        )
+    _collector, auditor = wire_fleet_plane(
+        cluster, cfg, sources=server.fleet_sources
+    )
+    server.auditor = auditor
     if tls is not None:
         from training_operator_tpu.cluster import certs
 
@@ -750,6 +808,55 @@ def run_describe(argv) -> int:
     return 0
 
 
+def run_top(argv) -> int:
+    """`python -m training_operator_tpu top --api-server URL` — the
+    kubectl-top analogue against a serving host: node/slice chip
+    utilization, gang/queue depths, job counts, and the standing auditor's
+    live invariant violations, rendered from GET /fleet (observe/fleet.py).
+    `--watch N` repolls every N seconds; the server rebuilds the snapshot
+    only when the store version or audit generation moved, so a tight poll
+    is byte-copy cheap."""
+    import os as _os
+
+    ap = argparse.ArgumentParser(
+        prog="python -m training_operator_tpu top",
+        description="fleet utilization, queue depths, and live invariant "
+                    "violations from a serving host",
+    )
+    ap.add_argument("--api-server", required=True, metavar="URL",
+                    help="base URL of the serving host (WIRE_API=...)")
+    ap.add_argument("--api-token", default=None,
+                    help="bearer token (env TPU_OPERATOR_API_TOKEN)")
+    ap.add_argument("--ca-cert", default=None, metavar="PEM",
+                    help="CA bundle pinning an https host (WIRE_CA=...; "
+                         "env TPU_OPERATOR_CA_CERT)")
+    ap.add_argument("--watch", type=float, default=None, metavar="SECONDS",
+                    help="repoll and re-render every SECONDS (default: once)")
+    ap.add_argument("--count", type=int, default=0,
+                    help="with --watch: stop after this many renders "
+                         "(default: until interrupted)")
+    args = ap.parse_args(argv)
+    from training_operator_tpu.cluster.httpapi import RemoteAPIServer
+    from training_operator_tpu.observe import render_top
+
+    api = RemoteAPIServer(
+        args.api_server,
+        token=args.api_token or _os.environ.get("TPU_OPERATOR_API_TOKEN") or None,
+        ca_file=args.ca_cert or _os.environ.get("TPU_OPERATOR_CA_CERT") or None,
+    )
+    renders = 0
+    while True:
+        print(render_top(api.get_fleet()), flush=True)
+        renders += 1
+        if args.watch is None or (args.count and renders >= args.count):
+            return 0
+        try:
+            time.sleep(max(0.1, args.watch))
+        except KeyboardInterrupt:
+            return 0
+        print()
+
+
 def run_node_verb(verb: str, argv) -> int:
     """`python -m training_operator_tpu cordon|uncordon|drain <node>` — the
     kubectl node-admin verbs against a serving host. Drain = cordon + evict
@@ -805,6 +912,8 @@ def main(argv=None) -> int:
         return lint_run(raw[1:])
     if raw and raw[0] == "describe":
         return run_describe(raw[1:])
+    if raw and raw[0] == "top":
+        return run_top(raw[1:])
     if raw and raw[0] in ("cordon", "uncordon", "drain"):
         return run_node_verb(raw[0], raw[1:])
     args = parse_args(argv)

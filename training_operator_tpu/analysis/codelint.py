@@ -26,6 +26,11 @@ Rules (all ERROR; the tree must stay green — `make lint` runs this):
         the README's family table (and the registry's duplicate-
         registration guard) can't silently drift against scattered inline
         registrations.
+  CL006 invariant-rule-registration-outside-invariants    calling
+        `register_invariant(...)` anywhere but observe/invariants.py (the
+        CL005 pattern applied to the fleet auditor's rule catalog): the
+        INV001-INV006 reference table in the README holds only if every
+        rule the auditor can evaluate is declared in that one module.
 
 Run: `python -m training_operator_tpu.analysis.codelint [paths...]`
 (defaults to the `training_operator_tpu` package). Exit 1 on findings.
@@ -114,6 +119,18 @@ def _is_metric_registration(call: ast.Call) -> bool:
     )
 
 
+# The invariant-rule registration entry point (CL006): one name, matched as
+# a bare call or an attribute call (`invariants.register_invariant`).
+INVARIANT_REGISTRAR = "register_invariant"
+
+
+def _is_invariant_registration(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id == INVARIANT_REGISTRAR
+    return isinstance(f, ast.Attribute) and f.attr == INVARIANT_REGISTRAR
+
+
 def _is_thread_ctor(call: ast.Call) -> bool:
     f = call.func
     if isinstance(f, ast.Attribute) and f.attr == "Thread":
@@ -158,6 +175,8 @@ def check_source(path: str, source: str, package_rel: Optional[str] = None) -> L
     in_scheduler = "scheduler/" in rel
     # The one file allowed to register metric families (CL005).
     in_metrics_module = rel.endswith("utils/metrics.py")
+    # The one file allowed to register invariant rules (CL006).
+    in_invariants_module = rel.endswith("observe/invariants.py")
     # The wire modules may import each other's internals (one subsystem,
     # four files); everyone else goes through the httpapi facade's public
     # names.
@@ -191,6 +210,17 @@ def check_source(path: str, source: str, package_rel: Optional[str] = None) -> L
                 f"metric registration (registry.{node.func.attr}) outside "
                 f"utils/metrics.py; declare the family there so the "
                 f"README table and duplicate-registration guard hold",
+            ))
+        if (
+            isinstance(node, ast.Call)
+            and not in_invariants_module
+            and _is_invariant_registration(node)
+        ):
+            findings.append(Finding(
+                path, node.lineno, "CL006",
+                "invariant rule registration (register_invariant) outside "
+                "observe/invariants.py; declare the rule there so the "
+                "INV rule catalog stays one greppable list",
             ))
         if isinstance(node, ast.Call) and _is_time_sleep(node) and in_control_pkg:
             findings.append(Finding(

@@ -173,6 +173,9 @@ class APIServer:
         self._event_seq = 0
         self._watchers: List[WatchQueue] = []
         self._events: List[Event] = []
+        # Event aggregation index (k8s parity): aggregation_key -> index in
+        # _events, so identical repeats bump a count instead of appending.
+        self._event_index: Dict[tuple, int] = {}
         self._lock = threading.RLock()
         # Signalled on every watch push; wait_and_drain blocks on it so a
         # cross-thread watch consumer (the HTTP long-poll handler) parks on
@@ -282,8 +285,11 @@ class APIServer:
                     max_uid_seq = max(max_uid_seq, int(m.group(1)))
                 self._notify("Added", self._clone(stored))
             self._rv_value = max(self._rv_value, rv)
-            if events:
-                self._events.extend(events)
+            for ev in events or []:
+                # Through the aggregation path: journal replay delivers one
+                # record per occurrence, and restored counts must match what
+                # the dead incarnation's readers saw.
+                self._merge_event_locked(ev)
             if pod_logs:
                 for key2, buf in pod_logs.items():
                     self._pod_logs[key2] = {
@@ -334,6 +340,16 @@ class APIServer:
         resume ring is born at (wire_server._ResumeRing)."""
         with self._lock:
             return self._event_seq
+
+    def object_counts(self) -> Dict[str, int]:
+        """Live object count per kind — the fleet collector's store-size
+        view, O(kinds) (the per-kind index already exists)."""
+        with self._lock:
+            return {
+                kind: len(objs)
+                for kind, objs in sorted(self._by_kind.items())
+                if objs
+            }
 
     def _notify(self, ev_type: str, obj: Any, status_only: bool = False) -> None:
         self._event_seq += 1
@@ -648,11 +664,35 @@ class APIServer:
 
     # -- events ------------------------------------------------------------
 
+    def _merge_event_locked(self, event: Event) -> None:
+        """Append-or-aggregate one event (k8s Events parity): an identical
+        repeat (same aggregation_key) becomes a count bump + last-timestamp
+        move on a REPLACED record — stored events stay frozen versions (the
+        snapshot/compaction path encodes captured references outside the
+        lock), so aggregation replaces, never mutates in place."""
+        import dataclasses as _dc
+
+        key = event.aggregation_key()
+        idx = self._event_index.get(key)
+        if idx is not None:
+            old = self._events[idx]
+            self._events[idx] = _dc.replace(
+                old,
+                count=old.count + max(1, event.count),
+                timestamp=event.timestamp or old.timestamp,
+            )
+            return
+        if not event.first_timestamp:
+            event.first_timestamp = event.timestamp
+        event.count = max(1, event.count)
+        self._event_index[key] = len(self._events)
+        self._events.append(event)
+
     def record_event(self, event: Event) -> None:
         with self._lock:
             if self._journal is not None:  # write-ahead, see create()
                 self._journal("event", event)
-            self._events.append(event)
+            self._merge_event_locked(event)
 
     def events(
         self, object_name: Optional[str] = None, reason: Optional[str] = None

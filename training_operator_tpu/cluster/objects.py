@@ -365,7 +365,13 @@ class HorizontalPodAutoscaler:
 @dataclass
 class Event:
     """Lifecycle event (reference emits k8s Events for every action,
-    e.g. common/pod.go:346,364)."""
+    e.g. common/pod.go:346,364).
+
+    k8s Events parity: repeated identical events (same object, type,
+    reason, message) are AGGREGATED on append by the API server — `count`
+    climbs, `timestamp` tracks the last occurrence, `first_timestamp` the
+    first — so an eviction storm or a persisting invariant violation is one
+    record with a count, not an unbounded store append stream."""
 
     object_kind: str = ""
     object_name: str = ""
@@ -373,9 +379,19 @@ class Event:
     event_type: str = "Normal"  # Normal | Warning
     reason: str = ""
     message: str = ""
-    timestamp: float = 0.0
+    timestamp: float = 0.0  # last occurrence
+    first_timestamp: float = 0.0
+    count: int = 1
 
     KIND = "Event"
+
+    def aggregation_key(self) -> tuple:
+        """THE dedup identity (k8s events keys aggregation the same way):
+        everything but the timestamps and the count."""
+        return (
+            self.object_kind, self.object_name, self.namespace,
+            self.event_type, self.reason, self.message,
+        )
 
 
 @dataclass

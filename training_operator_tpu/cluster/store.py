@@ -325,6 +325,17 @@ class HostStore:
             # possibly-megabyte record on the write-ahead hot path.
             self._bytes_since_snapshot += len(line)
 
+    def journal_bytes(self) -> int:
+        """Bytes appended to the current journal generation since the last
+        snapshot — the fleet plane's INV005 feed (a value persistently over
+        `compact_max_bytes` means compaction is wedged)."""
+        with self._lock:
+            return self._bytes_since_snapshot
+
+    def journal_records(self) -> int:
+        with self._lock:
+            return self._records_since_snapshot
+
     # -- compaction --------------------------------------------------------
 
     def maybe_compact(self, api: APIServer) -> bool:

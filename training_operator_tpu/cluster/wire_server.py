@@ -247,6 +247,26 @@ class ApiHTTPServer:
         # Delta-resume ring: subscribe BEFORE any client can, so the ring
         # misses nothing a session could have observed.
         self._ring = _ResumeRing(api, size=resume_ring_size)
+        # Fleet introspection attach points (observe/fleet.py): the server
+        # contributes its own session/ring occupancy to the sources; the
+        # host role adds journal/expectations feeds and sets `auditor` so
+        # GET /fleet carries live violations. The snapshot is byte-cached
+        # keyed (store version, audit generation) — polling /fleet from
+        # `top`/autoscalers costs a byte copy until something changes.
+        from training_operator_tpu.observe.invariants import FleetSources
+
+        self.fleet_sources = FleetSources(
+            watch_sessions=lambda: len(self._sessions),
+            resume_ring=self._resume_ring_occupancy,
+        )
+        self.auditor = None
+        # (key, built_monotonic, bytes). The key (store version, audit seq)
+        # misses the out-of-store feeds (session counts, journal bytes, the
+        # snapshot's own `t`), so cache validity is ALSO age-bounded — with
+        # the auditor disabled the seq never moves and a key-only cache
+        # would serve a frozen snapshot forever.
+        self._fleet_cache: Optional[Tuple[Tuple[int, int], float, bytes]] = None
+        self.fleet_cache_max_age = 2.0
         # Version-keyed body cache: (kind, ns, name, resourceVersion) ->
         # encoded JSON bytes. Objects are immutable between resourceVersions
         # (copy-on-read store), so cached bytes can never be stale — an
@@ -495,6 +515,8 @@ class ApiHTTPServer:
                 200, metrics.registry.render().encode(),
                 ctype="text/plain; version=0.0.4",
             )
+        elif head == "fleet" and method == "GET":
+            self._fleet(h)
         elif head == "timelines":
             self._timelines(h, method, parts[1:])
         elif head == "version" and len(parts) == 4:
@@ -502,6 +524,49 @@ class ApiHTTPServer:
             h._send(200, {"resourceVersion": rv})
         else:
             h._send(404, {"error": "NotFound", "message": f"no route {head}"})
+
+    def _resume_ring_occupancy(self) -> Dict[str, Tuple[int, int]]:
+        """kind -> (events retained, configured size) across the resume
+        rings — the fleet view of replay-buffer pressure."""
+        ring = self._ring
+        with ring._lock:
+            return {
+                kind: (len(dq), ring.size) for kind, dq in ring._rings.items()
+            }
+
+    def _fleet(self, h) -> None:
+        """GET /fleet: the fleet snapshot (observe/fleet.collect_fleet) plus
+        the auditor's live violations, served through a snapshot byte cache
+        keyed (store version, audit generation). The store-derived content
+        is a pure function of that key; the out-of-store feeds (sessions,
+        journal, the snapshot's own clock) are not, so validity is also
+        age-bounded by `fleet_cache_max_age` — tight polls still collapse
+        to byte copies, staleness stays bounded in every configuration
+        (including --audit-interval 0, where the seq never moves)."""
+        aud = self.auditor
+        key = (self.api.version(), getattr(aud, "seq", -1))
+        now = _time.monotonic()
+        with self._body_lock:
+            cached = self._fleet_cache
+        if (
+            cached is not None
+            and cached[0] == key
+            and now - cached[1] < self.fleet_cache_max_age
+        ):
+            metrics.wire_fleet_cache_hits.inc()
+            h._send_bytes(200, cached[2])
+            return
+        metrics.wire_fleet_cache_misses.inc()
+        from training_operator_tpu.observe.fleet import collect_fleet
+
+        fleet = collect_fleet(self.api, self.now_fn(), self.fleet_sources)
+        fleet["violations"] = (
+            [v.to_dict() for v in aud.last_violations] if aud is not None else []
+        )
+        body = json.dumps(fleet, separators=(",", ":")).encode()
+        with self._body_lock:
+            self._fleet_cache = (key, now, body)
+        h._send_bytes(200, body)
 
     def _object_bytes(self, obj) -> bytes:
         """Encoded JSON bytes for one STORED object reference, via the

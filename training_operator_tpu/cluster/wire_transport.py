@@ -868,6 +868,15 @@ class RemoteAPIServer:
             self._drop_conn()
             raise ApiUnavailableError(f"GET /metrics.txt: {e}") from None
 
+    # -- fleet -------------------------------------------------------------
+
+    def get_fleet(self) -> Dict[str, Any]:
+        """The serving host's fleet snapshot (GET /fleet): node/slice
+        utilization, queue depths, job/object counts, store occupancy, and
+        the standing auditor's live violations. Cheap to poll — the server
+        rebuilds it only when the store version or audit generation moved."""
+        return self._request("GET", "/fleet")
+
     # -- timelines ---------------------------------------------------------
 
     def get_timeline(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:

@@ -103,6 +103,11 @@ class OperatorConfig:
     node_heartbeat_interval: float = 10.0
     node_grace_period: float = 40.0
     node_toleration_seconds: float = 30.0
+    # Fleet introspection plane (observe/fleet.py + observe/invariants.py):
+    # cadence of the standing invariant auditor AND the training_fleet_*
+    # gauge republish, on the cluster clock. 0 disables both (the /fleet
+    # route still serves the snapshot, just without live violations).
+    fleet_audit_interval: float = 30.0
     # Probe/metrics HTTP port; 0 disables (reference --health-probe-bind-
     # address / --metrics-bind-address, collapsed to one server here).
     health_port: int = 0
@@ -170,6 +175,8 @@ class OperatorConfig:
             )
         if self.node_toleration_seconds < 0:
             raise ValueError("node_toleration_seconds must be >= 0")
+        if self.fleet_audit_interval < 0:
+            raise ValueError("fleet_audit_interval must be >= 0 (0 disables)")
         if self.leader_lease_duration <= 0:
             # A non-positive lease is permanently expired: leadership would
             # flap between candidates every tick, each transition firing a

@@ -200,6 +200,16 @@ class OperatorManager:
         for _, jc in self.controllers.values():
             jc.expectations.clear()
 
+    def unfulfilled_expectations(self) -> Dict[str, float]:
+        """Unfulfilled expectation ages across every registered kind,
+        prefixed with the kind — the INV004 feed (observe/invariants.py):
+        an entry older than the expectations TTL is wedged."""
+        out: Dict[str, float] = {}
+        for kind, (_, jc) in self.controllers.items():
+            for key, age in jc.expectations.unfulfilled().items():
+                out[f"{kind}|{key}"] = age
+        return out
+
     def _resync_all(self) -> None:
         """Enqueue every in-scope job of every registered kind (the informer
         initial-list a newly elected leader needs)."""
@@ -310,6 +320,9 @@ class OperatorManager:
         except Exception:
             log.exception("reconcile failed for %s", key)
             metrics.reconcile_total.inc(kind, "error")
+            # controller-runtime workqueue_retries_total parity: a failed
+            # reconcile re-enqueued with backoff is one retry.
+            metrics.workqueue_retries.inc(kind)
             delay = self.queue.failure_delay(key)
             self.cluster.schedule_after(delay, lambda: self.queue.add(key))
         else:
@@ -319,6 +332,10 @@ class OperatorManager:
         finally:
             wall = _time.perf_counter() - t0
             metrics.reconcile_seconds.observe(wall)
+            # Per-kind latency (controller_runtime_reconcile_time_seconds
+            # {controller=...}); the unlabeled histogram above stays as the
+            # all-kinds aggregate.
+            metrics.reconcile_duration.observe(wall, kind)
             if tracing:
                 self.api.timelines.record_span(
                     ns, name, "", "reconcile",

@@ -78,6 +78,18 @@ class ControllerExpectations:
             return True
         return False
 
+    def unfulfilled(self) -> Dict[str, float]:
+        """key -> age (seconds since set) of every NOT-yet-fulfilled
+        expectation — the fleet auditor's INV004 feed: an entry older than
+        the TTL is wedged (its watch events will never arrive; the gate
+        opens on TTL expiry but the leak says something was lost)."""
+        now = self._now()
+        return {
+            key: now - exp.timestamp
+            for key, exp in self._store.items()
+            if not exp.fulfilled()
+        }
+
     def clear(self) -> None:
         """Drop every expectation — for a controller whose watch stream had
         a gap (e.g. a standby period between two leadership terms): stale

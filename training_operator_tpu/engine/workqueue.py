@@ -14,6 +14,8 @@ import time as _time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional
 
+from training_operator_tpu.utils import metrics
+
 
 class RateLimitingQueue:
     """Deduplicating FIFO with per-key failure counts for backoff.
@@ -38,6 +40,9 @@ class RateLimitingQueue:
 
     def add(self, key: str) -> None:
         if key not in self._queue:
+            # controller-runtime workqueue_adds_total parity: dedup'd
+            # re-adds of a queued key are not new work and don't count.
+            metrics.workqueue_adds.inc()
             self._queue[key] = None
             self._enqueued_at[key] = self._now()
 

@@ -1,16 +1,20 @@
-"""Job-lifecycle observability: span tracer, describe surface, trace export.
+"""Observability: span tracer, describe, trace export, fleet plane.
 
 Public surface:
   TimelineStore / JobTimeline / Span   the tracer model (observe/timeline.py)
   set_enabled / enabled                process-wide tracing switch
   export_chrome_trace                  Trace Event Format dump (observe/export.py)
   render_describe / phase_table        the describe renderer (observe/describe.py)
+  collect_fleet / FleetCollector /     the fleet snapshot plane
+    render_top / FleetSources            (observe/fleet.py)
+  InvariantAuditor / Violation /       the standing invariant auditor
+    InvariantViolationError              (observe/invariants.py)
 
 The APIServer owns a `TimelineStore` as `api.timelines`; instrumentation
 in the admission path, the manager workqueue, the reconcile engine, and
 the gang scheduler records into it. The wire exposes one job's timeline at
-`GET /timelines/{ns}/{name}` and the registry text exposition at
-`GET /metrics.txt`.
+`GET /timelines/{ns}/{name}`, the fleet snapshot at `GET /fleet`, and the
+registry text exposition at `GET /metrics.txt`.
 """
 
 from training_operator_tpu.observe.describe import (  # noqa: F401
@@ -19,6 +23,17 @@ from training_operator_tpu.observe.describe import (  # noqa: F401
     render_describe,
 )
 from training_operator_tpu.observe.export import export_chrome_trace  # noqa: F401
+from training_operator_tpu.observe.fleet import (  # noqa: F401
+    FleetCollector,
+    collect_fleet,
+    render_top,
+)
+from training_operator_tpu.observe.invariants import (  # noqa: F401
+    FleetSources,
+    InvariantAuditor,
+    InvariantViolationError,
+    Violation,
+)
 from training_operator_tpu.observe.timeline import (  # noqa: F401
     JobTimeline,
     Span,

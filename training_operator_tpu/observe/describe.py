@@ -179,9 +179,13 @@ def render_describe(api, namespace: str, name: str, max_events: int = 40) -> str
     if events:
         lines.append(f"  {'AT':>12}  {'TYPE':<8} {'KIND':<10} {'REASON':<22} MESSAGE")
         for e in events[-max_events:]:
+            # Aggregated repeats (k8s parity): one row with a count, the
+            # kubectl `(x12 over 5m)` shape.
+            count = getattr(e, "count", 1)
+            suffix = f" (x{count})" if count > 1 else ""
             lines.append(
                 f"  {e.timestamp:>12.3f}  {e.event_type:<8} {e.object_kind:<10} "
-                f"{e.reason:<22} {e.message}"
+                f"{e.reason:<22} {e.message}{suffix}"
             )
     else:
         lines.append("  <none>")

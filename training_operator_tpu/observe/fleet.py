@@ -1,0 +1,383 @@
+"""Fleet snapshot collector + `top` renderer: live utilization in one view.
+
+Answers the half of "is the fleet healthy right now?" that isn't a rule
+(observe/invariants.py is the other half): per-node and per-slice chip
+utilization, gang/queue depths, job counts by kind and state, store object
+counts, journal bytes, watch-session and resume-ring occupancy. One
+`collect_fleet` walk produces the wire payload `GET /fleet` serves (byte-
+cached by store version, so polling it is cheap), the gauges the
+`FleetCollector` republishes as `training_fleet_*`, and the table
+`python -m training_operator_tpu top` renders — three surfaces, one
+collector, so they cannot disagree.
+
+ROADMAP open item 5's autoscaler is the intended machine consumer: the
+fleet dict carries exactly the live utilization/queue signals an elasticity
+loop needs, already shaped for the wire.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from training_operator_tpu.observe.invariants import FleetSources
+from training_operator_tpu.utils import metrics
+
+# Per-node detail rows are capped: at 10k nodes the detail table would be
+# the payload; `top` shows slices, aggregates stay exact.
+MAX_NODE_ROWS = 64
+
+
+def job_state(job: Any) -> str:
+    """Uniform lifecycle state for any job-shaped object — v1 jobs (from
+    their condition list) and v2 TrainJobs: pending | running | succeeded
+    | failed. THE classification the fleet gauges, /fleet, and `top` share."""
+    from training_operator_tpu.api import common as capi
+
+    if hasattr(job, "replica_specs"):  # v1 job
+        if capi.is_succeeded(job.status):
+            return "succeeded"
+        if capi.has_condition(job.status, capi.JobConditionType.FAILED):
+            return "failed"
+        if capi.is_running(job.status):
+            return "running"
+        return "pending"
+    # v2 TrainJob: Complete/Failed are terminal; jobs_status says whether
+    # the workload has materialized (created -> it is driving pods).
+    from training_operator_tpu.runtime.api import TrainJobConditionType
+
+    complete = job.condition(TrainJobConditionType.COMPLETE)
+    if complete is not None and complete.status:
+        return "succeeded"
+    failed = job.condition(TrainJobConditionType.FAILED)
+    if failed is not None and failed.status:
+        return "failed"
+    return "running" if job.status.jobs_status else "pending"
+
+
+def collect_fleet(api, now: float,
+                  sources: Optional[FleetSources] = None) -> Dict[str, Any]:
+    """One point-in-time fleet snapshot as a JSON-shaped dict. Reads the
+    store through `list_refs` (frozen references, no clones) — a collection
+    pass over a 10k-node store is one walk, not one deep copy per object."""
+    from training_operator_tpu.api.jobs import JOB_KINDS
+    from training_operator_tpu.cluster.inventory import TPU_RESOURCE
+    from training_operator_tpu.cluster.objects import node_ready
+
+    sources = sources or FleetSources()
+    nodes = list(api.list_refs("Node"))
+    pods = list(api.list_refs("Pod"))
+    groups = list(api.list_refs("PodGroup"))
+
+    # Per-node chip/cpu usage from bound non-terminal pods.
+    used_by_node: Dict[str, Dict[str, float]] = {}
+    for pod in pods:
+        if not pod.node_name or pod.is_terminal():
+            continue
+        bucket = used_by_node.setdefault(pod.node_name, {})
+        for k, v in pod.resources().items():
+            bucket[k] = bucket.get(k, 0.0) + v
+
+    ready = notready = cordoned = 0
+    chips_total = chips_used = 0.0
+    free_tpu_hosts = 0
+    slices: Dict[str, Dict[str, Any]] = {}
+    node_rows: List[Dict[str, Any]] = []
+    for node in sorted(nodes, key=lambda n: n.metadata.name):
+        is_ready = node_ready(node)
+        if is_ready:
+            ready += 1
+        else:
+            notready += 1
+        if node.unschedulable:
+            cordoned += 1
+        cap_chips = node.capacity.get(TPU_RESOURCE, 0.0)
+        used = used_by_node.get(node.metadata.name, {})
+        used_chips = min(cap_chips, used.get(TPU_RESOURCE, 0.0))
+        chips_total += cap_chips
+        chips_used += used_chips
+        acc = node.accelerator
+        if acc.kind == "tpu" and acc.tpu_slice:
+            sl = slices.setdefault(acc.tpu_slice, {
+                "slice": acc.tpu_slice,
+                "topology": acc.slice_topology,
+                "hosts": 0,
+                "free_hosts": 0,
+                "ready_hosts": 0,
+                "chips": 0.0,
+                "chips_used": 0.0,
+            })
+            sl["hosts"] += 1
+            sl["chips"] += cap_chips
+            sl["chips_used"] += used_chips
+            if is_ready:
+                sl["ready_hosts"] += 1
+            if used_chips == 0.0 and is_ready and not node.unschedulable:
+                sl["free_hosts"] += 1
+                free_tpu_hosts += 1
+        if len(node_rows) < MAX_NODE_ROWS:
+            node_rows.append({
+                "node": node.metadata.name,
+                "ready": is_ready,
+                "cordoned": node.unschedulable,
+                "slice": acc.tpu_slice,
+                "chips": cap_chips,
+                "chips_used": used_chips,
+                "cpu": node.capacity.get("cpu", 0.0),
+                "cpu_used": used.get("cpu", 0.0),
+            })
+
+    podgroups: Dict[str, int] = {}
+    for pg in groups:
+        phase = getattr(pg.phase, "value", str(pg.phase))
+        podgroups[phase] = podgroups.get(phase, 0) + 1
+
+    jobs: Dict[str, Dict[str, int]] = {}
+    for kind in ("TrainJob", *JOB_KINDS):
+        counts: Dict[str, int] = {}
+        for job in api.list_refs(kind):
+            state = job_state(job)
+            counts[state] = counts.get(state, 0) + 1
+        if counts:
+            jobs[kind] = counts
+
+    store: Dict[str, Any] = {}
+    if sources.journal_bytes is not None:
+        store["journal_bytes"] = int(sources.journal_bytes())
+    if sources.journal_bound is not None:
+        store["journal_bound"] = int(sources.journal_bound())
+    if sources.watch_sessions is not None:
+        store["watch_sessions"] = int(sources.watch_sessions())
+    if sources.resume_ring is not None:
+        rings = sources.resume_ring()
+        store["resume_ring_events"] = sum(occ for occ, _ in rings.values())
+        store["resume_ring_size"] = max(
+            (size for _, size in rings.values()), default=0
+        )
+    expectations = 0
+    if sources.expectations is not None:
+        expectations = len(sources.expectations())
+
+    return {
+        "t": now,
+        "nodes": {
+            "total": len(nodes), "ready": ready, "notready": notready,
+            "cordoned": cordoned,
+        },
+        "node_rows": node_rows,
+        "nodes_truncated": len(nodes) > len(node_rows),
+        "slices": [slices[k] for k in sorted(slices)],
+        "chips": {"total": chips_total, "used": chips_used},
+        "free_tpu_hosts": free_tpu_hosts,
+        "whole_free_slices": sum(
+            1 for s in slices.values() if s["free_hosts"] == s["hosts"]
+        ),
+        "podgroups": podgroups,
+        "queue": {
+            "pending_gangs": podgroups.get("Pending", 0)
+            + podgroups.get("Unschedulable", 0),
+            "workqueue_depth": metrics.workqueue_depth.value(),
+            "unfulfilled_expectations": expectations,
+        },
+        "jobs": jobs,
+        "objects": api.object_counts(),
+        "store": store,
+    }
+
+
+class FleetCollector:
+    """Periodic republisher: one `collect_fleet` walk per `interval` on the
+    cluster clock, exported as `training_fleet_*` gauges through the
+    process registry (so `/metrics` + `/metrics.txt` carry the fleet view
+    without a /fleet poll). Holds the latest snapshot for local readers.
+
+    `auditor`: an (unattached) InvariantAuditor to drive from the SAME
+    timer — one fleet-plane tick per interval instead of two drifting
+    timers each walking the store."""
+
+    def __init__(self, cluster, sources: Optional[FleetSources] = None,
+                 interval: float = 30.0, auditor=None):
+        self.cluster = cluster
+        self.sources = sources or FleetSources()
+        self.interval = interval
+        self.auditor = auditor
+        self.last: Optional[Dict[str, Any]] = None
+        # Label tuples set last round, per dynamic-label family: a bucket
+        # that empties (every Pending gang admitted, a kind GC'd from the
+        # store) must be zeroed, not left at its last value — a stale
+        # phantom gauge would tell the autoscaler there is pending work
+        # forever, and /metrics would disagree with /fleet.
+        self._published: Dict[str, set] = {}
+        self._armed = True
+        cluster.schedule_after(interval, self._tick)
+
+    def stop(self) -> None:
+        self._armed = False
+
+    def _tick(self) -> None:
+        if not self._armed:
+            return
+        try:
+            self.collect()
+        finally:
+            if self._armed:
+                self.cluster.schedule_after(self.interval, self._tick)
+
+    def collect(self) -> Dict[str, Any]:
+        if self.auditor is not None:
+            # Audit first: the violations gauge and audit seq are then
+            # coherent with the snapshot this same tick publishes.
+            self.auditor.audit()
+        fleet = collect_fleet(
+            self.cluster.api, self.cluster.clock.now(), self.sources
+        )
+        self.publish(fleet)
+        self.last = fleet
+        return fleet
+
+    def _set_family(self, gauge, values: Dict[tuple, float]) -> None:
+        """Publish one dynamic-label gauge family, zeroing every label
+        tuple that was set on a previous round but is absent now."""
+        stale = self._published.get(gauge.name, set()) - set(values)
+        for labels in stale:
+            gauge.set(*labels, value=0.0)
+        for labels, v in values.items():
+            gauge.set(*labels, value=v)
+        self._published[gauge.name] = set(values)
+
+    def publish(self, fleet: Dict[str, Any]) -> None:
+        n = fleet["nodes"]
+        metrics.fleet_nodes.set("ready", value=float(n["ready"]))
+        metrics.fleet_nodes.set("notready", value=float(n["notready"]))
+        metrics.fleet_nodes.set("cordoned", value=float(n["cordoned"]))
+        metrics.fleet_chips_total.set(value=float(fleet["chips"]["total"]))
+        metrics.fleet_chips_used.set(value=float(fleet["chips"]["used"]))
+        metrics.fleet_free_tpu_hosts.set(value=float(fleet["free_tpu_hosts"]))
+        metrics.fleet_whole_free_slices.set(
+            value=float(fleet["whole_free_slices"])
+        )
+        self._set_family(metrics.fleet_podgroups, {
+            (phase,): float(count)
+            for phase, count in fleet["podgroups"].items()
+        })
+        self._set_family(metrics.fleet_jobs, {
+            (kind, state): float(count)
+            for kind, counts in fleet["jobs"].items()
+            for state, count in counts.items()
+        })
+        self._set_family(metrics.fleet_objects, {
+            (kind,): float(count)
+            for kind, count in fleet["objects"].items()
+        })
+        store = fleet["store"]
+        if "journal_bytes" in store:
+            metrics.fleet_journal_bytes.set(
+                value=float(store["journal_bytes"])
+            )
+        if "watch_sessions" in store:
+            metrics.fleet_watch_sessions.set(
+                value=float(store["watch_sessions"])
+            )
+        if "resume_ring_events" in store:
+            metrics.fleet_resume_ring_events.set(
+                value=float(store["resume_ring_events"])
+            )
+
+
+# ---------------------------------------------------------------------------
+# `top` renderer
+# ---------------------------------------------------------------------------
+
+
+def _bar(used: float, total: float, width: int = 20) -> str:
+    if total <= 0:
+        return "-" * width
+    filled = int(round(width * min(1.0, used / total)))
+    return "#" * filled + "." * (width - filled)
+
+
+def render_top(fleet: Dict[str, Any]) -> str:
+    """The kubectl-top analogue for one fleet snapshot: slice/node chip
+    utilization, gang/queue depths, job counts, live violations."""
+    lines: List[str] = []
+    n = fleet["nodes"]
+    chips = fleet["chips"]
+    pct = 100.0 * chips["used"] / chips["total"] if chips["total"] else 0.0
+    lines.append(
+        f"fleet @ t={fleet['t']:.1f}  nodes: {n['total']} "
+        f"({n['ready']} ready, {n['notready']} notready, "
+        f"{n['cordoned']} cordoned)  chips: {chips['used']:.0f}/"
+        f"{chips['total']:.0f} ({pct:.1f}%)"
+    )
+
+    if fleet["slices"]:
+        lines.append("")
+        lines.append(f"  {'SLICE':<16} {'TOPO':<8} {'HOSTS':>5} {'FREE':>5} "
+                     f"{'CHIPS':>12} UTIL")
+        for sl in fleet["slices"]:
+            lines.append(
+                f"  {sl['slice']:<16} {sl['topology']:<8} {sl['hosts']:>5} "
+                f"{sl['free_hosts']:>5} "
+                f"{sl['chips_used']:>5.0f}/{sl['chips']:<6.0f} "
+                f"{_bar(sl['chips_used'], sl['chips'])}"
+            )
+    elif fleet["node_rows"]:
+        lines.append("")
+        lines.append(f"  {'NODE':<24} {'READY':<6} {'CPU':>12} {'CHIPS':>10}")
+        for row in fleet["node_rows"]:
+            lines.append(
+                f"  {row['node']:<24} {str(row['ready']):<6} "
+                f"{row['cpu_used']:>5.1f}/{row['cpu']:<6.1f} "
+                f"{row['chips_used']:>4.0f}/{row['chips']:<5.0f}"
+            )
+        if fleet.get("nodes_truncated"):
+            lines.append(f"  ... ({n['total']} nodes total)")
+
+    q = fleet["queue"]
+    pg = fleet["podgroups"]
+    lines.append("")
+    lines.append(
+        "queues:  pending gangs "
+        f"{q['pending_gangs']}  inqueue {pg.get('Inqueue', 0)}  "
+        f"running {pg.get('Running', 0)}  workqueue depth "
+        f"{q['workqueue_depth']:.0f}  expectations "
+        f"{q['unfulfilled_expectations']}"
+    )
+
+    if fleet["jobs"]:
+        lines.append("")
+        lines.append(f"  {'KIND':<16} {'PENDING':>8} {'RUNNING':>8} "
+                     f"{'SUCCEEDED':>10} {'FAILED':>7}")
+        for kind in sorted(fleet["jobs"]):
+            c = fleet["jobs"][kind]
+            lines.append(
+                f"  {kind:<16} {c.get('pending', 0):>8} "
+                f"{c.get('running', 0):>8} {c.get('succeeded', 0):>10} "
+                f"{c.get('failed', 0):>7}"
+            )
+
+    store = fleet.get("store") or {}
+    if store:
+        parts = []
+        if "journal_bytes" in store:
+            parts.append(f"journal {store['journal_bytes']}B")
+        if "watch_sessions" in store:
+            parts.append(f"watch sessions {store['watch_sessions']}")
+        if "resume_ring_events" in store:
+            parts.append(f"resume ring {store['resume_ring_events']} events")
+        if parts:
+            lines.append("")
+            lines.append("store:   " + "  ".join(parts))
+
+    violations = fleet.get("violations") or []
+    lines.append("")
+    if violations:
+        lines.append(f"violations: {len(violations)} ACTIVE")
+        for v in violations:
+            where = f"{v['namespace']}/{v['name']}" if v["namespace"] else v["name"]
+            lines.append(
+                f"  {v['rule']}  {v['object_kind']:<10} {where:<28} "
+                f"{v['message']}"
+            )
+    else:
+        lines.append("violations: none")
+    return "\n".join(lines)

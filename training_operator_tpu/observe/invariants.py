@@ -1,0 +1,496 @@
+"""Standing invariant auditor: "is the fleet healthy right now?" as code.
+
+ROADMAP open item 4 names the invariant checker as the prerequisite for the
+10k-node soak harness: chaos tiers prove jobs *converge*, but convergence
+tests can't see a fleet that is quietly wrong in ways no single job notices
+— an orphaned pod holding chips forever, a gang whose recorded placement no
+longer matches any ICI mesh, an expectation entry that will gate reconciles
+until its TTL on every pass. This module is the rule catalog plus the
+periodic auditor that evaluates it against the live store.
+
+Rule catalog (ALL rules are registered HERE — codelint CL006 rejects
+`register_invariant` calls anywhere else, the CL005 pattern — so the README
+reference table cannot drift against scattered registrations):
+
+  INV001 orphaned-pod            a Pod labeled as owned by a job that no
+                                 longer exists (cascade GC failed/wedged)
+  INV002 gang-placement-broken   an admitted gang's recorded placement is
+                                 inconsistent hardware: placed nodes gone /
+                                 non-TPU, more slices than num_slices, or a
+                                 non-contiguous host block (broken ICI mesh)
+  INV003 stale-running-pod       a RUNNING pod on a dead/NotReady/vanished
+                                 node past the eviction toleration (the
+                                 node lifecycle controller failed to evict)
+  INV004 wedged-expectation      an unfulfilled expectation older than the
+                                 expectations TTL — its events will never
+                                 arrive (the PR 5 expectation-leak class)
+  INV005 storage-over-bound      host journal bytes past the compaction
+                                 bound, or a resume ring holding more
+                                 events than its configured size
+  INV006 condition-disagreement  a terminal TrainJob whose same-named
+                                 workload job holds the OPPOSITE terminal
+                                 condition (v2 status sync broke)
+
+Mechanics: every rule returns *candidates*; the auditor tracks first-seen
+times and reports a violation only once it has persisted past the rule's
+grace window (cluster-clock seconds) — transient in-between states (a
+cascade delete one tick behind its job, a gang mid-invalidation) are the
+normal operation of an asynchronous control plane, not violations. Reported
+violations emit a Warning Event (deduplicated by the Event aggregation
+path), increment `training_invariant_violations_total{rule}` once per
+incident, land a timeline span on the affected job, and — in `fail_fast`
+mode, which the chaos matrix and `bench.py --audit` run under — raise
+`InvariantViolationError`, turning every existing chaos tier into an
+invariant regression test.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from training_operator_tpu.utils import metrics
+
+# Default audit cadence (OperatorConfig.fleet_audit_interval).
+DEFAULT_AUDIT_INTERVAL = 30.0
+
+# Grace windows (cluster-clock seconds a candidate must persist before it
+# is a violation). Sized to the machinery that legitimately produces the
+# transient: cascade GC and gang invalidation land within a tick or two but
+# ride watch echoes and (remote) wire retries; eviction timers fire at the
+# toleration deadline plus scheduling slack.
+GRACE_TRANSIENT = 30.0
+
+
+class InvariantViolationError(RuntimeError):
+    """Raised by a fail-fast auditor when any violation is active."""
+
+
+@dataclass
+class Violation:
+    rule: str
+    object_kind: str
+    namespace: str
+    name: str
+    message: str
+    since: float = 0.0
+
+    def key(self) -> Tuple[str, str, str, str]:
+        return (self.rule, self.object_kind, self.namespace, self.name)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "rule": self.rule,
+            "object_kind": self.object_kind,
+            "namespace": self.namespace,
+            "name": self.name,
+            "message": self.message,
+            "since": self.since,
+        }
+
+
+@dataclass
+class FleetSources:
+    """Optional out-of-store signal feeds for the auditor and the fleet
+    collector — state that lives beside the APIServer, not in it: the wire
+    server knows its sessions and resume rings, the HostStore its journal,
+    the manager its expectation caches. Every field is a zero-arg callable
+    (or None when that subsystem isn't present in this deployment shape)."""
+
+    journal_bytes: Optional[Callable[[], int]] = None
+    journal_bound: Optional[Callable[[], int]] = None
+    watch_sessions: Optional[Callable[[], int]] = None
+    # kind -> (events retained, configured ring size)
+    resume_ring: Optional[Callable[[], Dict[str, Tuple[int, int]]]] = None
+    # unfulfilled expectation key -> age in cluster-clock seconds
+    expectations: Optional[Callable[[], Dict[str, float]]] = None
+
+
+class AuditContext:
+    """One audit pass's view: the store, the clock instant, and the side
+    sources — with the object lists fetched once and shared across rules
+    (list_refs: frozen references, no clones)."""
+
+    def __init__(self, api, now: float, sources: Optional[FleetSources],
+                 toleration_seconds: float):
+        self.api = api
+        self.now = now
+        self.sources = sources or FleetSources()
+        self.toleration_seconds = toleration_seconds
+        self._lists: Dict[str, List[Any]] = {}
+        self._nodes_by_name: Optional[Dict[str, Any]] = None
+
+    def list(self, kind: str) -> List[Any]:
+        cached = self._lists.get(kind)
+        if cached is None:
+            cached = self._lists[kind] = list(self.api.list_refs(kind))
+        return cached
+
+    def nodes_by_name(self) -> Dict[str, Any]:
+        if self._nodes_by_name is None:
+            self._nodes_by_name = {
+                n.metadata.name: n for n in self.list("Node")
+            }
+        return self._nodes_by_name
+
+
+@dataclass
+class InvariantRule:
+    rule_id: str
+    description: str
+    check: Callable[[AuditContext], List[Violation]]
+    grace: float = GRACE_TRANSIENT
+
+
+RULES: List[InvariantRule] = []
+
+
+def register_invariant(rule: InvariantRule) -> InvariantRule:
+    """THE registration point (CL006): every rule the auditor can evaluate
+    is declared in this module, so the rule-id catalog is one greppable
+    list and a duplicate id is impossible to introduce silently."""
+    if any(r.rule_id == rule.rule_id for r in RULES):
+        raise ValueError(f"invariant rule {rule.rule_id} already registered")
+    RULES.append(rule)
+    return rule
+
+
+# ---------------------------------------------------------------------------
+# Rule checks
+# ---------------------------------------------------------------------------
+
+
+def _check_orphaned_pods(ctx: AuditContext) -> List[Violation]:
+    from training_operator_tpu.api.common import JOB_KIND_LABEL, JOB_NAME_LABEL
+
+    out = []
+    for pod in ctx.list("Pod"):
+        labels = pod.metadata.labels
+        jkind = labels.get(JOB_KIND_LABEL)
+        jname = labels.get(JOB_NAME_LABEL)
+        if not jkind or not jname:
+            continue
+        if ctx.api.resource_version(jkind, pod.namespace, jname) is None:
+            out.append(Violation(
+                "INV001", "Pod", pod.namespace, pod.metadata.name,
+                f"pod {pod.namespace}/{pod.metadata.name} has no live "
+                f"owning {jkind} {jname} (cascade GC missed it)",
+            ))
+    return out
+
+
+def _check_gang_placement(ctx: AuditContext) -> List[Violation]:
+    from training_operator_tpu.cluster.objects import PodGroupPhase
+    from training_operator_tpu.scheduler.snapshot import (
+        contiguous_host_block,
+        host_index,
+    )
+
+    nodes = ctx.nodes_by_name()
+    out = []
+    for pg in ctx.list("PodGroup"):
+        if pg.phase not in (PodGroupPhase.INQUEUE, PodGroupPhase.RUNNING):
+            continue
+        if not pg.placement or not pg.topology_request:
+            continue  # non-TPU gang: no ICI contract to audit
+        problems: List[str] = []
+        slices: Dict[str, List[int]] = {}
+        for pod_name, node_name in sorted(pg.placement.items()):
+            node = nodes.get(node_name)
+            if node is None:
+                problems.append(f"placed node {node_name} no longer exists")
+                continue
+            acc = node.accelerator
+            if acc.kind != "tpu" or not acc.tpu_slice:
+                problems.append(
+                    f"pod {pod_name} placed on non-TPU node {node_name}"
+                )
+                continue
+            slices.setdefault(acc.tpu_slice, []).append(host_index(node))
+        budget = max(1, pg.num_slices)
+        if len(slices) > budget:
+            problems.append(
+                f"gang spans {len(slices)} failure domains "
+                f"({', '.join(sorted(slices))}) > num_slices={budget}"
+            )
+        for sid in sorted(slices):
+            if not contiguous_host_block(slices[sid]):
+                problems.append(
+                    f"hosts {sorted(set(slices[sid]))} in slice {sid} are "
+                    f"not an ICI-contiguous block"
+                )
+        if problems:
+            out.append(Violation(
+                "INV002", "PodGroup", pg.namespace, pg.metadata.name,
+                "; ".join(problems),
+            ))
+    return out
+
+
+def _check_stale_running_pods(ctx: AuditContext) -> List[Violation]:
+    from training_operator_tpu.cluster.objects import (
+        NODE_CONDITION_READY,
+        PodPhase,
+        get_node_condition,
+    )
+
+    nodes = ctx.nodes_by_name()
+    tol = ctx.toleration_seconds
+    out = []
+    for pod in ctx.list("Pod"):
+        if pod.status.phase != PodPhase.RUNNING or not pod.node_name:
+            continue
+        node = nodes.get(pod.node_name)
+        if node is None:
+            out.append(Violation(
+                "INV003", "Pod", pod.namespace, pod.metadata.name,
+                f"RUNNING pod on vanished node {pod.node_name}",
+            ))
+            continue
+        cond = get_node_condition(node, NODE_CONDITION_READY)
+        if cond is None or cond.get("status") == "True":
+            continue
+        age = ctx.now - float(cond.get("last_transition_time", ctx.now))
+        if age > tol:
+            out.append(Violation(
+                "INV003", "Pod", pod.namespace, pod.metadata.name,
+                f"RUNNING pod on NotReady node {pod.node_name} for "
+                f"{age:.0f}s > toleration {tol:.0f}s (eviction missed it)",
+            ))
+    return out
+
+
+def _check_wedged_expectations(ctx: AuditContext) -> List[Violation]:
+    from training_operator_tpu.engine.expectations import (
+        EXPECTATION_TIMEOUT_SECONDS,
+    )
+
+    src = ctx.sources.expectations
+    if src is None:
+        return []
+    out = []
+    for key, age in src().items():
+        if age > EXPECTATION_TIMEOUT_SECONDS:
+            out.append(Violation(
+                "INV004", "Expectation", "", key,
+                f"expectation {key} unfulfilled for {age:.0f}s > TTL "
+                f"{EXPECTATION_TIMEOUT_SECONDS:.0f}s — its watch events "
+                f"will never arrive",
+            ))
+    return out
+
+
+def _check_storage_bounds(ctx: AuditContext) -> List[Violation]:
+    out = []
+    src = ctx.sources
+    if src.journal_bytes is not None and src.journal_bound is not None:
+        bound = int(src.journal_bound())
+        size = int(src.journal_bytes())
+        if bound > 0 and size > bound:
+            out.append(Violation(
+                "INV005", "HostStore", "", "journal",
+                f"journal holds {size} bytes > compaction bound {bound} "
+                f"(compaction wedged?)",
+            ))
+    if src.resume_ring is not None:
+        for kind, (occupancy, size) in sorted(src.resume_ring().items()):
+            if occupancy > size:
+                out.append(Violation(
+                    "INV005", "ResumeRing", "", kind,
+                    f"resume ring for {kind} retains {occupancy} events > "
+                    f"configured size {size}",
+                ))
+    return out
+
+
+def _check_condition_disagreement(ctx: AuditContext) -> List[Violation]:
+    from training_operator_tpu.api import common as capi
+    from training_operator_tpu.api.jobs import JOB_KINDS
+    from training_operator_tpu.runtime.api import TrainJobConditionType
+
+    # Same-named workload jobs of every v1 kind, indexed once.
+    workloads: Dict[Tuple[str, str], Any] = {}
+    for kind in JOB_KINDS:
+        for job in ctx.list(kind):
+            workloads[(job.namespace, job.metadata.name)] = job
+    out = []
+    for tj in ctx.list("TrainJob"):
+        complete = tj.condition(TrainJobConditionType.COMPLETE)
+        failed = tj.condition(TrainJobConditionType.FAILED)
+        tj_state = None
+        if complete is not None and complete.status:
+            tj_state = "Complete"
+        elif failed is not None and failed.status:
+            tj_state = "Failed"
+        if tj_state is None:
+            continue
+        wj = workloads.get((tj.namespace, tj.metadata.name))
+        if wj is None:
+            continue  # workload GC'd after terminal sync: consistent
+        wj_failed = capi.has_condition(wj.status, capi.JobConditionType.FAILED)
+        wj_succeeded = capi.is_succeeded(wj.status)
+        if (tj_state == "Complete" and wj_failed) or (
+            tj_state == "Failed" and wj_succeeded
+        ):
+            out.append(Violation(
+                "INV006", "TrainJob", tj.namespace, tj.metadata.name,
+                f"TrainJob is {tj_state} but workload {wj.kind} "
+                f"{wj.namespace}/{wj.metadata.name} holds the opposite "
+                f"terminal condition",
+            ))
+    return out
+
+
+register_invariant(InvariantRule(
+    "INV001", "pod with no live owning job", _check_orphaned_pods,
+))
+register_invariant(InvariantRule(
+    "INV002",
+    "admitted gang placement split across failure domains or ICI-broken",
+    _check_gang_placement,
+))
+register_invariant(InvariantRule(
+    "INV003", "RUNNING pod on a dead node past its eviction toleration",
+    _check_stale_running_pods,
+))
+register_invariant(InvariantRule(
+    "INV004", "expectation unfulfilled past its TTL",
+    _check_wedged_expectations, grace=0.0,  # the TTL IS the grace
+))
+register_invariant(InvariantRule(
+    "INV005", "journal or resume ring over its configured bound",
+    _check_storage_bounds, grace=60.0,  # compaction runs from the host loop
+))
+register_invariant(InvariantRule(
+    "INV006", "TrainJob and workload job disagree on the terminal condition",
+    _check_condition_disagreement, grace=60.0,  # one v2 resync heals it
+))
+
+
+# Violation targets whose (namespace, name) IS a job timeline key — only
+# these get a span (a span per orphaned pod would pollute the job ring with
+# pod-named timelines).
+_SPAN_KINDS = ("PodGroup", "TrainJob")
+
+
+class InvariantAuditor:
+    """Evaluates the rule catalog periodically against one APIServer.
+
+    `now_fn` is the cluster clock, so graces and cadence run in sim time on
+    a virtual clock (the chaos matrix) and in wall time on a host. `audit()`
+    is also directly callable — the bench calls it per tick."""
+
+    def __init__(
+        self,
+        api,
+        now_fn: Callable[[], float],
+        sources: Optional[FleetSources] = None,
+        interval: float = DEFAULT_AUDIT_INTERVAL,
+        fail_fast: bool = False,
+        toleration_seconds: Optional[float] = None,
+        rules: Optional[List[InvariantRule]] = None,
+    ):
+        from training_operator_tpu import config
+
+        self.api = api
+        self.now = now_fn
+        self.sources = sources or FleetSources()
+        self.interval = interval
+        self.fail_fast = fail_fast
+        self.toleration_seconds = (
+            toleration_seconds
+            if toleration_seconds is not None
+            else config.current().node_toleration_seconds
+        )
+        self.rules = list(rules if rules is not None else RULES)
+        # Candidate key -> first-seen cluster time (grace tracking).
+        self._first_seen: Dict[Tuple, float] = {}
+        # Keys currently reported: the counter/Event/span fire once per
+        # incident, not once per audit pass; a healed-then-recurring key
+        # counts again.
+        self._reported: set = set()
+        self.last_violations: List[Violation] = []
+        # Audit generation — the /fleet byte cache keys on (store version,
+        # seq) so a fresh audit invalidates the cached snapshot.
+        self.seq = 0
+        self.audits = 0
+        self._armed = False
+
+    # -- evaluation ----------------------------------------------------
+
+    def audit(self) -> List[Violation]:
+        now = self.now()
+        ctx = AuditContext(self.api, now, self.sources, self.toleration_seconds)
+        candidates: Dict[Tuple, Tuple[InvariantRule, Violation]] = {}
+        for rule in self.rules:
+            for v in rule.check(ctx):
+                candidates[v.key()] = (rule, v)
+        # Healed candidates reset their grace clock (and their incident).
+        for key in list(self._first_seen):
+            if key not in candidates:
+                del self._first_seen[key]
+        active: List[Violation] = []
+        for key, (rule, v) in candidates.items():
+            first = self._first_seen.setdefault(key, now)
+            if now - first < rule.grace:
+                continue
+            v.since = first
+            active.append(v)
+            if key not in self._reported:
+                self._reported.add(key)
+                self._report(v, now)
+        self._reported &= set(candidates)
+        active.sort(key=lambda v: v.key())
+        self.last_violations = active
+        metrics.fleet_violations.set(value=float(len(active)))
+        self.seq += 1
+        self.audits += 1
+        if self.fail_fast and active:
+            raise InvariantViolationError(
+                "; ".join(f"{v.rule} {v.object_kind} {v.namespace}/{v.name}: "
+                          f"{v.message}" for v in active)
+            )
+        return active
+
+    def _report(self, v: Violation, now: float) -> None:
+        from training_operator_tpu.cluster.objects import Event
+
+        metrics.invariant_violations.inc(v.rule)
+        self.api.record_event(Event(
+            object_kind=v.object_kind,
+            object_name=v.name,
+            namespace=v.namespace,
+            event_type="Warning",
+            reason=v.rule,
+            message=v.message,
+            timestamp=now,
+        ))
+        if v.object_kind in _SPAN_KINDS:
+            self.api.timelines.record_span(
+                v.namespace, v.name, "", "invariant",
+                start=v.since, end=now, rule=v.rule, message=v.message,
+            )
+
+    # -- periodic ------------------------------------------------------
+
+    def attach(self, cluster) -> "InvariantAuditor":
+        """Run on the cluster's (virtual) clock every `interval` — the
+        standing auditor. In fail-fast mode a violation raises out of the
+        timer callback through `Cluster.step()`, failing the run."""
+        self._armed = True
+        cluster.schedule_after(self.interval, lambda: self._tick(cluster))
+        return self
+
+    def detach(self) -> None:
+        self._armed = False
+
+    def _tick(self, cluster) -> None:
+        if not self._armed:
+            return
+        try:
+            self.audit()
+        finally:
+            if self._armed:
+                cluster.schedule_after(
+                    self.interval, lambda: self._tick(cluster)
+                )

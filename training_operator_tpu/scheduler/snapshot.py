@@ -292,6 +292,24 @@ def _host_index(node: Node) -> int:
         return 0
 
 
+def host_index(node: Node) -> int:
+    """Public spelling of the slice host index (the node's position along
+    the slice's host axis) — placement introspection (the fleet auditor's
+    INV002 contiguity check) must read the SAME index the packer placed by,
+    or audit and placement could disagree about what contiguous means."""
+    return _host_index(node)
+
+
+def contiguous_host_block(indices) -> bool:
+    """True when the host indices form one gapless run — the only shape a
+    sub-slice placement can take on an ICI mesh (hosts own contiguous chip
+    blocks along the minor axis, so a gap in host indices is a hole in the
+    chip grid). The auditor checks admitted placements against this; the
+    packer allocates by it."""
+    s = sorted(set(int(i) for i in indices))
+    return not s or s[-1] - s[0] + 1 == len(s)
+
+
 def resolve_owner_job(api: APIServer, pg: PodGroup) -> Optional[Job]:
     """PodGroups are named after and owned by their job; `job-kind` label says
     which kind to fetch (set by PodGroupControl.create_podgroup)."""

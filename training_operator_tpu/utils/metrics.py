@@ -25,6 +25,10 @@ def _label_str(label_names: Tuple[str, ...], labels: Tuple[str, ...]) -> str:
 
 
 class Counter:
+    # Prometheus TYPE line — the ONLY thing Gauge.render used to differ in;
+    # subclasses override the attribute instead of copying the renderer.
+    METRIC_TYPE = "counter"
+
     def __init__(self, name: str, help_text: str, label_names: Tuple[str, ...]):
         self.name = name
         self.help = help_text
@@ -56,22 +60,23 @@ class Counter:
             return list(self._values.items())
 
     def render(self) -> List[str]:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        """Text exposition from the SAME items() view snapshot() reads, so
+        the two surfaces cannot disagree (Histogram-style one-view rule)."""
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.METRIC_TYPE}",
+        ]
         for labels, v in sorted(self.items()):
             lines.append(f"{self.name}{{{_label_str(self.label_names, labels)}}} {v}")
         return lines
 
 
 class Gauge(Counter):
+    METRIC_TYPE = "gauge"
+
     def set(self, *label_values: str, value: float = 0.0) -> None:
         with self._lock:
             self._values[tuple(label_values)] = value
-
-    def render(self) -> List[str]:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
-        for labels, v in sorted(self.items()):
-            lines.append(f"{self.name}{{{_label_str(self.label_names, labels)}}} {v}")
-        return lines
 
 
 # controller-runtime's reconcile_time_seconds convention, stretched to the
@@ -163,6 +168,74 @@ class Histogram:
         return lines
 
 
+class LabeledHistogram:
+    """Histogram family with label dimensions (controller-runtime's
+    `controller_runtime_reconcile_time_seconds{controller=...}` shape): one
+    child Histogram per label tuple, sharing a name/help/bucket layout.
+
+    Exposition derives from each child's `snapshot_items()` — the one-view
+    rule — with the family labels spliced into every sample's label set, so
+    text and JSON stay in lockstep exactly as for the unlabeled Histogram.
+    """
+
+    METRIC_TYPE = "histogram"
+
+    def __init__(self, name: str, help_text: str, label_names: Tuple[str, ...],
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self.buckets: Tuple[float, ...] = tuple(sorted(buckets))
+        self._children: Dict[Tuple[str, ...], Histogram] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *label_values: str) -> Histogram:
+        if len(label_values) != len(self.label_names):
+            raise ValueError(f"{self.name}: expected labels {self.label_names}")
+        key = tuple(label_values)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = Histogram(
+                    self.name, self.help, self.buckets
+                )
+            return child
+
+    def observe(self, value: float, *label_values: str) -> None:
+        self.labels(*label_values).observe(value)
+
+    def _child_items(self) -> List[Tuple[Tuple[str, ...], Histogram]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+    @staticmethod
+    def _splice(key: str, label_str: str) -> str:
+        """Insert the family labels into one child sample key:
+        `name_bucket{le="x"}` -> `name_bucket{kind="j",le="x"}` and the
+        brace-less `name_count` -> `name_count{kind="j"}`."""
+        brace = key.find("{")
+        if brace < 0:
+            return f"{key}{{{label_str}}}"
+        return f"{key[:brace]}{{{label_str},{key[brace + 1:]}"
+
+    def snapshot_items(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for labels, child in self._child_items():
+            label_str = _label_str(self.label_names, labels)
+            for key, v in child.snapshot_items().items():
+                out[self._splice(key, label_str)] = v
+        return out
+
+    def render(self) -> List[str]:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.METRIC_TYPE}",
+        ]
+        for key, v in self.snapshot_items().items():
+            lines.append(f"{key} {v}")
+        return lines
+
+
 class MetricsRegistry:
     def __init__(self) -> None:
         self._metrics: Dict[str, Counter] = {}
@@ -205,7 +278,17 @@ class MetricsRegistry:
         return existing
 
     def histogram(self, name: str, help_text: str = "",
-                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+                  buckets: Sequence[float] = DEFAULT_BUCKETS,
+                  labels: Tuple[str, ...] = ()) -> Histogram:
+        if labels:
+            existing = self._existing(
+                name, LabeledHistogram, labels=labels, buckets=buckets
+            )
+            if existing is None:
+                existing = self._metrics[name] = LabeledHistogram(
+                    name, help_text, tuple(labels), buckets
+                )
+            return existing
         existing = self._existing(name, Histogram, buckets=buckets)
         if existing is None:
             existing = self._metrics[name] = Histogram(name, help_text, buckets)
@@ -223,7 +306,7 @@ class MetricsRegistry:
         bench/test can assert counter deltas without text parsing)."""
         out: Dict[str, float] = {}
         for m in self._metrics.values():
-            if isinstance(m, Histogram):
+            if isinstance(m, (Histogram, LabeledHistogram)):
                 out.update(m.snapshot_items())
                 continue
             for labels, v in m.items():
@@ -441,4 +524,97 @@ node_recovered = registry.counter(
     "training_node_recovered_total",
     "Nodes whose heartbeat resumed and were marked Ready again",
     ("node",),
+)
+# Controller-runtime metric parity (PR 7): per-KIND reconcile latency
+# (controller_runtime_reconcile_time_seconds{controller=...}) and the
+# workqueue add/retry families next to the existing depth gauge — the
+# aggregate training_operator_reconcile_seconds histogram predates this and
+# stays as the all-kinds view.
+reconcile_duration = registry.histogram(
+    "training_reconcile_duration_seconds",
+    "Wall time of one reconcile pass, by job kind",
+    labels=("kind",),
+)
+workqueue_adds = registry.counter(
+    "training_workqueue_adds_total",
+    "Keys enqueued into the manager workqueue (dedup'd adds not counted)", (),
+)
+workqueue_retries = registry.counter(
+    "training_workqueue_retries_total",
+    "Failed reconciles re-enqueued with backoff, by job kind",
+    ("kind",),
+)
+# Fleet introspection plane (observe/fleet.py): point-in-time gauges the
+# FleetCollector republishes every interval — "is the fleet healthy right
+# now" as scrapeable numbers. Aggregates only (no per-node labels): at 10k
+# nodes a per-node family would dwarf every other series in the registry.
+fleet_nodes = registry.gauge(
+    "training_fleet_nodes",
+    "Nodes by state (ready | notready | cordoned)",
+    ("state",),
+)
+fleet_chips_total = registry.gauge(
+    "training_fleet_chips_total", "Accelerator chips in the inventory", ()
+)
+fleet_chips_used = registry.gauge(
+    "training_fleet_chips_used",
+    "Accelerator chips held by bound non-terminal pods", (),
+)
+fleet_free_tpu_hosts = registry.gauge(
+    "training_fleet_free_tpu_hosts",
+    "TPU hosts with no accelerator pod bound", (),
+)
+fleet_whole_free_slices = registry.gauge(
+    "training_fleet_whole_free_slices",
+    "TPU slices whose every host is free (whole-slice gang capacity)", (),
+)
+fleet_podgroups = registry.gauge(
+    "training_fleet_podgroups",
+    "PodGroups by phase (gang queue depths)",
+    ("phase",),
+)
+fleet_jobs = registry.gauge(
+    "training_fleet_jobs",
+    "Jobs by kind and state (pending | running | succeeded | failed)",
+    ("kind", "state"),
+)
+fleet_objects = registry.gauge(
+    "training_fleet_objects",
+    "Objects in the store, by kind",
+    ("kind",),
+)
+fleet_journal_bytes = registry.gauge(
+    "training_fleet_journal_bytes",
+    "Bytes in the host store's current journal generation", (),
+)
+fleet_watch_sessions = registry.gauge(
+    "training_fleet_watch_sessions",
+    "Live server-side watch sessions", (),
+)
+fleet_resume_ring_events = registry.gauge(
+    "training_fleet_resume_ring_events",
+    "Watch events retained across all per-kind resume rings", (),
+)
+fleet_violations = registry.gauge(
+    "training_fleet_violations",
+    "Invariant violations currently active (past their rule's grace)", (),
+)
+# Standing invariant auditor (observe/invariants.py): one count per NEWLY
+# reported violation (a violation persisting across audits is one incident,
+# not one per pass — the gauge above carries "active right now").
+invariant_violations = registry.counter(
+    "training_invariant_violations_total",
+    "Invariant violations reported by the standing auditor, by rule id",
+    ("rule",),
+)
+# GET /fleet byte cache (wire_server): the fleet snapshot is rebuilt only
+# when the store version or the audit generation moved, so polling it from
+# `top`/autoscalers costs byte-copy, not an O(cluster) walk.
+wire_fleet_cache_hits = registry.counter(
+    "training_wire_fleet_cache_hits_total",
+    "GET /fleet responses served from the version-keyed snapshot cache", (),
+)
+wire_fleet_cache_misses = registry.counter(
+    "training_wire_fleet_cache_misses_total",
+    "GET /fleet snapshots rebuilt (store version or audit generation moved)", (),
 )
