@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast test-wire test-chaos test-fleet lint bench bench-quick bench-wire bench-wire-v2 bench-wire-resume bench-observe bench-audit bench-node-chaos dryrun operator-demo ha-demo native clean
+.PHONY: test test-fast test-wire test-chaos test-fleet test-tenancy lint bench bench-quick bench-wire bench-wire-v2 bench-wire-resume bench-observe bench-audit bench-node-chaos bench-tenancy dryrun operator-demo ha-demo native clean
 
 test:            ## full suite (no hardware needed; ~10 min)
 	$(PY) -m pytest tests/ -q
@@ -25,6 +25,9 @@ test-chaos:      ## the chaos/fault-injection lane: pod, store, wire, and node t
 
 test-fleet:      ## the fleet introspection lane: invariant rules, /fleet, top, event dedup
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py -q
+
+test-tenancy:    ## the multi-tenancy lane: quotas, priority, fair share, preemption
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tenancy.py -q
 
 lint:            ## project code lint: AST discipline rules + ruff (if present)
 	$(PY) -m training_operator_tpu.analysis.codelint training_operator_tpu
@@ -98,6 +101,14 @@ bench-audit:     ## auditor-overhead block (one JSON line + BENCH_SELF_AUDIT art
 # Running again, as one JSON line.
 bench-node-chaos:  ## node-loss MTTR block (one JSON line)
 	JAX_PLATFORMS=cpu $(PY) bench.py --node-chaos-only
+
+# N teams x M jobs over-subscribing one chip pool, arbiter off (FCFS) vs on,
+# on a virtual clock: Jain fairness over per-team mean running chips, p50/p99
+# schedule->Running per priority tier, preemption count, and the
+# checkpoint-resume proof (every preempted job Succeeded, >=1 resume from a
+# nonzero step, restart budget untouched).
+bench-tenancy:   ## contention fairness A/B block -> BENCH_SELF_TENANCY artifact
+	JAX_PLATFORMS=cpu $(PY) bench.py --tenancy-only
 
 native:          ## force-rebuild the C++ data-path core (drops the hash cache)
 	$(PY) -c "from training_operator_tpu import native; import glob, os; \

@@ -1349,6 +1349,266 @@ def run_node_chaos(heartbeat: float = 10.0, grace: float = 40.0,
     }
 
 
+# ---------------------------------------------------------------------------
+# Multi-tenant contention: N teams x M jobs over-subscribing one pool, the
+# fair-share arbiter (queues/quotas/priority/checkpoint-preemption) vs the
+# strict first-come gang scheduler. Jain fairness index, preemption count,
+# per-priority-tier latency percentiles.
+# ---------------------------------------------------------------------------
+
+
+def _jain(values):
+    vals = [float(v) for v in values]
+    total = sum(vals)
+    if total <= 0:
+        return None
+    return round(total * total / (len(vals) * sum(v * v for v in vals)), 4)
+
+
+def run_tenancy_contention(
+    teams: int = 4,
+    jobs_per_team: int = 12,
+    pool_slices: int = 8,
+    seed: int = 11,
+):
+    """The `tenancy` bench block: `teams` ClusterQueues with equal chip
+    quotas, each submitting `jobs_per_team` long 2x4 gangs into a pool
+    sized for exactly the sum of the quotas — over-subscribed ~3x. Team A
+    submits its entire backlog FIRST (the realistic burst skew FCFS
+    rewards), then a high-priority "prod" wave of whole-slice gangs lands
+    at t=60 on a saturated pool, so serving it requires checkpoint-aware
+    preemption.
+
+    Two identical legs: `fcfs` (arbiter off — strict submission order)
+    and `arbiter` (quota admission + DRF interleave + priority tiers +
+    preemption). Fairness is Jain's index over each team's mean running
+    chips while the pool is contended (until half the team jobs finish);
+    the prod tier's schedule-to-running percentiles show what priority
+    buys; and every preempted job must converge Succeeded with >= 1
+    checkpoint resume and an untouched restart budget — checked here, not
+    just claimed."""
+    import re as _re
+
+    from training_operator_tpu.cluster.objects import Event  # noqa: F401
+    from training_operator_tpu.controllers.jax import JAXController
+    from training_operator_tpu.engine.core import job_recreate_restarts
+    from training_operator_tpu.tenancy import (
+        ClusterQueue,
+        PriorityClass,
+        TenancyArbiter,
+        register_tenancy_admission,
+    )
+
+    team_names = [f"team-{chr(ord('a') + i)}" for i in range(teams)]
+    team_quota = pool_slices * float(CHIPS_PER_SLICE) / teams
+    rng = random.Random(seed)
+    durations = {
+        f"{t}-j{i}": rng.randint(240, 420)
+        for t in team_names
+        for i in range(jobs_per_team)
+    }
+
+    def team_gang(name, queue, prio, duration, workers=2, topology="2x4"):
+        chips = _chips(topology)
+        tmpl = PodTemplateSpec(
+            containers=[Container(name="jax", image="trainer",
+                                  resources={"cpu": 1.0, TPU_RESOURCE: 4.0})],
+            annotations={ANNOTATION_SIM_DURATION: str(duration)},
+        )
+        from training_operator_tpu.api.common import RunPolicy, SchedulingPolicy
+
+        return JAXJob(
+            metadata=ObjectMeta(name=name),
+            replica_specs={"Worker": ReplicaSpec(
+                replicas=workers, template=tmpl,
+                restart_policy=capi.RestartPolicy.EXIT_CODE,
+            )},
+            tpu_policy=TPUPolicy(accelerator=f"v5e-{chips}", topology=topology),
+            run_policy=RunPolicy(scheduling_policy=SchedulingPolicy(
+                queue=queue, priority_class=prio,
+            )),
+        )
+
+    def leg(arbiter_on: bool):
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_tpu_pool(pool_slices, slice_topology=SLICE_TOPOLOGY))
+        DefaultScheduler(cluster)
+        SimKubelet(cluster)
+        register_tenancy_admission(cluster.api)
+        arbiter = None
+        if arbiter_on:
+            arbiter = TenancyArbiter(
+                cluster.api, cluster.clock.now,
+                starvation_seconds=100_000.0,  # isolate quota/priority effects
+            )
+        GangScheduler(
+            cluster, TPUPacker(), charge_solve_time=True,
+            min_solve_interval=0.25, arbiter=arbiter,
+        )
+        mgr = OperatorManager(cluster, gang_enabled=True,
+                              reconciles_per_tick=4096)
+        mgr.register(JAXController(cluster.api))
+
+        # Same tenancy objects in BOTH legs: FCFS simply ignores them.
+        cluster.api.create(PriorityClass(
+            metadata=ObjectMeta(name="high"), value=1000))
+        cluster.api.create(PriorityClass(
+            metadata=ObjectMeta(name="normal"), value=500))
+        for t in team_names:
+            cluster.api.create(ClusterQueue(
+                metadata=ObjectMeta(name=t),
+                quota={TPU_RESOURCE: team_quota},
+                borrowing_limit={TPU_RESOURCE: team_quota},
+            ))
+        cluster.api.create(ClusterQueue(
+            metadata=ObjectMeta(name="prod"),
+            quota={TPU_RESOURCE: 2 * team_quota},
+        ))
+
+        # Burst skew: team-a's ENTIRE backlog enters the queue first.
+        team_jobs = {t: [] for t in team_names}
+        for t in team_names:
+            for i in range(jobs_per_team):
+                name = f"{t}-j{i}"
+                team_jobs[t].append(name)
+                mgr.submit(team_gang(name, t, "normal", durations[name]))
+        prod_jobs = [f"prod-p{i}" for i in range(teams)]
+
+        def prod_wave():
+            for name in prod_jobs:
+                mgr.submit(team_gang(name, "prod", "high", 120,
+                                     workers=4, topology="4x4"))
+
+        cluster.schedule_at(60.0, prod_wave)
+
+        # First-Running capture (preemption re-transitions must not
+        # overwrite the schedule-to-running instant).
+        running_at = {}
+        finished = set()
+        watch = cluster.api.watch(kinds={"JAXJob"})
+
+        def track():
+            for ev in watch.drain():
+                if ev.type != "Modified":
+                    continue
+                j = ev.obj
+                if capi.is_finished(j.status):
+                    finished.add(j.name)
+                if j.name in running_at:
+                    continue
+                cond = capi.get_condition(j.status, JobConditionType.RUNNING)
+                if cond is not None and cond.status:
+                    running_at[j.name] = cond.last_transition_time
+
+        cluster.add_ticker(track)
+
+        # Fairness sampling: each team's running chips every 5s while the
+        # pool is contended (until half the team jobs have finished).
+        all_team_jobs = [n for names in team_jobs.values() for n in names]
+        job_team = {n: n.rsplit("-j", 1)[0] for n in all_team_jobs}
+        samples = {t: [] for t in team_names}
+        state = {"next": 0.0, "open": True}
+
+        def sample_tick():
+            if not state["open"]:
+                return
+            now = cluster.clock.now()
+            if now < state["next"]:
+                return
+            state["next"] = now + 5.0
+            if sum(1 for n in all_team_jobs if n in finished) * 2 >= len(all_team_jobs):
+                state["open"] = False
+                return
+            by_team = {t: 0.0 for t in team_names}
+            for p in cluster.informer.list("Pod"):
+                if p.node_name and not p.is_terminal():
+                    team = job_team.get(
+                        p.metadata.labels.get("training.tpu.dev/job-name", ""))
+                    if team:
+                        by_team[team] += p.resources().get(TPU_RESOURCE, 0.0)
+            for t, chips in by_team.items():
+                samples[t].append(chips)
+
+        cluster.add_ticker(sample_tick)
+
+        everybody = all_team_jobs + prod_jobs
+        ok = cluster.run_until(
+            lambda: len(finished) >= len(everybody),
+            timeout=50_000, max_steps=5_000_000,
+        )
+        if not ok:
+            raise RuntimeError(
+                f"tenancy leg (arbiter={arbiter_on}) did not converge: "
+                f"{len(everybody) - len(finished)} jobs pending"
+            )
+
+        shares = {t: (sum(v) / len(v) if v else 0.0) for t, v in samples.items()}
+        lat = {
+            "normal": sorted(
+                running_at[n] for n in all_team_jobs if n in running_at
+            ),
+            "high": sorted(
+                running_at[n] - 60.0 for n in prod_jobs if n in running_at
+            ),
+        }
+        preempt_events = [
+            e for e in cluster.api.events(reason="Preempted")
+            if e.object_kind == "PodGroup"
+        ]
+        preempted_jobs = sorted({e.object_name for e in preempt_events})
+        resumes = {}
+        for name in preempted_jobs:
+            ckpt = 0.0
+            for e in cluster.api.events(object_name=name, reason="Requeued"):
+                m = _re.search(r"resumes from ([0-9.]+)s", e.message)
+                if m:
+                    ckpt = max(ckpt, float(m.group(1)))
+            resumes[name] = ckpt
+        preempted_ok = all(
+            capi.is_succeeded(cluster.api.get("JAXJob", "default", n).status)
+            and job_recreate_restarts(
+                cluster.api.get("JAXJob", "default", n)) == 0
+            and resumes.get(n, 0.0) > 0.0
+            for n in preempted_jobs
+        )
+        return {
+            "jain_fairness": _jain(shares.values()),
+            "team_mean_chips": {t: round(v, 1) for t, v in shares.items()},
+            "makespan_s": round(cluster.clock.now(), 1),
+            "p50_schedule_to_running_s": {
+                tier: round(_pct(v, 0.50), 1) for tier, v in lat.items()
+            },
+            "p99_schedule_to_running_s": {
+                tier: round(_pct(v, 0.99), 1) for tier, v in lat.items()
+            },
+            "preemptions": sum(e.count for e in preempt_events),
+            "preempted_jobs": preempted_jobs,
+            "preempted_all_succeeded_with_checkpoint_resume_and_budget":
+                preempted_ok if preempted_jobs else None,
+            "checkpointed_seconds_by_job": {
+                n: round(v, 1) for n, v in resumes.items()
+            },
+        }
+
+    fcfs = leg(False)
+    arb = leg(True)
+    return {
+        "teams": teams,
+        "jobs_per_team": jobs_per_team,
+        "pool_chips": pool_slices * float(CHIPS_PER_SLICE),
+        "team_quota_chips": team_quota,
+        "workload": (
+            "team-a's full backlog submitted first (burst skew), normal "
+            "priority, 240-420s 2x4 gangs; prod wave of whole-slice "
+            "high-priority gangs at t=60 on the saturated pool"
+        ),
+        "fcfs": fcfs,
+        "arbiter": arb,
+        "fairness_target": ">= 0.9 Jain with the arbiter on",
+        "fairness_met": (arb["jain_fairness"] or 0.0) >= 0.9,
+    }
+
+
 def _accelerator_reachable(timeout_s: float = 150.0) -> bool:
     """Probe the default JAX backend in a SUBPROCESS with a hard timeout.
 
@@ -1431,6 +1691,17 @@ def main():
                          "NotReady + unreachable taint")
     ap.add_argument("--node-toleration-seconds", type=float, default=30.0,
                     help="node-chaos block: taint age before eviction")
+    ap.add_argument("--tenancy-only", action="store_true",
+                    help="run only the multi-tenant contention block "
+                         "(N teams over-subscribing the pool, FCFS vs the "
+                         "fair-share arbiter: Jain index, preemptions, "
+                         "per-tier latency) and write --tenancy-out")
+    ap.add_argument("--tenancy-teams", type=int, default=4,
+                    help="teams/queues in the contention block")
+    ap.add_argument("--tenancy-jobs", type=int, default=12,
+                    help="jobs per team in the contention block")
+    ap.add_argument("--tenancy-out", default="BENCH_SELF_TENANCY_r11.json",
+                    help="artifact path for --tenancy-only")
     ap.add_argument("--audit", action="store_true",
                     help="run every burst under the standing invariant "
                          "auditor in fail-fast mode (observe/invariants.py): "
@@ -1477,6 +1748,23 @@ def main():
         }
         print(json.dumps(doc))
         with open(args.audit_out, "w") as f:
+            json.dump(doc, f, indent=1)
+        return
+
+    if args.tenancy_only:
+        block = run_tenancy_contention(
+            teams=args.tenancy_teams, jobs_per_team=args.tenancy_jobs,
+        )
+        doc = {
+            "metric": "tenancy_jain_fairness",
+            "value": block["arbiter"]["jain_fairness"],
+            "unit": "Jain index over per-team mean running chips during "
+                    "contention (1.0 = perfectly fair; arbiter leg)",
+            "vs_baseline": block["fcfs"]["jain_fairness"],
+            "tenancy": block,
+        }
+        print(json.dumps(doc))
+        with open(args.tenancy_out, "w") as f:
             json.dump(doc, f, indent=1)
         return
 

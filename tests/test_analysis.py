@@ -355,6 +355,124 @@ class TestAdmissionLint:
             mgr.submit(rt(name="Bad_Runtime_Name"))
 
 
+def tenancy_objects():
+    from training_operator_tpu.tenancy import ClusterQueue, PriorityClass
+
+    classes = [
+        PriorityClass(metadata=ObjectMeta(name="gold", namespace=""), value=900),
+        PriorityClass(metadata=ObjectMeta(name="bronze", namespace=""), value=10),
+    ]
+    queues = [
+        ClusterQueue(metadata=ObjectMeta(name="small-q", namespace=""),
+                     quota={TPU_RESOURCE: 4.0}),
+        ClusterQueue(metadata=ObjectMeta(name="big-q", namespace=""),
+                     quota={TPU_RESOURCE: 64.0},
+                     borrowing_limit={TPU_RESOURCE: 64.0}),
+        ClusterQueue(metadata=ObjectMeta(name="tight-q", namespace=""),
+                     quota={TPU_RESOURCE: 4.0},
+                     borrowing_limit={TPU_RESOURCE: 2.0}),
+    ]
+    return classes, queues
+
+
+def tenancy_job(queue=None, prio=None, name="tenant"):
+    from training_operator_tpu.tenancy import (
+        PRIORITY_CLASS_LABEL,
+        QUEUE_LABEL,
+    )
+
+    tj = job(name=name)
+    if queue is not None:
+        tj.labels[QUEUE_LABEL] = queue
+    if prio is not None:
+        tj.labels[PRIORITY_CLASS_LABEL] = prio
+    return tj
+
+
+# (case id, queue label, priority label, rule fired or None, severity)
+TENANCY_TABLE = [
+    ("ten001-unknown-priority-class",
+     None, "platinum", "TEN001", Severity.ERROR),
+    ("ten001-known-class-clean", None, "gold", None, None),
+    ("ten002-unknown-queue", "ghost-q", None, "TEN002", Severity.WARN),
+    ("ten002-quota-can-never-fit",
+     "small-q", None, "TEN002", Severity.WARN),
+    ("ten002-borrowing-still-too-small",
+     "tight-q", None, "TEN002", Severity.WARN),
+    ("ten002-big-queue-fits", "big-q", None, None, None),
+    ("tenancy-unlabeled-job-is-exempt", None, None, None, None),
+]
+
+
+class TestTenancyRules:
+    """TEN001/TEN002: tenancy references checked at lint/admission. The
+    rt() default gang is 2x4 = 8 chips; small-q caps at 4, tight-q at
+    4 + 2 borrowing, big-q comfortably fits it."""
+
+    @pytest.mark.parametrize(
+        "case,queue,prio,rule,severity",
+        TENANCY_TABLE,
+        ids=[c[0] for c in TENANCY_TABLE],
+    )
+    def test_table(self, case, queue, prio, rule, severity):
+        classes, queues = tenancy_objects()
+        report = analyze_trainjob(
+            tenancy_job(queue=queue, prio=prio), rt(),
+            priority_classes=classes, cluster_queues=queues,
+        )
+        if rule is None:
+            assert not report.diagnostics, f"{case}: {report.render()}"
+            return
+        assert report.has(rule), f"{case}: wanted {rule}, got {report.render()}"
+        fired = {d.rule_id for d in report.diagnostics if d.severity == severity}
+        assert fired == {rule}, f"{case}: extra {severity.value}s: {report.render()}"
+        if severity == Severity.ERROR:
+            assert not report.ok()
+        else:
+            assert report.ok(), report.render()
+
+    def test_rules_skipped_without_tenancy_inputs(self):
+        # None = "no tenancy view provided": the analyzer must never guess.
+        report = analyze_trainjob(
+            tenancy_job(queue="ghost-q", prio="platinum"), rt()
+        )
+        assert not report.has("TEN001") and not report.has("TEN002")
+
+    def test_ten_rules_documented(self):
+        for rule_id in ("TEN001", "TEN002"):
+            r = RULES[rule_id]
+            assert r.catches and r.fix and r.slug
+
+    def test_ten001_fatal_at_admission(self):
+        from training_operator_tpu.tenancy import PRIORITY_CLASS_LABEL
+
+        cluster, mgr = v2_env()
+        mgr.submit(rt())
+        bad = job(name="classless")
+        bad.labels[PRIORITY_CLASS_LABEL] = "no-such-class"
+        with pytest.raises(ValidationError) as ei:
+            mgr.submit(bad)
+        assert "TEN001" in str(ei.value)
+
+    def test_ten002_annotates_not_rejects(self):
+        from training_operator_tpu.tenancy import (
+            ClusterQueue, QUEUE_LABEL, register_tenancy_admission,
+        )
+
+        cluster, mgr = v2_env()
+        register_tenancy_admission(cluster.api)
+        cluster.api.create(ClusterQueue(
+            metadata=ObjectMeta(name="small-q"),
+            quota={TPU_RESOURCE: 4.0},
+        ))
+        mgr.submit(rt())
+        queued = job(name="squeezed")
+        queued.labels[QUEUE_LABEL] = "small-q"
+        mgr.submit(queued)
+        stored = cluster.api.get(TrainJob.KIND, "default", "squeezed")
+        assert "TEN002" in stored.annotations.get(LINT_ANNOTATION, "")
+
+
 class TestSDKLint:
     def test_lint_presubmit_object(self):
         from training_operator_tpu.sdk.client import TrainingClient

@@ -1,0 +1,739 @@
+"""Multi-tenant fleet scheduling: quota admission/borrow/reclaim, priority
+ordering, checkpoint-aware preemption, starvation guards, INV007, and the
+tenancy surfaces (wire kinds, /fleet queues, describe, top).
+
+Everything drives the public paths a deployment uses — ClusterQueue/
+PriorityClass objects in the store, jobs routed via RunPolicy's scheduling
+policy, the arbiter consulted by the gang scheduler — never by hand-setting
+arbitration state. Virtual clock throughout: every assertion is an exact
+instant, so admission order and preemption decisions are pinned, not raced.
+"""
+
+import json
+
+import pytest
+
+import training_operator_tpu.api.common as capi
+from training_operator_tpu.api.common import (
+    Container,
+    JobConditionType,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+)
+from training_operator_tpu.api.jobs import JAXJob, ObjectMeta, TPUPolicy
+from training_operator_tpu.api.validation import ValidationError
+from training_operator_tpu.cluster.inventory import TPU_RESOURCE, make_tpu_pool
+from training_operator_tpu.cluster.objects import PodGroupPhase, PodPhase
+from training_operator_tpu.cluster.runtime import (
+    ANNOTATION_SIM_DURATION,
+    Cluster,
+    DefaultScheduler,
+    SimKubelet,
+    VirtualClock,
+)
+from training_operator_tpu.cluster import wire
+from training_operator_tpu.controllers.jax import JAXController
+from training_operator_tpu.controllers.manager import OperatorManager
+from training_operator_tpu.engine.core import job_recreate_restarts
+from training_operator_tpu.observe.fleet import collect_fleet, render_queues, render_top
+from training_operator_tpu.observe.invariants import InvariantAuditor, RULES
+from training_operator_tpu.scheduler import GangScheduler, TPUPacker
+from training_operator_tpu.tenancy import (
+    PREEMPTION_NEVER,
+    ClusterQueue,
+    PriorityClass,
+    TenancyArbiter,
+    register_tenancy_admission,
+)
+
+SOLVE_TIMEOUT = 2000.0
+
+
+def make_env(starvation=100_000.0, max_preemptions=3, arbiter=True, slices=2):
+    cluster = Cluster(VirtualClock())
+    cluster.add_nodes(make_tpu_pool(slices, slice_topology="4x4"))
+    DefaultScheduler(cluster)
+    SimKubelet(cluster)
+    register_tenancy_admission(cluster.api)
+    arb = None
+    if arbiter:
+        arb = TenancyArbiter(
+            cluster.api, cluster.clock.now,
+            starvation_seconds=starvation, max_preemptions=max_preemptions,
+        )
+    sched = GangScheduler(cluster, TPUPacker(), arbiter=arb)
+    mgr = OperatorManager(cluster, gang_enabled=True)
+    mgr.register(JAXController(cluster.api))
+    return cluster, mgr, sched
+
+
+def priority_class(api, name, value, policy=None, default=False):
+    pc = PriorityClass(metadata=ObjectMeta(name=name), value=value,
+                       global_default=default)
+    if policy:
+        pc.preemption_policy = policy
+    return api.create(pc)
+
+
+def cluster_queue(api, name, chips, borrow=0.0, weight=1.0, namespaces=()):
+    return api.create(ClusterQueue(
+        metadata=ObjectMeta(name=name),
+        quota={TPU_RESOURCE: float(chips)},
+        borrowing_limit={TPU_RESOURCE: float(borrow)} if borrow else {},
+        weight=weight,
+        namespaces=list(namespaces),
+    ))
+
+
+def gang(name, queue="", prio="", duration="400", workers=4, topology="4x4"):
+    """One TPU gang: `workers` x 4-chip hosts of one `topology` sub-mesh."""
+    tmpl = PodTemplateSpec(
+        containers=[Container(name="jax", image="img",
+                              resources={"cpu": 1.0, TPU_RESOURCE: 4.0})],
+        annotations={ANNOTATION_SIM_DURATION: duration},
+    )
+    chips = 4 * workers
+    return JAXJob(
+        metadata=ObjectMeta(name=name),
+        replica_specs={"Worker": ReplicaSpec(
+            replicas=workers, template=tmpl,
+            restart_policy=RestartPolicy.EXIT_CODE,
+        )},
+        tpu_policy=TPUPolicy(accelerator=f"v5e-{chips}", topology=topology),
+        run_policy=RunPolicy(scheduling_policy=SchedulingPolicy(
+            queue=queue, priority_class=prio,
+        )),
+    )
+
+
+def running(cluster, name, after=-1.0):
+    job = cluster.api.get("JAXJob", "default", name)
+    c = capi.get_condition(job.status, JobConditionType.RUNNING)
+    return c is not None and c.status and c.last_transition_time > after
+
+
+def running_at(cluster, name):
+    job = cluster.api.get("JAXJob", "default", name)
+    c = capi.get_condition(job.status, JobConditionType.RUNNING)
+    return c.last_transition_time if c is not None and c.status else None
+
+
+def succeeded(cluster, name):
+    job = cluster.api.get("JAXJob", "default", name)
+    return capi.is_succeeded(job.status)
+
+
+def phase(cluster, name):
+    pg = cluster.api.try_get("PodGroup", "default", name)
+    return pg.phase if pg is not None else None
+
+
+# ---------------------------------------------------------------------------
+# Quota admission / borrowing / reclaim
+# ---------------------------------------------------------------------------
+
+
+class TestQuotaAdmission:
+    def test_quota_caps_admitted_chips(self):
+        cluster, mgr, _ = make_env()
+        cluster_queue(cluster.api, "team-a", chips=16)
+        mgr.submit(gang("a-1", queue="team-a", duration="50"))
+        mgr.submit(gang("a-2", queue="team-a", duration="50"))
+        assert cluster.run_until(lambda: running(cluster, "a-1"), timeout=60)
+        # Pool has a whole free slice, but the QUEUE is full: a-2 waits.
+        cluster.run_for(20.0)
+        assert not running(cluster, "a-2")
+        assert phase(cluster, "a-2") == PodGroupPhase.PENDING
+        evs = cluster.api.events(object_name="a-2", reason="QuotaExceeded")
+        assert evs and "team-a" in evs[0].message
+        # Quota frees when a-1 finishes: a-2 admits (reclaim-on-complete).
+        assert cluster.run_until(lambda: running(cluster, "a-2"),
+                                 timeout=SOLVE_TIMEOUT)
+
+    def test_borrowing_up_to_limit(self):
+        cluster, mgr, _ = make_env()
+        cluster_queue(cluster.api, "team-a", chips=16, borrow=16)
+        mgr.submit(gang("a-1", queue="team-a"))
+        mgr.submit(gang("a-2", queue="team-a"))
+        assert cluster.run_until(
+            lambda: running(cluster, "a-1") and running(cluster, "a-2"),
+            timeout=120,
+        )
+        fleet = collect_fleet(cluster.api, cluster.clock.now())
+        row = {r["queue"]: r for r in fleet["queues"]}["team-a"]
+        assert row["admitted_chips"] == 32.0
+        assert row["borrowed_chips"] == 16.0
+
+    def test_borrowing_limit_is_hard(self):
+        cluster, mgr, _ = make_env()
+        cluster_queue(cluster.api, "team-a", chips=16, borrow=8)
+        mgr.submit(gang("a-1", queue="team-a"))
+        mgr.submit(gang("a-2", queue="team-a"))
+        assert cluster.run_until(lambda: running(cluster, "a-1"), timeout=60)
+        cluster.run_for(30.0)
+        assert phase(cluster, "a-2") == PodGroupPhase.PENDING
+
+    def test_unknown_queue_waits_not_bypasses(self):
+        cluster, mgr, _ = make_env()
+        cluster_queue(cluster.api, "team-a", chips=16)
+        mgr.submit(gang("typo", queue="team-z", duration="50"))
+        cluster.run_for(30.0)
+        assert not running(cluster, "typo")
+        evs = cluster.api.events(object_name="typo", reason="QuotaExceeded")
+        assert evs and "does not exist" in evs[0].message
+        # Creating the queue (watch-driven re-arbitration) unblocks it.
+        cluster_queue(cluster.api, "team-z", chips=16)
+        assert cluster.run_until(lambda: running(cluster, "typo"),
+                                 timeout=SOLVE_TIMEOUT)
+
+    def test_no_tenancy_objects_is_passthrough(self):
+        cluster, mgr, _ = make_env()
+        mgr.submit(gang("j-1"))
+        mgr.submit(gang("j-2"))
+        assert cluster.run_until(
+            lambda: running(cluster, "j-1") and running(cluster, "j-2"),
+            timeout=120,
+        )
+
+    def test_namespace_default_queue_routing(self):
+        cluster, mgr, _ = make_env()
+        cluster_queue(cluster.api, "ns-queue", chips=16,
+                      namespaces=["default"])
+        mgr.submit(gang("a-1", duration="50"))  # names no queue
+        mgr.submit(gang("a-2", duration="50"))
+        assert cluster.run_until(lambda: running(cluster, "a-1"), timeout=60)
+        cluster.run_for(20.0)
+        # Routed into ns-queue by namespace: the 16-chip quota gates a-2.
+        assert phase(cluster, "a-2") == PodGroupPhase.PENDING
+
+    def test_reclaim_preempts_borrower(self):
+        cluster, mgr, _ = make_env()
+        cluster_queue(cluster.api, "team-a", chips=16, borrow=16)
+        cluster_queue(cluster.api, "team-b", chips=16)
+        mgr.submit(gang("a-1", queue="team-a", duration="500"))
+        mgr.submit(gang("a-2", queue="team-a", duration="500"))
+        assert cluster.run_until(
+            lambda: running(cluster, "a-1") and running(cluster, "a-2"),
+            timeout=120,
+        )
+        # team-b reclaims its NOMINAL share: the borrowing gang of team-a
+        # is displaced even at equal priority.
+        mgr.submit(gang("b-1", queue="team-b", duration="100"))
+        assert cluster.run_until(lambda: running(cluster, "b-1"),
+                                 timeout=SOLVE_TIMEOUT)
+        pgs = {p.name: p for p in cluster.api.list("PodGroup")}
+        preempted = [n for n, p in pgs.items() if p.preemption_count > 0]
+        assert len(preempted) == 1 and preempted[0].startswith("a-")
+
+    def test_reclaim_accounting_is_live_within_one_cycle(self):
+        # Two reclaimers arrive while team-a borrows ONE slice's worth.
+        # Planning the first eviction returns team-a to nominal quota, so
+        # the second reclaimer must see it as a non-borrower in the SAME
+        # planning pass and wait — stale accounting would displace both
+        # team-a gangs at equal priority for one slice of actual need.
+        cluster, mgr, _ = make_env()
+        cluster_queue(cluster.api, "team-a", chips=16, borrow=16)
+        cluster_queue(cluster.api, "team-b", chips=16)
+        cluster_queue(cluster.api, "team-c", chips=16)
+        mgr.submit(gang("a-1", queue="team-a", duration="500"))
+        mgr.submit(gang("a-2", queue="team-a", duration="500"))
+        assert cluster.run_until(
+            lambda: running(cluster, "a-1") and running(cluster, "a-2"),
+            timeout=120,
+        )
+        mgr.submit(gang("b-1", queue="team-b", duration="100"))
+        mgr.submit(gang("c-1", queue="team-c", duration="100"))
+        assert cluster.run_until(
+            lambda: running(cluster, "b-1") or running(cluster, "c-1"),
+            timeout=SOLVE_TIMEOUT,
+        )
+        pgs = {p.name: p for p in cluster.api.list("PodGroup")}
+        preempted = [n for n, p in pgs.items() if p.preemption_count > 0]
+        assert len(preempted) == 1 and preempted[0].startswith("a-")
+        # The surviving team-a gang keeps running; everyone converges once
+        # capacity actually frees (no futile double displacement).
+        assert cluster.run_until(
+            lambda: all(succeeded(cluster, n)
+                        for n in ("a-1", "a-2", "b-1", "c-1")),
+            timeout=2000,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Priority ordering + default class
+# ---------------------------------------------------------------------------
+
+
+class TestPriorityOrdering:
+    def test_high_priority_tier_solves_first(self):
+        cluster, mgr, _ = make_env(slices=1)
+        priority_class(cluster.api, "high", 1000)
+        priority_class(cluster.api, "low", 100)
+        # Both pending before the first solve; only one slice exists.
+        mgr.submit(gang("low-j", prio="low", duration="50"))
+        mgr.submit(gang("high-j", prio="high", duration="50"))
+        assert cluster.run_until(lambda: running(cluster, "high-j"),
+                                 timeout=60)
+        assert not running(cluster, "low-j")
+        # FIFO would have admitted low-j (created first): priority won.
+        assert cluster.run_until(lambda: running(cluster, "low-j"),
+                                 timeout=SOLVE_TIMEOUT)
+
+    def test_default_priority_class_stamped_from_config(self):
+        from training_operator_tpu import config as cfgmod
+
+        old = cfgmod.current()
+        try:
+            cfg = cfgmod.OperatorConfig(default_priority_class="bronze")
+            cfgmod.set_current(cfg)
+            cluster, mgr, _ = make_env()
+            priority_class(cluster.api, "bronze", 50)
+            mgr.submit(gang("plain"))
+            assert cluster.run_until(
+                lambda: phase(cluster, "plain") is not None, timeout=30
+            )
+            pg = cluster.api.get("PodGroup", "default", "plain")
+            assert pg.priority_class == "bronze"
+        finally:
+            cfgmod.set_current(old)
+
+    def test_explicit_class_stamped_on_podgroup(self):
+        cluster, mgr, _ = make_env()
+        priority_class(cluster.api, "gold", 900)
+        mgr.submit(gang("vip", prio="gold", queue="q1"))
+        assert cluster.run_until(
+            lambda: phase(cluster, "vip") is not None, timeout=30
+        )
+        pg = cluster.api.get("PodGroup", "default", "vip")
+        assert pg.priority_class == "gold"
+        assert pg.queue == "q1"
+
+
+# ---------------------------------------------------------------------------
+# Preemption: victims, checkpoints, budgets, guards
+# ---------------------------------------------------------------------------
+
+
+class TestPreemption:
+    def fill_and_preempt(self, max_preemptions=3):
+        cluster, mgr, sched = make_env(max_preemptions=max_preemptions)
+        priority_class(cluster.api, "high", 1000)
+        priority_class(cluster.api, "low", 100)
+        mgr.submit(gang("low-1", prio="low", duration="400"))
+        mgr.submit(gang("low-2", prio="low", duration="400"))
+        assert cluster.run_until(
+            lambda: running(cluster, "low-1") and running(cluster, "low-2"),
+            timeout=120,
+        )
+        cluster.run_for(50.0)
+        mgr.submit(gang("prod", prio="high", duration="100"))
+        assert cluster.run_until(lambda: running(cluster, "prod"),
+                                 timeout=SOLVE_TIMEOUT)
+        return cluster, mgr
+
+    def test_preemption_checkpoint_resume_round_trip(self):
+        cluster, mgr = self.fill_and_preempt()
+        pgs = {p.name: p for p in cluster.api.list("PodGroup")}
+        victims = [p for p in pgs.values() if p.preemption_count > 0]
+        assert len(victims) == 1
+        victim = victims[0]
+        assert victim.phase == PodGroupPhase.PENDING
+        assert victim.checkpointed_seconds == pytest.approx(50.0, abs=2.0)
+        assert cluster.api.events(object_name=victim.name, reason="Preempted")
+        assert cluster.api.events(object_name=victim.name, reason="Requeued")
+        # Everyone converges; the victim resumed from its checkpoint: with
+        # 50s saved it finishes ~350s after resuming, not 400.
+        assert cluster.run_until(
+            lambda: all(succeeded(cluster, n)
+                        for n in ("low-1", "low-2", "prod")),
+            timeout=SOLVE_TIMEOUT,
+        )
+        # Restart budget untouched: preemption rides the retryable path.
+        for n in ("low-1", "low-2", "prod"):
+            job = cluster.api.get("JAXJob", "default", n)
+            assert job_recreate_restarts(job) == 0
+        # A full re-run (step 0) of the victim would end at >= 50 + 100 +
+        # 400; checkpoint resume lands it a checkpoint earlier.
+        assert cluster.clock.now() < 50 + 100 + 400
+
+    def test_recreated_pod_runs_only_remaining_work(self):
+        cluster, mgr = self.fill_and_preempt()
+        victim = next(p for p in cluster.api.list("PodGroup")
+                      if p.preemption_count > 0)
+        assert cluster.run_until(
+            lambda: any(
+                p.status.phase == PodPhase.RUNNING
+                for p in cluster.api.list(
+                    "Pod", "default",
+                    {"training.tpu.dev/job-name": victim.name})
+            ),
+            timeout=SOLVE_TIMEOUT,
+        )
+        pod = cluster.api.list(
+            "Pod", "default", {"training.tpu.dev/job-name": victim.name}
+        )[0]
+        dur = float(pod.spec.annotations[ANNOTATION_SIM_DURATION])
+        assert dur == pytest.approx(400.0 - victim.checkpointed_seconds,
+                                    abs=2.0)
+
+    def test_preemption_picks_cheapest_victims(self):
+        cluster, mgr, _ = make_env()
+        priority_class(cluster.api, "high", 1000)
+        priority_class(cluster.api, "low", 100)
+        # slice-0: one whole-slice low gang (16 chips). slice-1: two
+        # half-slice low gangs (8 chips each). Staged so the pool fills
+        # deterministically regardless of batch-solve spreading.
+        mgr.submit(gang("big", prio="low", duration="500"))
+        assert cluster.run_until(lambda: running(cluster, "big"), timeout=60)
+        mgr.submit(gang("small-1", prio="low", duration="500",
+                        workers=2, topology="2x4"))
+        mgr.submit(gang("small-2", prio="low", duration="500",
+                        workers=2, topology="2x4"))
+        assert cluster.run_until(
+            lambda: all(running(cluster, n)
+                        for n in ("big", "small-1", "small-2")),
+            timeout=120,
+        )
+        # An 8-chip high gang needs one victim: the cheapest (8 chips),
+        # never the 16-chip whole-slice gang.
+        mgr.submit(gang("urgent", prio="high", duration="50",
+                        workers=2, topology="2x4"))
+        assert cluster.run_until(lambda: running(cluster, "urgent"),
+                                 timeout=SOLVE_TIMEOUT)
+        pgs = {p.name: p for p in cluster.api.list("PodGroup")}
+        assert pgs["big"].preemption_count == 0
+        displaced = [n for n in ("small-1", "small-2")
+                     if pgs[n].preemption_count > 0]
+        assert len(displaced) == 1
+
+    def test_never_policy_class_does_not_preempt(self):
+        cluster, mgr, _ = make_env(slices=1)
+        priority_class(cluster.api, "meek", 1000,
+                       policy=PREEMPTION_NEVER)
+        priority_class(cluster.api, "low", 100)
+        mgr.submit(gang("low-1", prio="low", duration="200"))
+        assert cluster.run_until(lambda: running(cluster, "low-1"),
+                                 timeout=60)
+        mgr.submit(gang("polite", prio="meek", duration="50"))
+        cluster.run_for(60.0)
+        assert not running(cluster, "polite")
+        pg = cluster.api.get("PodGroup", "default", "low-1")
+        assert pg.preemption_count == 0
+
+    def test_max_preemptions_immunity(self):
+        cluster, mgr, _ = make_env(slices=1, max_preemptions=1)
+        priority_class(cluster.api, "high", 1000)
+        priority_class(cluster.api, "low", 100)
+        mgr.submit(gang("victim", prio="low", duration="300"))
+        assert cluster.run_until(lambda: running(cluster, "victim"),
+                                 timeout=60)
+        cluster.run_for(20.0)
+        mgr.submit(gang("h-1", prio="high", duration="50"))
+        assert cluster.run_until(lambda: running(cluster, "h-1"),
+                                 timeout=SOLVE_TIMEOUT)
+        # Victim displaced once; resumes after h-1.
+        assert cluster.run_until(
+            lambda: running(cluster, "victim",
+                            after=running_at(cluster, "h-1") or 0.0),
+            timeout=SOLVE_TIMEOUT,
+        )
+        resumed_at = running_at(cluster, "victim")
+        mgr.submit(gang("h-2", prio="high", duration="50"))
+        cluster.run_for(60.0)
+        pg = cluster.api.get("PodGroup", "default", "victim")
+        assert pg.preemption_count == 1, "immune victim displaced again"
+        # h-2 waits for the victim to finish instead.
+        assert cluster.run_until(lambda: running(cluster, "h-2"),
+                                 timeout=SOLVE_TIMEOUT)
+
+
+class TestStarvationGuard:
+    def test_low_priority_eventually_runs(self):
+        # A CONTINUOUS high-priority stream (one fresh gang every 40s) on a
+        # one-slice pool: strict priority would starve the low gang until
+        # the stream dries up (t=300); the guard promotes it once it has
+        # waited 120s — and the promotion shields it from being preempted
+        # right back by the stream.
+        cluster, mgr, _ = make_env(slices=1, starvation=120.0)
+        priority_class(cluster.api, "high", 1000)
+        priority_class(cluster.api, "low", 100)
+        mgr.submit(gang("meek", prio="low", duration="50"))
+        mgr.submit(gang("h-0", prio="high", duration="50"))
+        for i in range(1, 6):
+            cluster.schedule_at(
+                40.0 * i,
+                lambda i=i: mgr.submit(gang(f"h-{i}", prio="high",
+                                            duration="50")),
+            )
+        assert cluster.run_until(lambda: running(cluster, "meek"),
+                                 timeout=SOLVE_TIMEOUT)
+        meek_at = running_at(cluster, "meek")
+        # Strict priority would run meek LAST (~t=300); the guard runs it
+        # as soon as it crosses the 120s starvation bound.
+        assert 120.0 <= meek_at < 250.0
+        assert cluster.run_until(
+            lambda: all(succeeded(cluster, f"h-{i}") for i in range(6)),
+            timeout=SOLVE_TIMEOUT,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Determinism under seeded contention
+# ---------------------------------------------------------------------------
+
+
+class TestDeterminism:
+    def _run(self):
+        import random
+
+        rng = random.Random(7)
+        cluster, mgr, _ = make_env()
+        priority_class(cluster.api, "high", 1000)
+        priority_class(cluster.api, "low", 100)
+        cluster_queue(cluster.api, "t-a", chips=16, borrow=16)
+        cluster_queue(cluster.api, "t-b", chips=16, borrow=16)
+        names = []
+        for i in range(8):
+            q = "t-a" if i % 2 == 0 else "t-b"
+            name = f"j{i}"
+            names.append(name)
+            mgr.submit(gang(name, queue=q, prio="low",
+                            duration=str(rng.randint(40, 120)),
+                            workers=2, topology="2x4"))
+        cluster.run_for(30.0)
+        mgr.submit(gang("hot", prio="high", duration="60"))
+        assert cluster.run_until(
+            lambda: all(succeeded(cluster, n) for n in names + ["hot"]),
+            timeout=SOLVE_TIMEOUT,
+        )
+        admitted = [
+            (e.object_name, round(e.timestamp, 3))
+            for e in cluster.api.events(reason="GangAdmitted")
+        ]
+        preempted = [
+            (e.object_name, round(e.timestamp, 3))
+            for e in cluster.api.events(reason="Preempted")
+            if e.object_kind == "PodGroup"
+        ]
+        return admitted, preempted
+
+    def test_same_seed_same_decisions(self):
+        first = self._run()
+        second = self._run()
+        assert first == second
+
+
+# ---------------------------------------------------------------------------
+# INV007 + the chaos matrix with the arbiter live
+# ---------------------------------------------------------------------------
+
+
+class TestInvariants:
+    def test_inv007_registered(self):
+        assert any(r.rule_id == "INV007" for r in RULES)
+
+    def test_inv007_fires_on_over_admission(self):
+        cluster, mgr, _ = make_env()
+        cluster_queue(cluster.api, "team-a", chips=16)
+        mgr.submit(gang("a-1", queue="team-a", duration="800"))
+        assert cluster.run_until(lambda: running(cluster, "a-1"), timeout=60)
+        # Shrink the quota below live usage: the arbiter never reclaims
+        # unpressured capacity, so the standing auditor must surface it.
+        cq = cluster.api.get("ClusterQueue", "", "team-a")
+        cq.quota = {TPU_RESOURCE: 8.0}
+        cluster.api.update(cq, check_version=False)
+        auditor = InvariantAuditor(cluster.api, cluster.clock.now)
+        assert auditor.audit() == []  # grace absorbs the first sighting
+        cluster.run_for(35.0)
+        violations = auditor.audit()
+        assert [v.rule for v in violations] == ["INV007"]
+        assert violations[0].name == "team-a"
+        assert "16" in violations[0].message
+
+    def test_inv007_clean_under_arbiter(self):
+        cluster, mgr, _ = make_env()
+        cluster_queue(cluster.api, "team-a", chips=16, borrow=8)
+        mgr.submit(gang("a-1", queue="team-a", duration="50"))
+        mgr.submit(gang("a-2", queue="team-a", duration="50"))
+        auditor = InvariantAuditor(cluster.api, cluster.clock.now)
+        assert cluster.run_until(
+            lambda: succeeded(cluster, "a-1") and succeeded(cluster, "a-2"),
+            timeout=SOLVE_TIMEOUT,
+        )
+        cluster.run_for(40.0)
+        assert auditor.audit() == []
+
+def test_chaos_matrix_with_tenancy():
+    """The PR 5/7 chaos matrix with queues, priorities, AND the fail-fast
+    auditor (all seven INV rules incl. INV007 quota accounting) live: pod
+    kills + node loss over a contested pool, every job still converges,
+    no invariant ever fires."""
+    from training_operator_tpu.cluster.chaos import ChaosMonkey, NodeChaos
+    from training_operator_tpu.controllers.nodelifecycle import (
+        NodeLifecycleController,
+    )
+    from training_operator_tpu.observe import FleetSources
+
+    cluster = Cluster(VirtualClock())
+    cluster.add_nodes(make_tpu_pool(4, slice_topology="4x4"))
+    DefaultScheduler(cluster)
+    kubelet = SimKubelet(cluster, heartbeat_interval=5.0)
+    NodeLifecycleController(cluster, grace_period=12.0, toleration_seconds=6.0)
+    register_tenancy_admission(cluster.api)
+    arb = TenancyArbiter(cluster.api, cluster.clock.now,
+                         starvation_seconds=100_000.0)
+    GangScheduler(cluster, TPUPacker(), arbiter=arb)
+    mgr = OperatorManager(cluster, gang_enabled=True)
+    mgr.register(JAXController(cluster.api))
+
+    priority_class(cluster.api, "high", 1000)
+    priority_class(cluster.api, "low", 100)
+    cluster_queue(cluster.api, "t-a", chips=32, borrow=16)
+    cluster_queue(cluster.api, "t-b", chips=32, borrow=16)
+
+    auditor = InvariantAuditor(
+        cluster.api, cluster.clock.now,
+        sources=FleetSources(expectations=mgr.unfulfilled_expectations),
+        interval=10.0, fail_fast=True, toleration_seconds=6.0,
+    ).attach(cluster)
+
+    names = []
+    for i in range(6):
+        name = f"c{i}"
+        names.append(name)
+        mgr.submit(gang(
+            name, queue="t-a" if i % 2 else "t-b",
+            prio="low" if i < 4 else "high",
+            duration="120", workers=2, topology="2x4",
+        ))
+
+    monkey = ChaosMonkey(cluster, kubelet, seed=5, interval=11.0, budget=3)
+    node_chaos = NodeChaos(cluster, kubelet, seed=9, interval=45.0, budget=2,
+                           recover_after=30.0)
+
+    def all_done():
+        return all(succeeded(cluster, n) for n in names)
+
+    assert cluster.run_until(all_done, timeout=20_000), (
+        "contested chaos burst did not converge"
+    )
+    monkey.stop()
+    node_chaos.stop()
+    # Quiescent close: fleet must audit clean after convergence too.
+    cluster.run_for(30.0)
+    assert auditor.audit() == []
+    assert auditor.audits > 0
+
+
+# ---------------------------------------------------------------------------
+# Wire, fleet, describe, admission surfaces
+# ---------------------------------------------------------------------------
+
+
+class TestSurfaces:
+    def test_tenancy_kinds_wire_roundtrip(self):
+        pc = PriorityClass(metadata=ObjectMeta(name="gold"), value=900,
+                           global_default=True, description="vip")
+        cq = ClusterQueue(
+            metadata=ObjectMeta(name="team-a"),
+            quota={TPU_RESOURCE: 64.0},
+            borrowing_limit={TPU_RESOURCE: 16.0},
+            weight=2.0, namespaces=["prod", "staging"],
+        )
+        for obj in (pc, cq):
+            data = json.loads(json.dumps(wire.encode(obj)))
+            back = wire.decode(data)
+            assert back == obj
+            # Compiled codec agrees with the reflection spec.
+            assert wire.reflect_encode(obj) == wire.encode(obj)
+
+    def test_podgroup_preemption_fields_roundtrip(self):
+        from training_operator_tpu.cluster.objects import PodGroup
+
+        pg = PodGroup(metadata=ObjectMeta(name="g"), preemption_count=2,
+                      last_preempted_at=12.5, checkpointed_seconds=99.25)
+        back = wire.decode(json.loads(json.dumps(wire.encode(pg))))
+        assert back.preemption_count == 2
+        assert back.checkpointed_seconds == 99.25
+        # Old payloads without the fields decode to the defaults.
+        data = wire.encode(pg)
+        for key in ("preemption_count", "last_preempted_at",
+                    "checkpointed_seconds"):
+            data.pop(key)
+        old = wire.decode(data)
+        assert old.preemption_count == 0
+
+    def test_admission_rejects_malformed_objects(self):
+        cluster, _, _ = make_env()
+        with pytest.raises(ValidationError):
+            cluster.api.create(ClusterQueue(
+                metadata=ObjectMeta(name="neg"),
+                quota={TPU_RESOURCE: -1.0},
+            ))
+        with pytest.raises(ValidationError):
+            cluster.api.create(ClusterQueue(
+                metadata=ObjectMeta(name="w0"), weight=0.0,
+            ))
+        with pytest.raises(ValidationError):
+            cluster.api.create(PriorityClass(
+                metadata=ObjectMeta(name="bad-policy"),
+                preemption_policy="Sometimes",
+            ))
+        with pytest.raises(ValidationError):
+            cluster.api.create(PriorityClass(
+                metadata=ObjectMeta(name="Bad_Name"), value=1,
+            ))
+
+    def test_fleet_queue_gauges_and_top(self):
+        from training_operator_tpu.observe.fleet import FleetCollector
+        from training_operator_tpu.utils import metrics
+
+        cluster, mgr, _ = make_env()
+        cluster_queue(cluster.api, "team-a", chips=16)
+        cluster_queue(cluster.api, "idle-q", chips=8)
+        mgr.submit(gang("a-1", queue="team-a", duration="200"))
+        mgr.submit(gang("a-2", queue="team-a", duration="200"))
+        assert cluster.run_until(lambda: running(cluster, "a-1"), timeout=60)
+        collector = FleetCollector(cluster, interval=5.0)
+        fleet = collector.collect()
+        rows = {r["queue"]: r for r in fleet["queues"]}
+        assert rows["team-a"]["admitted_chips"] == 16.0
+        assert rows["team-a"]["pending_chips"] == 16.0
+        assert rows["idle-q"]["admitted_chips"] == 0.0
+        assert metrics.queue_admitted_chips.value("team-a") == 16.0
+        assert metrics.queue_pending_chips.value("team-a") == 16.0
+        rendered = render_top(fleet)
+        assert "CLUSTERQUEUE" in rendered and "team-a" in rendered
+        assert "team-a" in render_queues(fleet["queues"])
+        collector.stop()
+
+    def test_describe_shows_tenancy_and_preempt_phase(self):
+        cluster, mgr, _ = make_env(slices=1)
+        priority_class(cluster.api, "high", 1000)
+        priority_class(cluster.api, "low", 100)
+        mgr.submit(gang("victim", prio="low", duration="300"))
+        assert cluster.run_until(lambda: running(cluster, "victim"),
+                                 timeout=60)
+        cluster.run_for(20.0)
+        mgr.submit(gang("hot", prio="high", duration="50"))
+        assert cluster.run_until(lambda: running(cluster, "hot"),
+                                 timeout=SOLVE_TIMEOUT)
+        from training_operator_tpu.observe import render_describe
+
+        text = render_describe(cluster.api, "default", "victim")
+        assert "Preemptions: 1" in text
+        assert "low" in text and "Queue:" in text
+        tl = cluster.api.get_timeline("default", "victim")
+        assert any(s.get("name") == "preempt" for s in tl["spans"])
+
+    def test_config_knob_validation(self):
+        from training_operator_tpu.config import OperatorConfig
+
+        with pytest.raises(ValueError):
+            OperatorConfig(tenancy_max_preemptions=-1).validate()
+        cfg = OperatorConfig(default_priority_class="x",
+                             tenancy_starvation_seconds=0.0)
+        cfg.validate()

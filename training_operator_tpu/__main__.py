@@ -188,6 +188,21 @@ def parse_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--aging-seconds", type=float, default=None,
                     help="tpu-packer starvation bound: gangs waiting longer "
                          "are promoted to FIFO front (default 300)")
+    ap.add_argument("--disable-tenancy", dest="tenancy_enabled",
+                    action="store_false", default=None,
+                    help="run the gang solver strictly first-come: no quota "
+                         "admission, no priority tiers, no preemption "
+                         "(tenancy/ arbiter off)")
+    ap.add_argument("--default-priority-class", default=None,
+                    help="PriorityClass for jobs that name none "
+                         "(default: unclassed, value 0)")
+    ap.add_argument("--tenancy-starvation-seconds", type=float, default=None,
+                    help="gangs pending longer bypass the priority tiers "
+                         "(FIFO front, quota still enforced; default 600, "
+                         "<=0 disables)")
+    ap.add_argument("--tenancy-max-preemptions", type=int, default=None,
+                    help="a gang displaced this many times becomes immune "
+                         "to further preemption (default 3)")
     ap.add_argument("--node-heartbeat-interval", type=float, default=None,
                     help="kubelet node-lease renewal period (default 10)")
     ap.add_argument("--node-grace-period", type=float, default=None,
@@ -244,6 +259,14 @@ def build_config(args: argparse.Namespace) -> OperatorConfig:
         cfg.max_drain_fraction = args.max_drain_fraction
     if args.aging_seconds is not None:
         cfg.aging_seconds = args.aging_seconds
+    if args.tenancy_enabled is not None:
+        cfg.tenancy_enabled = args.tenancy_enabled
+    if args.default_priority_class is not None:
+        cfg.default_priority_class = args.default_priority_class
+    if args.tenancy_starvation_seconds is not None:
+        cfg.tenancy_starvation_seconds = args.tenancy_starvation_seconds
+    if args.tenancy_max_preemptions is not None:
+        cfg.tenancy_max_preemptions = args.tenancy_max_preemptions
     if args.namespace is not None:
         cfg.namespace = args.namespace
     if args.node_heartbeat_interval is not None:
@@ -327,6 +350,10 @@ def wire_cluster_services(cluster: Cluster, cfg: OperatorConfig) -> None:
         NodeLifecycleController,
     )
     from training_operator_tpu.scheduler.elastic import HorizontalAutoscaler
+    from training_operator_tpu.tenancy import (
+        TenancyArbiter,
+        register_tenancy_admission,
+    )
 
     DefaultScheduler(cluster)
     SimKubelet(cluster, heartbeat_interval=cfg.node_heartbeat_interval)
@@ -336,6 +363,9 @@ def wire_cluster_services(cluster: Cluster, cfg: OperatorConfig) -> None:
         toleration_seconds=cfg.node_toleration_seconds,
     )
     HorizontalAutoscaler(cluster)
+    # Tenancy kinds are stored wherever the gang scheduler runs; their
+    # admission rides along so a malformed quota can't wedge the arbiter.
+    register_tenancy_admission(cluster.api)
     if cfg.gang_scheduler_name != "none":
         placer = {
             "tpu-packer": lambda: TPUPacker(
@@ -346,12 +376,21 @@ def wire_cluster_services(cluster: Cluster, cfg: OperatorConfig) -> None:
             "baseline": lambda: BaselinePlacer(whole_slice=True),
             "baseline-firstfit": lambda: BaselinePlacer(whole_slice=False),
         }[cfg.gang_scheduler_name]()
+        arbiter = None
+        if cfg.tenancy_enabled:
+            arbiter = TenancyArbiter(
+                cluster.api,
+                cluster.clock.now,
+                starvation_seconds=cfg.tenancy_starvation_seconds,
+                max_preemptions=cfg.tenancy_max_preemptions,
+            )
         GangScheduler(
             cluster,
             placer,
             prewarm=cfg.gang_scheduler_name == "tpu-packer",
             resolve_period=cfg.resolve_period,
             min_solve_interval=cfg.min_solve_interval,
+            arbiter=arbiter,
         )
 
 
@@ -857,6 +896,46 @@ def run_top(argv) -> int:
         print()
 
 
+def run_queues(argv) -> int:
+    """`python -m training_operator_tpu queues --api-server URL` — the
+    tenancy view: every ClusterQueue with its quota, admitted/pending/
+    borrowed chips (from GET /fleet's queues section, the same accounting
+    the arbiter admits against), and the PriorityClass catalog."""
+    import os as _os
+
+    ap = argparse.ArgumentParser(
+        prog="python -m training_operator_tpu queues",
+        description="ClusterQueue quota/usage and the PriorityClass catalog",
+    )
+    ap.add_argument("--api-server", required=True, metavar="URL",
+                    help="base URL of the serving host (WIRE_API=...)")
+    ap.add_argument("--api-token", default=None,
+                    help="bearer token (env TPU_OPERATOR_API_TOKEN)")
+    ap.add_argument("--ca-cert", default=None, metavar="PEM",
+                    help="CA bundle pinning an https host (WIRE_CA=...; "
+                         "env TPU_OPERATOR_CA_CERT)")
+    args = ap.parse_args(argv)
+    from training_operator_tpu.cluster.httpapi import RemoteAPIServer
+    from training_operator_tpu.observe.fleet import render_queues
+
+    api = RemoteAPIServer(
+        args.api_server,
+        token=args.api_token or _os.environ.get("TPU_OPERATOR_API_TOKEN") or None,
+        ca_file=args.ca_cert or _os.environ.get("TPU_OPERATOR_CA_CERT") or None,
+    )
+    classes = sorted(
+        api.list("PriorityClass"), key=lambda c: (-c.value, c.metadata.name)
+    )
+    print(render_queues(api.get_fleet().get("queues", [])))
+    if classes:
+        print()
+        print(f"{'PRIORITYCLASS':<20} {'VALUE':>12} {'PREEMPTION':<22} DEFAULT")
+        for c in classes:
+            print(f"{c.metadata.name:<20} {c.value:>12} "
+                  f"{c.preemption_policy:<22} {'*' if c.global_default else ''}")
+    return 0
+
+
 def run_node_verb(verb: str, argv) -> int:
     """`python -m training_operator_tpu cordon|uncordon|drain <node>` — the
     kubectl node-admin verbs against a serving host. Drain = cordon + evict
@@ -914,6 +993,8 @@ def main(argv=None) -> int:
         return run_describe(raw[1:])
     if raw and raw[0] == "top":
         return run_top(raw[1:])
+    if raw and raw[0] == "queues":
+        return run_queues(raw[1:])
     if raw and raw[0] in ("cordon", "uncordon", "drain"):
         return run_node_verb(raw[0], raw[1:])
     args = parse_args(argv)

@@ -151,6 +151,24 @@ RULES: Dict[str, Rule] = {
             "rate x job duration), or use an OnFailure/ExitCode restart "
             "policy on the trainer template",
         ),
+        Rule(
+            "TEN001", "priority-class-not-found", Severity.ERROR,
+            "the job names a PriorityClass that does not exist — it would "
+            "silently run unclassed (value 0, never preempting), which is "
+            "exactly the typo the k8s priority admission plugin rejects",
+            "create the PriorityClass first, or name an existing one "
+            "(tenancy.tpu.dev/priority-class label / "
+            "schedulingPolicy.priorityClass)",
+        ),
+        Rule(
+            "TEN002", "queue-can-never-fit", Severity.WARN,
+            "the job's ClusterQueue can never admit its gang: the queue "
+            "does not exist (the gang waits for it), or the gang's chip "
+            "demand exceeds the queue's quota + borrowing limit — it "
+            "would sit QuotaExceeded forever",
+            "raise the queue's quota/borrowing above the gang's demand, "
+            "shrink the gang, or route it to a bigger queue",
+        ),
     ]
 }
 

@@ -27,6 +27,7 @@ from training_operator_tpu.cluster.inventory import (
 from training_operator_tpu.cluster.objects import PodGroupPhase
 from training_operator_tpu.runtime.api import TRAINER_NODE, TrainingRuntime, TrainJob
 from training_operator_tpu.scheduler.candidates import CandidateCache, host_grid_dims
+from training_operator_tpu.tenancy.api import PRIORITY_CLASS_LABEL, QUEUE_LABEL
 
 # Shared across lint invocations: geometry classes are few, and webhook-path
 # lint runs per TrainJob create — re-enumerating per admission would be the
@@ -95,13 +96,17 @@ def analyze_trainjob(
     nodes: Optional[Iterable] = None,
     podgroups: Optional[Iterable] = None,
     target: str = "",
+    priority_classes: Optional[Iterable] = None,
+    cluster_queues: Optional[Iterable] = None,
 ) -> LintReport:
     """The full static dry-run for one TrainJob against its resolved runtime.
 
     `nodes` (any iterable of cluster Node objects, fake or live) enables the
     inventory-dependent rules (TPU002-vs-inventory, CAP001/CAP002);
-    `podgroups` enables the queue analysis (GANG001/GANG002, CAP002).
-    Either may be None — rules that need them are skipped, never guessed.
+    `podgroups` enables the queue analysis (GANG001/GANG002, CAP002);
+    `priority_classes`/`cluster_queues` enable the tenancy rules
+    (TEN001/TEN002). Any may be None — rules that need them are skipped,
+    never guessed.
     """
     report = LintReport(target=target or (job.name if job is not None else ""))
     trainer = job.trainer if job is not None else None
@@ -110,6 +115,17 @@ def analyze_trainjob(
         name = job.metadata.name
         if not is_dns1035_label(name):
             report.add("JOB001", f"{name!r} is not a DNS-1035 label", "metadata.name")
+
+    if job is not None and priority_classes is not None:
+        pc_name = job.labels.get(PRIORITY_CLASS_LABEL, "")
+        if pc_name and pc_name not in {
+            c.metadata.name for c in priority_classes
+        }:
+            report.add(
+                "TEN001",
+                f"PriorityClass {pc_name!r} does not exist",
+                f"labels[{PRIORITY_CLASS_LABEL}]",
+            )
 
     if runtime is None:
         ref = job.runtime_ref if job is not None else None
@@ -261,6 +277,36 @@ def analyze_trainjob(
             f"topology {tpu.topology} has {chips_per_slice}",
             "mlPolicy.tpu.accelerator",
         )
+
+    # Tenancy fit (TEN002): the gang's total chip demand against its
+    # ClusterQueue's hard ceiling (quota + borrowing, tenancy/api.py
+    # ClusterQueue.cap). Statically decidable from (spec, queue object) —
+    # but WARN, not reject: quotas are operator-mutable cluster state.
+    if job is not None and cluster_queues is not None:
+        q_name = job.labels.get(QUEUE_LABEL, "")
+        if q_name:
+            by_name = {q.metadata.name: q for q in cluster_queues}
+            queue = by_name.get(q_name)
+            if queue is None:
+                report.add(
+                    "TEN002",
+                    f"ClusterQueue {q_name!r} does not exist — the gang "
+                    "waits until it is created",
+                    f"labels[{QUEUE_LABEL}]",
+                )
+            else:
+                cap = queue.cap(TPU_RESOURCE)
+                if TPU_RESOURCE in queue.quota and total_chips > cap + 1e-9:
+                    report.add(
+                        "TEN002",
+                        f"gang needs {total_chips} chips but queue "
+                        f"{q_name!r} caps at {cap:g} "
+                        f"(quota {queue.quota.get(TPU_RESOURCE, 0.0):g} + "
+                        f"borrowing "
+                        f"{queue.borrowing_limit.get(TPU_RESOURCE, 0.0):g}) "
+                        "— it can never admit",
+                        f"labels[{QUEUE_LABEL}]",
+                    )
 
     # Whole-slice override discipline (plugins.WorkloadBuilderPlugin clamps).
     if trainer is not None and trainer.num_nodes is not None:

@@ -309,6 +309,20 @@ class PodGroup:
     reserved_nodes: List[str] = field(default_factory=list)
     placement_score: float = 0.0
     creation_attempts: int = 0
+    # Tenancy/preemption bookkeeping (tenancy/arbiter.py): how many times
+    # this gang was displaced, when last (fair-share debt: displaced gangs
+    # re-enter their queue's line first), and how much simulated progress
+    # was checkpointed before eviction — the engine subtracts it from the
+    # recreated pods' run time, the resume-from-step analogue of the
+    # trainer's own save/auto-resume.
+    preemption_count: int = 0
+    last_preempted_at: float = 0.0
+    checkpointed_seconds: float = 0.0
+    # True once the gang was admitted through the starvation guard (aged
+    # past tenancy_starvation_seconds while pending). Borg-style aging is
+    # a priority BOOST, so the promotion must also shield the gang from
+    # being preempted right back by the very tier it was promoted past.
+    starvation_promoted: bool = False
 
     KIND = "PodGroup"
 

@@ -44,6 +44,7 @@ from typing import Any, Callable, Dict, Optional
 from training_operator_tpu.api import jobs as jobs_api
 from training_operator_tpu.cluster import objects as cluster_objects
 from training_operator_tpu.runtime import api as runtime_api
+from training_operator_tpu.tenancy import api as tenancy_api
 from training_operator_tpu.utils import metrics
 
 # kind string -> class, for every kind the APIServer can store (plus Event,
@@ -68,6 +69,8 @@ KIND_REGISTRY: Dict[str, type] = {
         runtime_api.TrainJob,
         runtime_api.TrainingRuntime,
         runtime_api.ClusterTrainingRuntime,
+        tenancy_api.PriorityClass,
+        tenancy_api.ClusterQueue,
     )
 }
 

@@ -108,6 +108,22 @@ class OperatorConfig:
     # gauge republish, on the cluster clock. 0 disables both (the /fleet
     # route still serves the snapshot, just without live violations).
     fleet_audit_interval: float = 30.0
+    # Multi-tenancy (tenancy/): the fair-share arbiter in front of the
+    # gang solver. With no ClusterQueue/PriorityClass objects stored the
+    # arbiter is a FIFO passthrough, so it is safe to leave enabled.
+    #   default_priority_class — PriorityClass stamped onto PodGroups whose
+    #       job names none (RunPolicy.scheduling_policy.priority_class);
+    #       "" = unclassed (value 0, may not preempt).
+    #   tenancy_starvation_seconds — a gang pending longer than this
+    #       bypasses the priority tiers (FIFO front; never the quota gate)
+    #       so low-priority work eventually runs. <=0 disables.
+    #   tenancy_max_preemptions — a gang displaced this many times becomes
+    #       immune to further preemption (the victim-side starvation
+    #       guard; its checkpointed progress caps the work ever lost).
+    tenancy_enabled: bool = True
+    default_priority_class: str = ""
+    tenancy_starvation_seconds: float = 600.0
+    tenancy_max_preemptions: int = 3
     # Probe/metrics HTTP port; 0 disables (reference --health-probe-bind-
     # address / --metrics-bind-address, collapsed to one server here).
     health_port: int = 0
@@ -177,6 +193,8 @@ class OperatorConfig:
             raise ValueError("node_toleration_seconds must be >= 0")
         if self.fleet_audit_interval < 0:
             raise ValueError("fleet_audit_interval must be >= 0 (0 disables)")
+        if self.tenancy_max_preemptions < 0:
+            raise ValueError("tenancy_max_preemptions must be >= 0")
         if self.leader_lease_duration <= 0:
             # A non-positive lease is permanently expired: leadership would
             # flap between candidates every tick, each transition firing a

@@ -118,17 +118,18 @@ class BaseController:
 
     def permanent_failure(self, job: Job, pods: Sequence[Pod]) -> List[Pod]:
         """Failed pods that will NOT be restarted (policy Never, or ExitCode
-        with a permanent 1-127 code) — these fail the job. Node-lost pods
-        are never permanent: the engine recreates them under every policy
-        (triage's deleted-pod rule), so counting them here would fail a job
-        for losing hardware."""
+        with a permanent 1-127 code) — these fail the job. System-caused
+        failures (node loss, tenancy preemption) are never permanent: the
+        engine recreates them under every policy (triage's deleted-pod
+        rule), so counting them here would fail a job for losing hardware
+        or for being displaced by a higher-priority gang."""
         out = []
         for rtype, spec in job.replica_specs.items():
             policy = spec.restart_policy
             for p in core.filter_pods_for_replica_type(pods, rtype):
                 if p.status.phase != PodPhase.FAILED:
                     continue
-                if core.pod_failed_node_lost(p):
+                if core.pod_failed_system(p):
                     continue
                 code = p.status.exit_code(self.default_container_name())
                 if policy == capi.RestartPolicy.NEVER:

@@ -108,8 +108,9 @@ class PodGroupControl:
     POD_GROUP_ANNOTATION = "scheduling.tpu.dev/pod-group"
     SCHEDULER_NAME = "tpu-gang-scheduler"
 
-    def __init__(self, api: APIServer):
+    def __init__(self, api: APIServer, now_fn=None):
         self.api = api
+        self._now = now_fn
 
     def get_podgroup(self, namespace: str, name: str) -> Optional[PodGroup]:
         return self.api.try_get("PodGroup", namespace, name)
@@ -131,6 +132,11 @@ class PodGroupControl:
                 namespace=owner.namespace,
                 owner_uid=owner.uid,
                 labels={"job-kind": owner.kind},
+                # Cluster-clock birth stamp: the schedule-timeout check,
+                # the packer's aging, and the tenancy starvation guard all
+                # measure waiting from here — without it every wait-based
+                # rule degenerates (None reads as "waiting forever").
+                creation_time=self._now() if self._now is not None else None,
             ),
             min_member=min_member,
             min_resources=min_resources,

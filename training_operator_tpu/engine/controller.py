@@ -106,7 +106,7 @@ class JobController:
         self.expectations = ControllerExpectations(now_fn)
         self.pod_control = PodControl(api, now_fn)
         self.service_control = ServiceControl(api, now_fn)
-        self.podgroup_control = PodGroupControl(api)
+        self.podgroup_control = PodGroupControl(api, now_fn)
 
     # ------------------------------------------------------------------
     # Entry point
@@ -268,13 +268,15 @@ class JobController:
     def _triage_failed_pod(self, job: Job, rtype: str, spec, pod: Pod, exp_key: str) -> None:
         """Exit-code restart classification (reference common/pod.go:350-374).
 
-        Node-lost/evicted pods (NODE_LOST_MESSAGE_PREFIX) are retryable
-        regardless of restart policy — the reference's deleted-pod rule: the
-        hardware died, not the workload — and are NOT charged against the
-        recreate-restart budget that backs past_backoff_limit."""
+        System-caused failures — node-lost evictions (NODE_LOST_MESSAGE_
+        PREFIX) and tenancy preemptions (PREEMPTED_MESSAGE_PREFIX) — are
+        retryable regardless of restart policy (the reference's deleted-pod
+        rule: the hardware died or was reclaimed, the workload did nothing
+        wrong) and are NOT charged against the recreate-restart budget that
+        backs past_backoff_limit."""
         policy = spec.restart_policy or RestartPolicy.ON_FAILURE
         exit_code = pod.status.exit_code(self.controller.default_container_name())
-        node_lost = core.pod_failed_node_lost(pod)
+        node_lost = core.pod_failed_system(pod)
         restart = False
         if node_lost:
             restart = True
@@ -324,6 +326,25 @@ class JobController:
             if pg is not None and pod_name in pg.placement:
                 # tpu-packer emitted a binding for this pod: pin it.
                 template.node_selector["kubernetes.io/hostname"] = pg.placement[pod_name]
+            if pg is not None and pg.checkpointed_seconds > 0:
+                # Checkpoint-aware resume after preemption: the gang saved
+                # `checkpointed_seconds` of progress before it was displaced
+                # (tenancy/arbiter.py; the trainer's own save/auto-resume
+                # plays this role for real workloads). The recreated pod
+                # runs only the REMAINING work — resumed from step, not
+                # step 0.
+                from training_operator_tpu.cluster.runtime import (
+                    ANNOTATION_SIM_DURATION,
+                )
+
+                dur = template.annotations.get(ANNOTATION_SIM_DURATION)
+                if dur is not None:
+                    try:
+                        remaining = max(0.0, float(dur) - pg.checkpointed_seconds)
+                    except ValueError:
+                        remaining = None
+                    if remaining is not None:
+                        template.annotations[ANNOTATION_SIM_DURATION] = f"{remaining:g}"
 
         pod = Pod(
             metadata=ObjectMeta(
@@ -468,13 +489,24 @@ class JobController:
         pg = self.podgroup_control.get_podgroup(job.namespace, job.name)
         topo = job.tpu_policy.topology if job.tpu_policy else (sp.topology if sp else None)
         num_slices = job.tpu_policy.num_slices if job.tpu_policy else 1
+        # Tenancy routing: the spec's priority class (RunPolicy.scheduling_
+        # policy.priority_class — on the wire since the seed) is stamped
+        # onto the PodGroup so the fair-share arbiter and `describe` see
+        # it; a job naming none falls to the deployment's configured
+        # default class.
+        from training_operator_tpu import config as _config
+
+        priority_class = (sp.priority_class if sp else "") or (
+            _config.current().default_priority_class
+        )
+        queue = sp.queue if sp else ""
         if pg is None:
             pg = self.podgroup_control.create_podgroup(
                 job,
                 min_member=min_member,
                 min_resources=min_resources,
-                queue=sp.queue if sp else "",
-                priority_class=sp.priority_class if sp else "",
+                queue=queue,
+                priority_class=priority_class,
                 schedule_timeout_seconds=sp.schedule_timeout_seconds if sp else None,
                 topology_request=topo,
                 num_slices=num_slices,
@@ -483,6 +515,8 @@ class JobController:
             pg.min_member != min_member
             or pg.min_resources != min_resources
             or pg.topology_request != topo
+            or pg.priority_class != priority_class
+            or pg.queue != queue
         ):
             # num_slices is deliberately NOT force-synced here: on elastic
             # TPU resize the repack path owns the num_slices transition
@@ -491,6 +525,8 @@ class JobController:
             pg.min_member = min_member
             pg.min_resources = min_resources
             pg.topology_request = topo
+            pg.priority_class = priority_class
+            pg.queue = queue
             self.podgroup_control.update_podgroup(pg)
         return pg
 

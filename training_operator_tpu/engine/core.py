@@ -127,6 +127,29 @@ def pod_failed_node_lost(pod: Pod) -> bool:
     )
 
 
+# Message prefix stamped onto pods displaced by the tenancy arbiter (the
+# fair-share/priority preemption path, tenancy/arbiter.py preempt_pod).
+# Same triage contract as NODE_LOST: the workload did nothing wrong — the
+# fleet reclaimed its hardware — so the failure is retryable under EVERY
+# restart policy and never charged against the recreate-restart budget
+# (the victim resumes from its checkpoint with its budget intact).
+PREEMPTED_MESSAGE_PREFIX = "Preempted"
+
+
+def pod_failed_preempted(pod: Pod) -> bool:
+    return (
+        pod.status.phase == PodPhase.FAILED
+        and pod.status.message.startswith(PREEMPTED_MESSAGE_PREFIX)
+    )
+
+
+def pod_failed_system(pod: Pod) -> bool:
+    """Failures the SYSTEM caused (node loss, preemption), as opposed to
+    the workload's own exit — the one predicate engine triage and the
+    per-kind permanent-failure classifiers must agree on."""
+    return pod_failed_node_lost(pod) or pod_failed_preempted(pod)
+
+
 # Annotation tracking engine-driven delete+recreate restarts (ExitCode-policy
 # retryable failures), which recreate pods with restart_count=0 and would
 # otherwise never trip the backoff limit. The reference closes this gap with

@@ -26,6 +26,7 @@ PHASE_ORDER = (
     "gang_solve",
     "bind",
     "node_evict",
+    "preempt",
     "time_to_running",
     "total",
 )
@@ -158,6 +159,29 @@ def render_describe(api, namespace: str, name: str, max_events: int = 40) -> str
             )
     else:
         lines.append("  <none>")
+
+    pg = api.try_get("PodGroup", namespace, name)
+    if pg is not None:
+        lines.append("")
+        lines.append("Gang:")
+        phase = getattr(pg.phase, "value", str(pg.phase))
+        prio = ""
+        if pg.priority_class:
+            pc = api.try_get("PriorityClass", "", pg.priority_class)
+            prio = f"  PriorityClass: {pg.priority_class}"
+            if pc is not None:
+                prio += f" (value {pc.value})"
+            else:
+                prio += " (NOT FOUND)"
+        lines.append(
+            f"  Phase: {phase}  Queue: {pg.queue or '<none>'}{prio}"
+        )
+        if pg.preemption_count or pg.checkpointed_seconds:
+            lines.append(
+                f"  Preemptions: {pg.preemption_count}  "
+                f"Checkpointed: {pg.checkpointed_seconds:.1f}s "
+                f"(resumes from step, not step 0)"
+            )
 
     lines.append("")
     lines.append("Pods:")

@@ -131,6 +131,38 @@ def collect_fleet(api, now: float,
         phase = getattr(pg.phase, "value", str(pg.phase))
         podgroups[phase] = podgroups.get(phase, 0) + 1
 
+    # Tenancy queues: quota vs admitted/pending/borrowed, from the SAME
+    # accounting the arbiter admits against (tenancy/arbiter.py) so the
+    # `queues` CLI, the gauges, and admission can never disagree.
+    queue_rows: List[Dict[str, Any]] = []
+    cluster_queues = list(api.list_refs("ClusterQueue"))
+    if cluster_queues:
+        from training_operator_tpu.tenancy.arbiter import (
+            admitted_usage,
+            pending_usage,
+        )
+
+        by_name = {q.metadata.name: q for q in cluster_queues}
+        admitted = admitted_usage(groups, by_name)
+        pending = pending_usage(groups, by_name)
+        for name in sorted(by_name):
+            q = by_name[name]
+            held = admitted.get(name, {})
+            chips_held = held.get(TPU_RESOURCE, 0.0)
+            quota_chips = q.quota.get(TPU_RESOURCE, 0.0)
+            queue_rows.append({
+                "queue": name,
+                "weight": q.weight,
+                "quota": dict(q.quota),
+                "borrowing_limit": dict(q.borrowing_limit),
+                "admitted": dict(held),
+                "pending": dict(pending.get(name, {})),
+                "admitted_chips": chips_held,
+                "pending_chips": pending.get(name, {}).get(TPU_RESOURCE, 0.0),
+                "borrowed_chips": max(0.0, chips_held - quota_chips),
+                "quota_chips": quota_chips,
+            })
+
     jobs: Dict[str, Dict[str, int]] = {}
     for kind in ("TrainJob", *JOB_KINDS):
         counts: Dict[str, int] = {}
@@ -172,6 +204,7 @@ def collect_fleet(api, now: float,
             1 for s in slices.values() if s["free_hosts"] == s["hosts"]
         ),
         "podgroups": podgroups,
+        "queues": queue_rows,
         "queue": {
             "pending_gangs": podgroups.get("Pending", 0)
             + podgroups.get("Unschedulable", 0),
@@ -268,6 +301,16 @@ class FleetCollector:
             (kind,): float(count)
             for kind, count in fleet["objects"].items()
         })
+        queues = fleet.get("queues") or []
+        self._set_family(metrics.queue_admitted_chips, {
+            (row["queue"],): float(row["admitted_chips"]) for row in queues
+        })
+        self._set_family(metrics.queue_pending_chips, {
+            (row["queue"],): float(row["pending_chips"]) for row in queues
+        })
+        self._set_family(metrics.queue_borrowed_chips, {
+            (row["queue"],): float(row["borrowed_chips"]) for row in queues
+        })
         store = fleet["store"]
         if "journal_bytes" in store:
             metrics.fleet_journal_bytes.set(
@@ -293,6 +336,25 @@ def _bar(used: float, total: float, width: int = 20) -> str:
         return "-" * width
     filled = int(round(width * min(1.0, used / total)))
     return "#" * filled + "." * (width - filled)
+
+
+def render_queues(queue_rows: List[Dict[str, Any]]) -> str:
+    """Table of one fleet snapshot's tenancy queues (the `queues` CLI and
+    `top`'s CLUSTERQUEUE section share this renderer)."""
+    if not queue_rows:
+        return "clusterqueues: none"
+    lines = [
+        f"  {'CLUSTERQUEUE':<16} {'WEIGHT':>6} {'QUOTA':>8} {'ADMITTED':>9} "
+        f"{'BORROWED':>9} {'PENDING':>8} UTIL"
+    ]
+    for row in queue_rows:
+        lines.append(
+            f"  {row['queue']:<16} {row['weight']:>6.1f} "
+            f"{row['quota_chips']:>8.0f} {row['admitted_chips']:>9.0f} "
+            f"{row['borrowed_chips']:>9.0f} {row['pending_chips']:>8.0f} "
+            f"{_bar(row['admitted_chips'], row['quota_chips'])}"
+        )
+    return "\n".join(lines)
 
 
 def render_top(fleet: Dict[str, Any]) -> str:
@@ -342,6 +404,10 @@ def render_top(fleet: Dict[str, Any]) -> str:
         f"{q['workqueue_depth']:.0f}  expectations "
         f"{q['unfulfilled_expectations']}"
     )
+
+    if fleet.get("queues"):
+        lines.append("")
+        lines.append(render_queues(fleet["queues"]))
 
     if fleet["jobs"]:
         lines.append("")

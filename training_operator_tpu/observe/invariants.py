@@ -30,6 +30,11 @@ reference table cannot drift against scattered registrations):
   INV006 condition-disagreement  a terminal TrainJob whose same-named
                                  workload job holds the OPPOSITE terminal
                                  condition (v2 status sync broke)
+  INV007 quota-over-admission    a ClusterQueue whose admitted gangs hold
+                                 more of a quota'd resource than quota +
+                                 borrowing allows (the arbiter's admission
+                                 accounting broke, or a quota was shrunk
+                                 below live usage and never reclaimed)
 
 Mechanics: every rule returns *candidates*; the auditor tracks first-seen
 times and reports a violation only once it has persisted past the rule's
@@ -361,9 +366,43 @@ register_invariant(InvariantRule(
     "INV005", "journal or resume ring over its configured bound",
     _check_storage_bounds, grace=60.0,  # compaction runs from the host loop
 ))
+def _check_quota_over_admission(ctx: AuditContext) -> List[Violation]:
+    # THE accounting is tenancy/arbiter.admitted_usage — the same function
+    # the arbiter admits against and the fleet gauges publish, so the
+    # auditor can only fire when the bound itself is broken, never from a
+    # parallel reimplementation drifting.
+    from training_operator_tpu.tenancy.arbiter import admitted_usage
+
+    queues = {q.metadata.name: q for q in ctx.list("ClusterQueue")}
+    if not queues:
+        return []
+    usage = admitted_usage(ctx.list("PodGroup"), queues)
+    out = []
+    for name in sorted(queues):
+        q = queues[name]
+        held = usage.get(name, {})
+        over = [
+            f"{res}: {held.get(res, 0.0):g} > {q.cap(res):g} "
+            f"(quota {q.quota.get(res, 0.0):g} + borrowing "
+            f"{q.borrowing_limit.get(res, 0.0):g})"
+            for res in sorted(q.quota)
+            if held.get(res, 0.0) > q.cap(res) + 1e-9
+        ]
+        if over:
+            out.append(Violation(
+                "INV007", "ClusterQueue", "", name,
+                "admitted gangs exceed quota + borrowing — " + "; ".join(over),
+            ))
+    return out
+
+
 register_invariant(InvariantRule(
     "INV006", "TrainJob and workload job disagree on the terminal condition",
     _check_condition_disagreement, grace=60.0,  # one v2 resync heals it
+))
+register_invariant(InvariantRule(
+    "INV007", "queue admitted usage exceeds quota + borrowing",
+    _check_quota_over_admission,
 ))
 
 

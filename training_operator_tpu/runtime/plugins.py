@@ -177,11 +177,21 @@ class WorkloadBuilderPlugin:
             annotations=dict(job.annotations),
             owner_uid=job.uid,
         )
+        # Tenancy routing rides the TrainJob's labels (the kueue
+        # queue-name-label pattern) onto the workload's scheduling policy,
+        # which the engine stamps onto the PodGroup the arbiter reads.
+        from training_operator_tpu.tenancy.api import (
+            PRIORITY_CLASS_LABEL,
+            QUEUE_LABEL,
+        )
+
         workload.run_policy = RunPolicy(
             suspend=job.suspend,
             scheduling_policy=SchedulingPolicy(
                 min_available=info.scheduler.total_members or None,
                 schedule_timeout_seconds=info.scheduler.schedule_timeout_seconds,
+                queue=job.labels.get(QUEUE_LABEL, ""),
+                priority_class=job.labels.get(PRIORITY_CLASS_LABEL, ""),
             ),
         )
         return [workload]

@@ -33,8 +33,13 @@ LINT_ANNOTATION = "lint.tpu.dev/warnings"
 # and heuristics (ENV001, TPU005, NODE001, RT00x) stay advisory: cluster
 # state changes, admission decisions must not.
 ADMISSION_FATAL_RULES = frozenset(
-    {"TPU001", "TPU002", "TPU003", "TPU004", "POL001", "POL002"}
+    {"TPU001", "TPU002", "TPU003", "TPU004", "POL001", "POL002", "TEN001"}
 )
+# TEN001 (nonexistent PriorityClass) is fatal for the same reason the k8s
+# priority admission plugin rejects it: the job would silently run
+# unclassed. TEN002 (queue can never fit) stays advisory — quotas are
+# operator-mutable cluster state, and admission decisions must not depend
+# on what an operator might raise tomorrow.
 
 
 def validate_trainjob(job: TrainJob) -> None:
@@ -103,10 +108,24 @@ def lint_trainjob_admission(api, job: TrainJob) -> None:
     # spec-only rules.
     tpu = runtime.spec.ml_policy.tpu if runtime is not None else None
     nodes = api.list("Node") if tpu is not None and tpu.topology else None
+    from training_operator_tpu.tenancy.api import (
+        PRIORITY_CLASS_LABEL,
+        QUEUE_LABEL,
+    )
+
+    # Tenancy rules only pay their (tiny) list when the job opts into the
+    # tenancy plane at all.
+    pcs = (
+        api.list("PriorityClass")
+        if job.labels.get(PRIORITY_CLASS_LABEL) else None
+    )
+    cqs = api.list("ClusterQueue") if job.labels.get(QUEUE_LABEL) else None
     report = analyze_trainjob(
         job, runtime,
         nodes=nodes if nodes else None,
         podgroups=api.list("PodGroup") if nodes else None,
+        priority_classes=pcs,
+        cluster_queues=cqs,
     )
     for d in report.diagnostics:
         metrics.lint_diagnostics.inc(d.rule_id, d.severity.value)

@@ -61,10 +61,16 @@ class GangScheduler:
         prewarm: bool = False,
         resolve_period: float = 15.0,
         min_solve_interval: float = 0.0,
+        arbiter=None,
     ):
         self.cluster = cluster
         self.api = cluster.api
         self.placer = placer
+        # Fair-share arbiter (tenancy/arbiter.py): quota admission,
+        # priority-tiered solving, and checkpoint-aware preemption in
+        # front of the placer. None = strict first-come (the pre-tenancy
+        # behavior, and the bench's FCFS baseline).
+        self.arbiter = arbiter
         # Compile the placer for this pool before the first cycle (one-time
         # XLA compile; belongs to operator startup, not to job latency).
         self._needs_prewarm = prewarm and hasattr(placer, "prewarm")
@@ -224,6 +230,10 @@ class GangScheduler:
                 self._solve_dirty = True
                 self._bind_dirty = True
                 self._capacity_freed = True
+            elif kind in ("ClusterQueue", "PriorityClass"):
+                # A tenancy edit (quota raised, class re-valued) can free a
+                # quota-blocked gang or reorder the queue — re-arbitrate.
+                self._solve_dirty = True
             elif (
                 ev.type == "Modified"
                 and not ev.status_only
@@ -363,17 +373,70 @@ class GangScheduler:
         self._last_solve_at = now
         if not requests:
             return
-        placements = self.placer.place(requests, snapshot, now=now)
+        blocked = []
+        priorities: Dict[str, int] = {}
+        starved_keys: set = set()
+        if self.arbiter is not None:
+            arb = self.arbiter.arbitrate(requests, self._groups.values(), now)
+            blocked = arb.blocked
+            priorities = arb.priorities
+            starved_keys = arb.starved
+            solved: List = []
+            placements = {}
+            # One placer call per priority tier (descending): place()
+            # commits admitted reservations into the shared snapshot, so
+            # later tiers solve against the capacity the higher tiers
+            # took — the solver can never trade a high-priority gang away
+            # for better packing of a lower one.
+            for tier in arb.tiers:
+                placements.update(self.placer.place(tier, snapshot, now=now))
+                solved.extend(tier)
+        else:
+            solved = requests
+            placements = self.placer.place(requests, snapshot, now=now)
         wall = time.perf_counter() - t0
         self.solve_walltime_total += wall
         self.cycles += 1
         metrics.scheduler_solve_seconds.observe(wall)
-        self._record_trace(now, wall, requests, placements, snapshot)
+        self._record_trace(now, wall, solved, placements, snapshot)
         if self.charge_solve_time and isinstance(self.cluster.clock, VirtualClock):
             self.cluster.clock.advance(wall)
 
+        for req, _queue_name, reason in blocked:
+            # Stays Pending; aggregation (stable message) collapses the
+            # per-cycle repeats into one Event with a count. Quota blocks
+            # deliberately don't count as Unschedulable attempts — the
+            # placement may be perfectly feasible, the queue is just full.
+            self._event(req.group, "Warning", "QuotaExceeded", reason)
+
+        if self.arbiter is not None:
+            unplaced = [r for r in solved if placements.get(r.key) is None]
+            executed = 0
+            for decision in self.arbiter.plan_preemptions(
+                unplaced, priorities, self._groups.values(), snapshot, now
+            ):
+                if self._preempt_group(decision):
+                    executed += 1
+            if executed:
+                # Same-cycle re-solve: absorb the eviction writes into the
+                # informer caches, rebuild the snapshot, and hand the
+                # freed capacity to the still-unplaced tiers (highest
+                # first) NOW — deferring to the next cycle would let a
+                # lower tier backfill the holes the evictions just made,
+                # and the victims would be displaced for nothing.
+                self._drain_events()
+                snapshot = self._snapshot()
+                for tier in arb.tiers:
+                    retry = [
+                        r for r in tier if placements.get(r.key) is None
+                    ]
+                    if retry:
+                        placements.update(
+                            self.placer.place(retry, snapshot, now=now)
+                        )
+
         now = self.cluster.clock.now()
-        for req in requests:
+        for req in solved:
             pg = req.group
             placement = placements.get(req.key)
             if placement is not None:
@@ -384,6 +447,11 @@ class GangScheduler:
                 live.reserved_nodes = list(placement.reserved_nodes)
                 live.placement_score = placement.score
                 live.phase = PodGroupPhase.INQUEUE
+                if req.key in starved_keys:
+                    # Aged past the starvation bound while pending: the
+                    # promotion persists as preemption immunity (see
+                    # PodGroup.starvation_promoted).
+                    live.starvation_promoted = True
                 if self._persist(live):
                     metrics.podgroups_admitted.inc()
                     self._event(live, "Normal", "GangAdmitted",
@@ -410,6 +478,66 @@ class GangScheduler:
         # Our own admission writes (phase -> INQUEUE) echo back through the
         # watch but do not match any dirty rule, so they don't force a
         # redundant re-solve next tick.
+
+    def _preempt_group(self, decision) -> bool:
+        """Execute one arbiter preemption: checkpoint the victim's
+        progress, evict its members via the retryable PREEMPTED path (no
+        restart budget consumed — engine triage), record the fair-share
+        debt, and reset the gang to Pending for a later re-solve. The
+        symmetric twin of `_invalidate_group`, with bookkeeping instead of
+        a dead node."""
+        pg = self._groups.get(decision.victim_key)
+        if pg is None or pg.phase not in (PodGroupPhase.INQUEUE, PodGroupPhase.RUNNING):
+            return False
+        live = self._fresh_for_write(pg)
+        if live is None or live.phase not in (
+            PodGroupPhase.INQUEUE, PodGroupPhase.RUNNING
+        ):
+            return False
+        from training_operator_tpu.tenancy.arbiter import preempt_pod
+
+        now = self.cluster.clock.now()
+        # Checkpoint signal: the victim saves before it dies (the
+        # trainer's save/auto-resume contract); in the substrate the saved
+        # progress is this run's elapsed time, accumulated across
+        # preemptions so a twice-displaced gang still resumes from its
+        # LATEST step.
+        progress = 0.0
+        for pod in list(self._group_pods.get(decision.victim_key, {}).values()):
+            if (
+                pod.status.phase == PodPhase.RUNNING
+                and pod.status.start_time is not None
+            ):
+                progress = max(progress, now - pod.status.start_time)
+            preempt_pod(self.api, pod, decision.reason, now)
+        live.checkpointed_seconds += progress
+        live.preemption_count += 1
+        live.last_preempted_at = now
+        live.placement = {}
+        live.reserved_nodes = []
+        live.phase = PodGroupPhase.PENDING
+        persisted = self._persist(live)
+        if persisted:
+            metrics.gang_preemptions.inc(decision.queue)
+            self._event(
+                live, "Warning", "Preempted",
+                f"{decision.reason}; checkpointed {progress:.1f}s",
+            )
+            self._event(
+                live, "Normal", "Requeued",
+                f"requeued after preemption #{live.preemption_count}; "
+                f"resumes from {live.checkpointed_seconds:.1f}s of saved progress",
+            )
+            self.api.timelines.record_span(
+                live.namespace, live.name, live.metadata.owner_uid or "",
+                "preempt", start=now, end=now,
+                preemptor=decision.preemptor_key,
+                queue=decision.queue,
+                checkpointed_s=round(progress, 3),
+            )
+        self._solve_dirty = True
+        self._bind_dirty = True
+        return persisted
 
     def _process_invalidations(self) -> None:
         if not self._lost_groups:

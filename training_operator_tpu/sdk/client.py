@@ -406,6 +406,23 @@ class TrainingClient:
 
         return drain_node(self.api, name, now=self.cluster.clock.now())
 
+    # -- tenancy (queues, priority) ----------------------------------------
+
+    def create_priority_class(self, pc):
+        """Store a tenancy PriorityClass (tenancy/api.py) — admission
+        validates it wherever the store lives (host role or in-process)."""
+        return self.api.create(pc)
+
+    def create_cluster_queue(self, cq):
+        """Store a tenancy ClusterQueue (per-team quota/borrowing/weight)."""
+        return self.api.create(cq)
+
+    def list_priority_classes(self) -> List[Any]:
+        return self.api.list("PriorityClass")
+
+    def list_cluster_queues(self) -> List[Any]:
+        return self.api.list("ClusterQueue")
+
     # -- static analysis ---------------------------------------------------
 
     def lint(self, job: Union[TrainJob, str], namespace: Optional[str] = None):
@@ -447,6 +464,8 @@ class TrainingClient:
             nodes=nodes if nodes else None,
             podgroups=self.api.list("PodGroup"),
             target=job.metadata.name,
+            priority_classes=self.api.list("PriorityClass"),
+            cluster_queues=self.api.list("ClusterQueue"),
         )
 
     # -- high-level fine-tune ---------------------------------------------

@@ -1,0 +1,55 @@
+"""Multi-tenant fleet scheduling: queues, quotas, priority, preemption.
+
+The subsystem the reference delegates to sigs.k8s.io/kueue + volcano.sh
+(SURVEY.md §deps, §2.3): a contested TPU fleet needs per-team quota
+(ClusterQueue), job importance (PriorityClass), a fair-share arbiter in
+front of the gang solver, and checkpoint-aware preemption so a displaced
+TrainJob resumes from its saved step instead of step 0.
+
+Layout:
+  api.py      PriorityClass / ClusterQueue kinds + validation + admission
+  arbiter.py  quota accounting, DRF-style ordering, preemption planning,
+              and the pod-preemption primitive the gang scheduler executes
+"""
+
+from training_operator_tpu.tenancy.api import (
+    PREEMPTION_NEVER,
+    PREEMPTION_PREEMPT_LOWER,
+    PRIORITY_CLASS_LABEL,
+    QUEUE_LABEL,
+    ClusterQueue,
+    PriorityClass,
+    register_tenancy_admission,
+    validate_cluster_queue,
+    validate_priority_class,
+)
+from training_operator_tpu.tenancy.arbiter import (
+    Arbitration,
+    PreemptionDecision,
+    TenancyArbiter,
+    admitted_usage,
+    pending_usage,
+    preempt_pod,
+    queue_for_group,
+    resolve_priority,
+)
+
+__all__ = [
+    "Arbitration",
+    "ClusterQueue",
+    "PREEMPTION_NEVER",
+    "PREEMPTION_PREEMPT_LOWER",
+    "PRIORITY_CLASS_LABEL",
+    "PreemptionDecision",
+    "PriorityClass",
+    "QUEUE_LABEL",
+    "TenancyArbiter",
+    "admitted_usage",
+    "pending_usage",
+    "preempt_pod",
+    "queue_for_group",
+    "register_tenancy_admission",
+    "resolve_priority",
+    "validate_cluster_queue",
+    "validate_priority_class",
+]

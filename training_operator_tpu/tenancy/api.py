@@ -1,0 +1,168 @@
+"""Tenancy API kinds: PriorityClass and ClusterQueue.
+
+Modeled on scheduling.k8s.io/v1 PriorityClass and kueue's ClusterQueue
+(the two dependencies the reference links for exactly this job — SURVEY.md
+§deps), reduced to the fields the fair-share arbiter consumes:
+
+  PriorityClass   a named integer importance + whether gangs of this class
+                  may displace lower-priority work (`preemption_policy`).
+  ClusterQueue    a team's share of the chip pool: per-resource nominal
+                  `quota`, a `borrowing_limit` it may exceed quota by when
+                  the pool has idle capacity, a fair-share `weight`, and
+                  the namespaces whose jobs default into it.
+
+Both are cluster-scoped (namespace ""), stored/watched/journaled like any
+other kind (cluster/wire.py KIND_REGISTRY), and guarded by admission
+hooks registered via `register_tenancy_admission`.
+
+Jobs reach the tenancy plane through the surfaces that already exist:
+v1 jobs via RunPolicy.scheduling_policy.{queue,priority_class} (on the
+PodGroup wire since the seed — used by nothing until this subsystem), and
+v2 TrainJobs via the QUEUE_LABEL / PRIORITY_CLASS_LABEL labels that the
+workload builder copies onto the generated job's scheduling policy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from training_operator_tpu.api.jobs import ObjectMeta
+
+# TrainJob (and any job) labels routing into the tenancy plane — the kueue
+# `kueue.x-k8s.io/queue-name` label analogue, under our API group.
+QUEUE_LABEL = "tenancy.tpu.dev/queue"
+PRIORITY_CLASS_LABEL = "tenancy.tpu.dev/priority-class"
+
+# PriorityClass.preemption_policy values (scheduling.k8s.io parity).
+PREEMPTION_PREEMPT_LOWER = "PreemptLowerPriority"
+PREEMPTION_NEVER = "Never"
+
+
+@dataclass
+class PriorityClass:
+    """Named job importance (scheduling.k8s.io/v1 PriorityClass shape)."""
+
+    KIND = "PriorityClass"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    # PreemptLowerPriority: gangs of this class may displace strictly
+    # lower-priority admitted gangs when infeasible. Never: they wait.
+    preemption_policy: str = PREEMPTION_PREEMPT_LOWER
+    # Applies to gangs that name no class at all (at most one class should
+    # set it; admission enforces nothing — ties resolve by highest value
+    # then name, deterministically).
+    global_default: bool = False
+    description: str = ""
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return ""
+
+
+@dataclass
+class ClusterQueue:
+    """One team's share of the pool (kueue ClusterQueue, reduced).
+
+    `quota` is the nominal per-resource share (e.g. {"tpu.dev/chips": 64});
+    `borrowing_limit` is how far past quota the queue may stretch into idle
+    capacity, per resource (absent key = no borrowing for that resource).
+    `weight` scales the queue's dominant share in fair-share ordering
+    (weight 2 = entitled to twice the share before it yields). `namespaces`
+    routes jobs that name no queue: a job from a listed namespace defaults
+    into this queue.
+    """
+
+    KIND = "ClusterQueue"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    quota: Dict[str, float] = field(default_factory=dict)
+    borrowing_limit: Dict[str, float] = field(default_factory=dict)
+    weight: float = 1.0
+    namespaces: List[str] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return ""
+
+    def cap(self, resource: str) -> float:
+        """quota + borrowing for one resource — THE over-admission bound
+        (the arbiter admits against it; INV007 audits against it)."""
+        return self.quota.get(resource, 0.0) + self.borrowing_limit.get(
+            resource, 0.0
+        )
+
+
+def validate_priority_class(pc: PriorityClass) -> None:
+    from training_operator_tpu.api.validation import ValidationError, is_dns1035_label
+
+    errs: List[str] = []
+    if not pc.metadata.name:
+        errs.append("metadata.name: required")
+    elif not is_dns1035_label(pc.metadata.name):
+        errs.append(f"metadata.name: {pc.metadata.name!r} is not a DNS-1035 label")
+    if not isinstance(pc.value, int) or isinstance(pc.value, bool):
+        errs.append(f"value: {pc.value!r} must be an integer")
+    elif not -2_000_000_000 <= pc.value <= 2_000_000_000:
+        # k8s caps user classes at 1e9; we only need "fits in the wire's
+        # JSON int and sorts sanely".
+        errs.append(f"value: {pc.value} out of range")
+    if pc.preemption_policy not in (PREEMPTION_PREEMPT_LOWER, PREEMPTION_NEVER):
+        errs.append(
+            f"preemptionPolicy: {pc.preemption_policy!r} must be "
+            f"{PREEMPTION_PREEMPT_LOWER!r} or {PREEMPTION_NEVER!r}"
+        )
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_cluster_queue(cq: ClusterQueue) -> None:
+    from training_operator_tpu.api.validation import ValidationError, is_dns1035_label
+
+    errs: List[str] = []
+    if not cq.metadata.name:
+        errs.append("metadata.name: required")
+    elif not is_dns1035_label(cq.metadata.name):
+        errs.append(f"metadata.name: {cq.metadata.name!r} is not a DNS-1035 label")
+    for res, val in cq.quota.items():
+        if val < 0:
+            errs.append(f"quota[{res}]: {val} must be >= 0")
+    for res, val in cq.borrowing_limit.items():
+        if val < 0:
+            errs.append(f"borrowingLimit[{res}]: {val} must be >= 0")
+    if cq.weight <= 0:
+        # weight divides the dominant share; zero would make the queue
+        # infinitely hungry (share 0 forever) and divide-by-zero besides.
+        errs.append(f"weight: {cq.weight} must be > 0")
+    if errs:
+        raise ValidationError(errs)
+
+
+def _admit_priority_class(pc: PriorityClass) -> None:
+    # Cluster-scoped kinds live at namespace "" (the ClusterTrainingRuntime
+    # convention); defaulting here keeps every lookup path agreeing on the
+    # key even when the client left ObjectMeta's "default" in place.
+    pc.metadata.namespace = ""
+    validate_priority_class(pc)
+
+
+def _admit_cluster_queue(cq: ClusterQueue) -> None:
+    cq.metadata.namespace = ""
+    validate_cluster_queue(cq)
+
+
+def register_tenancy_admission(api) -> None:
+    """Admission for the tenancy kinds, on whichever APIServer stores them
+    (host role and standalone both route through here so a malformed quota
+    object can never enter the store and wedge the arbiter)."""
+    api.register_admission(PriorityClass.KIND, _admit_priority_class)
+    api.register_admission(ClusterQueue.KIND, _admit_cluster_queue)

@@ -1,0 +1,588 @@
+"""Fair-share arbiter: the admission layer in front of the gang solver.
+
+Sits between pending PodGroups and `GangScheduler`'s batch solve (the role
+kueue plays in front of the reference's gang scheduler). Three duties:
+
+1. **Quota admission.** A gang enters the solve only while its queue's
+   admitted usage + the gang's demand stays within quota + borrowing for
+   every quota'd resource (ClusterQueue.cap). Blocked gangs stay Pending
+   with a QuotaExceeded event; the gang scheduler re-arbitrates when
+   capacity frees or a tenancy object changes.
+
+2. **Ordering.** Admissible gangs are handed to the placer in priority
+   tiers (descending PriorityClass value; one `place()` call per tier, so
+   the solver can never trade a high-priority gang away for better packing
+   of a lower one). Within a tier, queues take turns by ascending weighted
+   dominant share (DRF-style: a queue's share is its most-constrained
+   quota fraction, divided by its weight), with preempted gangs at the
+   front of their queue's line (fair-share debt: displaced work re-enters
+   first). Gangs pending past `starvation_seconds` bypass the priority
+   tiers entirely (FIFO front) — the starvation guard — but never the
+   quota gate.
+
+3. **Preemption planning.** A gang that stayed unplaced after its tier's
+   solve may displace admitted work: victims are chosen cheapest-first
+   (lowest priority, then least displaced demand, then youngest — the
+   least checkpoint progress lost) among strictly-lower-priority gangs —
+   or, when the preemptor's queue is reclaiming its nominal quota,
+   borrowing gangs of any queue at <= its priority. Only plans that
+   provably cover the capacity deficit are returned (no futile
+   evictions), and a gang already preempted `max_preemptions` times is
+   immune (preemption's own starvation guard). Execution — checkpoint
+   marking, eviction, requeue — is the gang scheduler's job
+   (`GangScheduler._preempt_group`), so the arbiter stays a pure planner.
+
+The checkpoint contract: the victim's progress at eviction is recorded on
+its PodGroup (`checkpointed_seconds`), standing in for the trainer's own
+save-on-SIGTERM (trainer/checkpoint.py already auto-resumes from the
+latest step on restart). The engine subtracts it from the simulated run
+time when the gang's pods are recreated — resumed from step, not step 0 —
+and the eviction rides the PR 5 retryable path, so the restart budget is
+untouched.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from training_operator_tpu.cluster.objects import PodGroup, PodGroupPhase
+from training_operator_tpu.engine.core import PREEMPTED_MESSAGE_PREFIX
+from training_operator_tpu.tenancy.api import (
+    PREEMPTION_NEVER,
+    ClusterQueue,
+    PriorityClass,
+)
+
+_EPS = 1e-9
+
+ADMITTED_PHASES = (PodGroupPhase.INQUEUE, PodGroupPhase.RUNNING)
+PENDING_PHASES = (PodGroupPhase.PENDING, PodGroupPhase.UNSCHEDULABLE)
+
+
+def resolve_priority(
+    pg: PodGroup, classes: Dict[str, PriorityClass]
+) -> Tuple[int, str]:
+    """(value, preemption_policy) for one gang. An unnamed class falls to
+    the global default (highest value wins ties, then name — deterministic);
+    a name with no object resolves to (0, Never) — value 0, no preemption
+    rights — and speclint TEN001 rejects that reference at admission for
+    v2 jobs."""
+    name = pg.priority_class
+    if name:
+        pc = classes.get(name)
+        if pc is not None:
+            return pc.value, pc.preemption_policy
+        return 0, PREEMPTION_NEVER
+    defaults = [c for c in classes.values() if c.global_default]
+    if defaults:
+        pc = max(defaults, key=lambda c: (c.value, c.metadata.name))
+        return pc.value, pc.preemption_policy
+    return 0, PREEMPTION_NEVER
+
+
+def queue_for_group(
+    pg: PodGroup, queues: Dict[str, ClusterQueue]
+) -> Optional[ClusterQueue]:
+    """The ClusterQueue a gang charges: its named queue, else the queue
+    whose `namespaces` lists the gang's namespace (first by name), else
+    none (unconstrained — a cluster without tenancy objects behaves
+    exactly like the pre-tenancy scheduler)."""
+    if pg.queue:
+        return queues.get(pg.queue)
+    ns = pg.namespace
+    for name in sorted(queues):
+        if ns in queues[name].namespaces:
+            return queues[name]
+    return None
+
+
+def _usage(
+    groups: Iterable[PodGroup],
+    queues: Dict[str, ClusterQueue],
+    phases: Tuple[PodGroupPhase, ...],
+) -> Dict[str, Dict[str, float]]:
+    out: Dict[str, Dict[str, float]] = {}
+    for pg in groups:
+        if pg.phase not in phases:
+            continue
+        q = queue_for_group(pg, queues)
+        if q is None:
+            continue
+        bucket = out.setdefault(q.name, {})
+        for res, val in (pg.min_resources or {}).items():
+            bucket[res] = bucket.get(res, 0.0) + val
+    return out
+
+
+def admitted_usage(
+    groups: Iterable[PodGroup], queues: Dict[str, ClusterQueue]
+) -> Dict[str, Dict[str, float]]:
+    """Per-queue resources held by admitted (Inqueue/Running) gangs — THE
+    accounting the arbiter admits against, INV007 audits against, and the
+    fleet queue gauges publish; one function so they cannot disagree."""
+    return _usage(groups, queues, ADMITTED_PHASES)
+
+
+def pending_usage(
+    groups: Iterable[PodGroup], queues: Dict[str, ClusterQueue]
+) -> Dict[str, Dict[str, float]]:
+    """Per-queue resources demanded by queued (Pending/Unschedulable)
+    gangs — the fleet plane's queue-depth view."""
+    return _usage(groups, queues, PENDING_PHASES)
+
+
+def dominant_share(
+    queue: ClusterQueue, usage: Dict[str, float]
+) -> float:
+    """Weighted dominant share: the queue's most-constrained quota
+    fraction, divided by its weight (DRF over the quota'd resources)."""
+    share = 0.0
+    for res, quota in queue.quota.items():
+        if quota > 0:
+            share = max(share, usage.get(res, 0.0) / quota)
+    return share / queue.weight
+
+
+@dataclass
+class Arbitration:
+    """One cycle's admission decision: solve `tiers` in order (one placer
+    call each), announce `blocked` (QuotaExceeded), keep `priorities` for
+    the preemption planner that runs after the solve."""
+
+    tiers: List[list] = field(default_factory=list)
+    blocked: List[Tuple[object, str, str]] = field(default_factory=list)
+    priorities: Dict[str, int] = field(default_factory=dict)
+    # Keys admitted through the starvation guard this cycle: the gang
+    # scheduler stamps `starvation_promoted` on them at admission, which
+    # shields them from the preemption planner (aging = priority boost,
+    # and a boost that evaporated at admission would be no guard at all).
+    starved: set = field(default_factory=set)
+
+
+@dataclass
+class PreemptionDecision:
+    victim_key: str  # "ns/name" of the displaced PodGroup
+    preemptor_key: str  # "ns/name" of the gang that needed the capacity
+    queue: str  # victim's queue name ("" = unqueued)
+    reason: str
+
+
+class TenancyArbiter:
+    """The arbiter one GangScheduler consults each solve cycle. Reads the
+    tenancy kinds from the store per cycle via `list_refs` (frozen
+    references; the populations are tiny), so it needs no informer of its
+    own and a quota edit is honored on the very next solve."""
+
+    def __init__(
+        self,
+        api,
+        now_fn,
+        starvation_seconds: float = 600.0,
+        max_preemptions: int = 3,
+    ):
+        self.api = api
+        self.now = now_fn
+        self.starvation_seconds = starvation_seconds
+        self.max_preemptions = max_preemptions
+
+    # -- store views ---------------------------------------------------
+
+    def _load(self) -> Tuple[Dict[str, ClusterQueue], Dict[str, PriorityClass]]:
+        queues = {q.metadata.name: q for q in self.api.list_refs("ClusterQueue")}
+        classes = {c.metadata.name: c for c in self.api.list_refs("PriorityClass")}
+        return queues, classes
+
+    # -- admission -----------------------------------------------------
+
+    def arbitrate(
+        self, requests: List, groups: Iterable[PodGroup], now: float
+    ) -> Arbitration:
+        """Order + quota-filter one cycle's pending GangRequests. `groups`
+        is the gang scheduler's full PodGroup view (admitted usage is
+        derived from it); requests not in the result's tiers are in
+        `blocked` and stay Pending."""
+        queues, classes = self._load()
+        usage = admitted_usage(groups, queues)
+        out = Arbitration()
+
+        # Bucket candidates: starved gangs FIFO at the very front (the
+        # starvation guard outranks priority, never quota), the rest into
+        # (priority, queue) lines with preempted gangs (fair-share debt)
+        # at the front of their queue's line.
+        starved: List[Tuple[float, str, object, Optional[ClusterQueue]]] = []
+        lines: Dict[int, Dict[str, List]] = {}
+        line_queue: Dict[str, Optional[ClusterQueue]] = {}
+        for req in requests:
+            pg = req.group
+            prio, _ = resolve_priority(pg, classes)
+            out.priorities[req.key] = prio
+            q = queue_for_group(pg, queues)
+            if pg.queue and q is None and queues:
+                # A named queue that doesn't exist is a wait, not a bypass
+                # (kueue semantics; a typo must not skip the quota gate).
+                out.blocked.append(
+                    (req, pg.queue, f"queue {pg.queue!r} does not exist")
+                )
+                continue
+            created = pg.metadata.creation_time
+            # No birth stamp = no measurable wait: never "starved" (on a
+            # wall clock the or-zero fallback would read as an epoch-long
+            # wait and promote EVERYTHING, silently disabling priority).
+            if (
+                self.starvation_seconds > 0
+                and created is not None
+                and now - created > self.starvation_seconds
+            ):
+                starved.append((created, pg.metadata.name, req, q))
+                continue
+            qname = q.name if q is not None else ""
+            line_queue[qname] = q
+            lines.setdefault(prio, {}).setdefault(qname, []).append(req)
+
+        def debt_key(req):
+            pg = req.group
+            # Displaced gangs first (oldest debt first), then FIFO.
+            return (
+                0 if pg.preemption_count > 0 else 1,
+                pg.last_preempted_at,
+                pg.metadata.creation_time or 0.0,
+                pg.metadata.name,
+            )
+
+        def admit(req, q: Optional[ClusterQueue], tier: List) -> None:
+            demand = req.group.min_resources or {}
+            if q is not None:
+                over = sorted(
+                    res
+                    for res in q.quota
+                    if usage.get(q.name, {}).get(res, 0.0) + demand.get(res, 0.0)
+                    > q.cap(res) + _EPS
+                )
+                if over:
+                    out.blocked.append((
+                        req, q.name,
+                        f"queue {q.name!r} quota exhausted for "
+                        + ", ".join(over),
+                    ))
+                    return
+                bucket = usage.setdefault(q.name, {})
+                for res, val in demand.items():
+                    bucket[res] = bucket.get(res, 0.0) + val
+            tier.append(req)
+
+        if starved:
+            tier: List = []
+            for _, _, req, q in sorted(starved, key=lambda s: (s[0], s[1])):
+                admit(req, q, tier)
+            out.starved.update(req.key for req in tier)
+            if tier:
+                out.tiers.append(tier)
+
+        for prio in sorted(lines, reverse=True):
+            per_queue = {
+                qname: sorted(reqs, key=debt_key)
+                for qname, reqs in lines[prio].items()
+            }
+            tier = []
+            # Round-robin by ascending weighted dominant share, recomputed
+            # after every admission so queues interleave instead of one
+            # queue drained first (the fairness the Jain bench measures).
+            while per_queue:
+                def share_of(qname: str) -> float:
+                    q = line_queue[qname]
+                    if q is None:
+                        return 0.0
+                    return dominant_share(q, usage.get(qname, {}))
+
+                qname = min(per_queue, key=lambda n: (share_of(n), n))
+                req = per_queue[qname].pop(0)
+                if not per_queue[qname]:
+                    del per_queue[qname]
+                admit(req, line_queue[qname], tier)
+            if tier:
+                out.tiers.append(tier)
+        return out
+
+    # -- preemption ----------------------------------------------------
+
+    def _eligible_victims(
+        self, req, prio: int, can_preempt_lower: bool, reclaiming: bool,
+        admitted: List[PodGroup], classes, queues, usage, taken: set,
+    ) -> Dict[str, Tuple[PodGroup, int, float, str]]:
+        """vkey -> (victim, its priority, its chip cost, its queue) for one
+        preemptor. Eligibility: strictly lower priority (when the
+        preemptor's class may preempt), or — on the reclaim arm — a
+        borrower at <= the preemptor's priority. Gangs at their preemption
+        cap or admitted via the starvation guard are immune."""
+        from training_operator_tpu.cluster.inventory import TPU_RESOURCE
+
+        out: Dict[str, Tuple[PodGroup, int, float, str]] = {}
+        for vic in admitted:
+            vkey = f"{vic.namespace}/{vic.name}"
+            if vkey in taken or vkey == req.key:
+                continue
+            if vic.preemption_count >= self.max_preemptions:
+                continue  # displaced enough: immune now
+            if vic.starvation_promoted:
+                # Admitted through the starvation guard: evicting it would
+                # undo the promotion the guard exists to make.
+                continue
+            vprio, _ = resolve_priority(vic, classes)
+            vq = queue_for_group(vic, queues)
+            borrower = vq is not None and any(
+                usage.get(vq.name, {}).get(res, 0.0)
+                > vq.quota.get(res, 0.0) + _EPS
+                for res in vq.quota
+            )
+            if not (
+                (can_preempt_lower and vprio < prio)
+                or (reclaiming and borrower and vprio <= prio)
+            ):
+                continue
+            vres = vic.min_resources or {}
+            cost = vres.get(TPU_RESOURCE, 0.0) or sum(vres.values())
+            out[vkey] = (vic, vprio, cost, vq.name if vq is not None else "")
+        return out
+
+    _BLOCKED = object()  # host held by a non-evictable occupant
+
+    def _tpu_slice_plan(
+        self, req, eligible, snapshot, claimed_hosts: set,
+    ) -> Optional[Tuple[set, set]]:
+        """Topology-aware victim selection for a TPU preemptor: find, per
+        needed slice, the CHEAPEST contiguous host window of the right
+        size whose occupants are all evictable (or already free) —
+        freeing chips that don't form an ICI block would displace work
+        for nothing (the exact thrash chip-counting produces; the bench
+        caught it). Returns (victim keys, window host nodes) or None when
+        no covering set of windows exists."""
+        from training_operator_tpu.scheduler.snapshot import (
+            request_hosts_per_slice,
+        )
+
+        want_slices = max(1, req.num_slices)
+        owner: Dict[str, str] = {}
+        for vkey, (vic, _vprio, _cost, _vq) in eligible.items():
+            for node in set(vic.placement.values()) | set(vic.reserved_nodes):
+                owner[node] = vkey
+        plans = []  # (max victim prio, chip cost, slice id, victims, hosts)
+        for sid in sorted(snapshot.slices):
+            sl = snapshot.slices[sid]
+            h = request_hosts_per_slice(req, sl.chips_per_host)
+            if h <= 0 or h > sl.num_hosts:
+                continue
+            states = []
+            for node in sl.host_nodes:
+                if node in claimed_hosts:
+                    states.append(self._BLOCKED)  # promised to an earlier plan
+                elif snapshot.host_free(node, sl.chips_per_host):
+                    states.append(None)
+                elif node in owner:
+                    states.append(owner[node])
+                else:
+                    states.append(self._BLOCKED)
+            best = None
+            for start in range(sl.num_hosts - h + 1):
+                window = states[start:start + h]
+                if any(s is self._BLOCKED for s in window):
+                    continue
+                vks = {s for s in window if s is not None}
+                cost = sum(eligible[v][2] for v in vks)
+                max_prio = max(
+                    (eligible[v][1] for v in vks), default=-(10 ** 12)
+                )
+                key = (max_prio, cost, start)
+                if best is None or key < best[0]:
+                    best = (key, vks, set(sl.host_nodes[start:start + h]))
+            if best is not None:
+                plans.append(
+                    (best[0][0], best[0][1], sid, best[1], best[2])
+                )
+        # Cheapest slices first: lowest victim priority, then chip cost.
+        plans.sort(key=lambda p: (p[0], p[1], p[2]))
+        if len(plans) < want_slices:
+            return None
+        victims: set = set()
+        hosts: set = set()
+        for _, _, _, vks, window_hosts in plans[:want_slices]:
+            victims.update(vks)
+            hosts.update(window_hosts)
+        return victims, hosts
+
+    def _generic_plan(
+        self, req, eligible, snapshot, freed: Dict[str, float],
+    ) -> Optional[set]:
+        """Chip-deficit victim selection for non-TPU preemptors: cheapest
+        first until every short resource is covered."""
+        demand = {
+            res: val for res, val in (req.group.min_resources or {}).items()
+            if val > 0
+        }
+        if not demand:
+            return None
+        free: Dict[str, float] = {}
+        for avail in snapshot.free.values():
+            for res, val in avail.items():
+                if val > 0:
+                    free[res] = free.get(res, 0.0) + val
+        deficit = {}
+        for res, need in demand.items():
+            short = need - free.get(res, 0.0) - freed.get(res, 0.0)
+            if short > _EPS:
+                deficit[res] = short
+        if not deficit:
+            return None  # fragmentation-only: eviction can't be shown to help
+        candidates = sorted(
+            eligible.items(),
+            key=lambda kv: (
+                kv[1][1],  # lowest priority first
+                kv[1][2],  # then least displaced work
+                -(kv[1][0].metadata.creation_time or 0.0),  # youngest
+                kv[0],
+            ),
+        )
+        chosen: set = set()
+        got: Dict[str, float] = {}
+        for vkey, (vic, _vprio, _cost, _vq) in candidates:
+            if all(got.get(r, 0.0) >= s - _EPS for r, s in deficit.items()):
+                break
+            vres = vic.min_resources or {}
+            if all(
+                got.get(r, 0.0) >= deficit[r] - _EPS or vres.get(r, 0.0) <= _EPS
+                for r in deficit
+            ):
+                continue  # contributes nothing still missing
+            chosen.add(vkey)
+            for r in deficit:
+                got[r] = got.get(r, 0.0) + vres.get(r, 0.0)
+        if not chosen or not all(
+            got.get(r, 0.0) >= s - _EPS for r, s in deficit.items()
+        ):
+            return None  # no covering plan: don't evict futilely
+        return chosen
+
+    def plan_preemptions(
+        self,
+        unplaced: List,
+        priorities: Dict[str, int],
+        groups: Iterable[PodGroup],
+        snapshot,
+        now: float,
+    ) -> List[PreemptionDecision]:
+        """Victims for the gangs the solve could not place. A plan frees
+        whole admitted gangs (a gang is the eviction unit — partial
+        eviction would just break the victim's own ICI mesh), and is only
+        returned when it provably covers the preemptor: a contiguous host
+        window per needed slice for TPU gangs, the chip deficit for
+        generic ones. The gang scheduler executes decisions and re-solves
+        in the SAME cycle, so freed capacity goes to the preemptor before
+        any lower tier can backfill it."""
+        if not unplaced:
+            return []
+        queues, classes = self._load()
+        groups = list(groups)
+        usage = admitted_usage(groups, queues)
+        admitted = [pg for pg in groups if pg.phase in ADMITTED_PHASES]
+        decisions: List[PreemptionDecision] = []
+        taken: set = set()
+        claimed_hosts: set = set()
+        freed: Dict[str, float] = {}
+
+        order = sorted(
+            unplaced,
+            key=lambda r: (
+                -priorities.get(r.key, 0),
+                r.group.metadata.creation_time or 0.0,
+                r.group.metadata.name,
+            ),
+        )
+        for req in order:
+            pg = req.group
+            prio, policy = resolve_priority(pg, classes)
+            # A Never class blocks the PRIORITY arm only; quota reclaim is
+            # a queue-level right (kueue's reclaimWithinCohort), not a
+            # class privilege — a quota'd team must be able to take its
+            # nominal share back from borrowers regardless of class.
+            can_preempt_lower = policy != PREEMPTION_NEVER
+            q = queue_for_group(pg, queues)
+            demand = pg.min_resources or {}
+            # Reclaim arm: a queue asking for no more than its NOMINAL
+            # quota may displace borrowers of any queue at <= its priority.
+            reclaiming = False
+            if q is not None and q.quota:
+                reclaiming = all(
+                    usage.get(q.name, {}).get(res, 0.0) + demand.get(res, 0.0)
+                    <= q.quota.get(res, 0.0) + _EPS
+                    for res in q.quota
+                )
+            eligible = self._eligible_victims(
+                req, prio, can_preempt_lower, reclaiming,
+                admitted, classes, queues, usage, taken,
+            )
+            if not eligible:
+                continue
+            if req.is_tpu():
+                plan = self._tpu_slice_plan(req, eligible, snapshot,
+                                            claimed_hosts)
+                if plan is None:
+                    continue
+                chosen, window_hosts = plan
+                if not chosen:
+                    # A free window already exists: the preemptor lost it
+                    # to same-tier competition, not to lower-priority work
+                    # — nothing to evict.
+                    continue
+                claimed_hosts.update(window_hosts)
+            else:
+                chosen = self._generic_plan(req, eligible, snapshot, freed)
+                if not chosen:
+                    continue
+            for vkey in sorted(chosen):
+                vic, _vprio, _cost, vqueue = eligible[vkey]
+                taken.add(vkey)
+                if vqueue and vqueue in usage:
+                    # Keep the accounting live as victims are taken: a
+                    # queue that stops borrowing the moment its gang is
+                    # planned for eviction must not still read as a
+                    # borrower to the NEXT preemptor's reclaim arm.
+                    bucket = usage[vqueue]
+                    for res, val in (vic.min_resources or {}).items():
+                        bucket[res] = max(0.0, bucket.get(res, 0.0) - val)
+                for res, val in (vic.min_resources or {}).items():
+                    freed[res] = freed.get(res, 0.0) + val
+                decisions.append(PreemptionDecision(
+                    victim_key=vkey,
+                    preemptor_key=req.key,
+                    queue=vqueue,
+                    reason=(
+                        f"higher-priority gang {req.key} "
+                        f"(priority {prio}) needs capacity"
+                    ),
+                ))
+            if q is not None:
+                # The preemptor will take the freed capacity at the
+                # same-cycle re-solve: charge its demand now so a LATER
+                # same-queue preemptor's reclaim test sees the joint
+                # demand (two gangs each within nominal quota must not
+                # both claim the <=-priority reclaim right when together
+                # they exceed it).
+                bucket = usage.setdefault(q.name, {})
+                for res, val in demand.items():
+                    bucket[res] = bucket.get(res, 0.0) + val
+        return decisions
+
+
+def preempt_pod(api, pod, reason: str, now: float) -> bool:
+    """Fail one member pod of a preempted gang — the tenancy twin of
+    nodelifecycle.evict_pod (both ride fail_pod, the one shared fail-a-pod
+    sequence), with the PREEMPTED marker the engine's triage treats as
+    retryable WITHOUT charging the restart budget (the workload did
+    nothing wrong; the fleet took its hardware back). Returns False when
+    the pod is already terminal or deleted."""
+    from training_operator_tpu.controllers.nodelifecycle import fail_pod
+
+    return fail_pod(
+        api, pod, PREEMPTED_MESSAGE_PREFIX, reason, now,
+        event_reason="Preempted", event_verb="preempted",
+    ) is not None

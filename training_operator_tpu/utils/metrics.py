@@ -618,3 +618,28 @@ wire_fleet_cache_misses = registry.counter(
     "training_wire_fleet_cache_misses_total",
     "GET /fleet snapshots rebuilt (store version or audit generation moved)", (),
 )
+# Multi-tenancy plane (tenancy/): per-queue chip accounting republished by
+# the FleetCollector from the SAME accounting the arbiter admits against
+# (tenancy/arbiter.py admitted_usage), plus the preemption counter the
+# gang scheduler bumps per displaced gang.
+queue_admitted_chips = registry.gauge(
+    "training_queue_admitted_chips",
+    "Accelerator chips held by admitted (Inqueue/Running) gangs, by queue",
+    ("queue",),
+)
+queue_pending_chips = registry.gauge(
+    "training_queue_pending_chips",
+    "Accelerator chips demanded by queued (Pending/Unschedulable) gangs, by queue",
+    ("queue",),
+)
+queue_borrowed_chips = registry.gauge(
+    "training_queue_borrowed_chips",
+    "Admitted chips beyond the queue's nominal quota (borrowed from idle capacity)",
+    ("queue",),
+)
+gang_preemptions = registry.counter(
+    "training_preemptions_total",
+    "Gangs preempted (checkpointed + evicted + requeued) by the fair-share arbiter, "
+    "by victim queue",
+    ("queue",),
+)
