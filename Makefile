@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast test-wire test-chaos test-fleet test-tenancy lint bench bench-quick bench-wire bench-wire-v2 bench-wire-resume bench-observe bench-audit bench-node-chaos bench-tenancy dryrun operator-demo ha-demo native clean
+.PHONY: test test-fast test-wire test-chaos test-fleet test-tenancy test-failover lint bench bench-quick bench-wire bench-wire-v2 bench-wire-resume bench-observe bench-audit bench-node-chaos bench-tenancy bench-failover dryrun operator-demo ha-demo native clean
 
 test:            ## full suite (no hardware needed; ~10 min)
 	$(PY) -m pytest tests/ -q
@@ -28,6 +28,12 @@ test-fleet:      ## the fleet introspection lane: invariant rules, /fleet, top, 
 
 test-tenancy:    ## the multi-tenancy lane: quotas, priority, fair share, preemption
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tenancy.py -q
+
+# Deterministic control-plane HA lane: in-process HostChaos (WAL shipping,
+# epoch-chained resume, promotion, the 120-job failover burst) plus the
+# crash-window store tests — no OS-process spawning, kept out of `slow`.
+test-failover:   ## control-plane failover lane (WAL standby, HostChaos, crash-safe store)
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_failover.py tests/test_store.py -q
 
 lint:            ## project code lint: AST discipline rules + ruff (if present)
 	$(PY) -m training_operator_tpu.analysis.codelint training_operator_tpu
@@ -95,6 +101,14 @@ bench-observe:   ## observability-overhead block (one JSON line)
 # violation fails the lane.
 bench-audit:     ## auditor-overhead block (one JSON line + BENCH_SELF_AUDIT artifact)
 	JAX_PLATFORMS=cpu $(PY) bench.py --audit-only
+
+# Kill the primary host mid 120-job burst on real sockets: standby tails
+# the WAL, auto-promotes on lease expiry, converges the burst under the
+# fail-fast auditor. Reports failover MTTR (kill -> first acknowledged
+# write), epoch-chained resume economics (replayed vs forced-relist events
+# for N surviving watch sessions), and steady-state replication lag.
+bench-failover:  ## control-plane failover MTTR block -> BENCH_SELF_FAILOVER artifact
+	JAX_PLATFORMS=cpu $(PY) bench.py --failover-only
 
 # Kill one host of a whole-slice TPU gang on a virtual clock and measure
 # node-loss MTTR: detect (grace) -> evict (toleration) -> gang re-solve ->

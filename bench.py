@@ -1350,11 +1350,315 @@ def run_node_chaos(heartbeat: float = 10.0, grace: float = 40.0,
 
 
 # ---------------------------------------------------------------------------
-# Multi-tenant contention: N teams x M jobs over-subscribing one pool, the
-# fair-share arbiter (queues/quotas/priority/checkpoint-preemption) vs the
-# strict first-come gang scheduler. Jain fairness index, preemption count,
-# per-priority-tier latency percentiles.
+# Control-plane host failover (PR 9 headline): WAL-shipping warm standby on
+# real sockets + real clock, primary SIGKILL'd mid 120-job burst, standby
+# auto-promoted via the replicated host lease. Reports failover MTTR
+# (kill -> first successful write on the standby), the epoch-chained resume
+# economics (events replayed vs what a forced relist would have delivered to
+# the surviving watch sessions), and steady-state replication lag.
 # ---------------------------------------------------------------------------
+
+
+def run_failover(jobs: int = 120, watch_sessions: int = 4,
+                 out: str = "BENCH_SELF_FAILOVER_r12.json"):
+    import statistics
+    import tempfile
+    import threading
+
+    import training_operator_tpu.api.common as capi
+    from training_operator_tpu.api.common import (
+        Container, PodTemplateSpec, ReplicaSpec,
+    )
+    from training_operator_tpu.api.defaults import default_job
+    from training_operator_tpu.api.jobs import JAXJob, JOB_KINDS, ObjectMeta
+    from training_operator_tpu.api.validation import validate_job
+    from training_operator_tpu.cluster.chaos import HostChaos
+    from training_operator_tpu.cluster.httpapi import (
+        ApiHTTPServer, ApiUnavailableError, RemoteAPIServer,
+    )
+    from training_operator_tpu.cluster.inventory import make_cpu_pool
+    from training_operator_tpu.cluster.objects import ConfigMap
+    from training_operator_tpu.cluster.replication import (
+        StandbyController, make_snapshot_source, start_host_lease,
+    )
+    from training_operator_tpu.cluster.runtime import (
+        ANNOTATION_SIM_DURATION as SIM_DUR, Cluster as Cl, WallClock,
+    )
+    from training_operator_tpu.cluster.store import HostStore
+    from training_operator_tpu.config import OperatorConfig
+    from training_operator_tpu.observe.invariants import (
+        FleetSources, InvariantAuditor,
+    )
+    from training_operator_tpu.utils import metrics as M
+    from training_operator_tpu.__main__ import build_stack
+
+    lease_s, poll_s = 1.0, 0.2
+    cfg = OperatorConfig(
+        enabled_schemes=["jax"], gang_scheduler_name="none", enable_v2=False,
+        fleet_audit_interval=0.0, replication_lease_seconds=lease_s,
+        replication_poll_timeout=poll_s,
+    )
+
+    def admit_all(cluster):
+        def admit(job):
+            default_job(job, now=cluster.clock.now())
+            validate_job(job)
+
+        for kind in JOB_KINDS:
+            cluster.api.register_admission(kind, admit)
+
+    def step_loop(cluster, stop, errors, extra=None):
+        def loop():
+            while not stop.is_set():
+                try:
+                    cluster.step()
+                    if extra is not None:
+                        extra()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+                    stop.set()
+                    return
+                time.sleep(0.005)
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return t
+
+    tmp = tempfile.mkdtemp(prefix="bench-failover-")
+
+    # -- primary host ------------------------------------------------------
+    p_cluster = Cl(WallClock())
+    p_store = HostStore(tmp + "/primary", wal_ring=cfg.replication_wal_ring)
+    p_store.load_into(p_cluster.api)
+    p_store.attach(p_cluster.api)
+    p_cluster.add_nodes(make_cpu_pool(8, cpu_per_node=16.0))
+    admit_all(p_cluster)
+    p_mgr, _ = build_stack(p_cluster, cfg)
+    p_server = ApiHTTPServer(p_cluster.api, port=0, now_fn=p_cluster.clock.now)
+    p_server.wal_source = p_store.wal_page
+    p_server.snapshot_source = make_snapshot_source(
+        p_cluster.api, p_store, p_server.resume_ring
+    )
+    start_host_lease(p_cluster, "bench-primary", lease_s)
+    p_errors, p_stop = [], threading.Event()
+    p_thread = step_loop(p_cluster, p_stop, p_errors)
+
+    # -- warm standby ------------------------------------------------------
+    s_cluster = Cl(WallClock())
+    s_store = HostStore(tmp + "/standby", wal_ring=cfg.replication_wal_ring)
+    ctrl = StandbyController(
+        s_cluster, p_server.url, store=s_store, poll_timeout=poll_s,
+        lease_duration=lease_s, identity="bench-standby",
+    )
+    ctrl.bootstrap()
+    admit_all(s_cluster)
+    s_server = ApiHTTPServer(s_cluster.api, port=0, now_fn=s_cluster.clock.now)
+    ctrl.attach_server(s_server)
+    s_sources = s_server.fleet_sources
+    s_sources.replication_lag = ctrl.lag
+
+    def on_promote():
+        mgr, _ = build_stack(s_cluster, cfg)
+        s_sources.expectations = mgr.unfulfilled_expectations
+
+    ctrl.on_promote.append(on_promote)
+    # The burst runs under the standing fail-fast auditor (INV008 included,
+    # fed by the live replication lag) — one violation fails the bench.
+    auditor = InvariantAuditor(
+        s_cluster.api, s_cluster.clock.now, sources=s_sources,
+        interval=0.5, fail_fast=True,
+    ).attach(s_cluster)
+    ctrl.start()
+    s_errors, s_stop = [], threading.Event()
+    s_thread = step_loop(
+        s_cluster, s_stop, s_errors, extra=ctrl.maybe_complete_promotion
+    )
+
+    # -- clients: one writer + N surviving watch sessions ------------------
+    writer = RemoteAPIServer(
+        addresses=[p_server.url, s_server.url], timeout=5.0
+    )
+    watchers = [
+        RemoteAPIServer(addresses=[p_server.url, s_server.url], timeout=5.0)
+        for _ in range(watch_sessions)
+    ]
+    queues = [w.watch(kinds=["JAXJob", "Pod"]) for w in watchers]
+    relists = []
+    for w in watchers:
+        orig = w.list
+        w.list = (lambda o: lambda *a, **k: relists.append(a) or o(*a, **k))(orig)
+
+    def drain_all():
+        n = 0
+        for q in queues:
+            try:
+                n += len(q.drain(timeout=0.1))
+            except ApiUnavailableError:
+                pass
+        return n
+
+    def succeeded():
+        try:
+            return sum(1 for j in writer.list("JAXJob")
+                       if capi.is_succeeded(j.status))
+        except ApiUnavailableError:
+            return -1
+
+    # -- burst + steady-state lag ------------------------------------------
+    for i in range(jobs):
+        writer.create(JAXJob(
+            metadata=ObjectMeta(name=f"fo-{i:03d}"),
+            replica_specs={"Worker": ReplicaSpec(
+                replicas=1,
+                template=PodTemplateSpec(
+                    containers=[Container(name="jax", image="trainer",
+                                          resources={"cpu": 1.0})],
+                    annotations={SIM_DUR: "0.3"},
+                ),
+            )},
+        ))
+    lag_records, lag_seconds = [], []
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and succeeded() < jobs // 4:
+        lag = ctrl.lag()
+        lag_records.append(lag["records"])
+        lag_seconds.append(lag["seconds"])
+        drain_all()
+        time.sleep(0.05)
+    mid_burst_succeeded = succeeded()
+    ctrl_applied_before = ctrl.applied
+
+    # -- SIGKILL the primary mid-burst -------------------------------------
+    replay_before = M.wire_resume_replayed.total()
+    delta_before = M.wire_resume_delta.total()
+    too_old_before = M.wire_resume_too_old.total()
+    chaos = HostChaos()
+    kill_t = chaos.kill_inprocess(
+        "bench-primary", server=p_server, store=p_store,
+        stop=p_stop, threads=[p_thread],
+    )
+    # kill_t is WALL time (HostChaos logs wall times for replay parity
+    # with NodeChaos) — every delta below diffs against time.time().
+    promote_t = None
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if ctrl.promoted:
+            promote_t = time.time()
+            break
+        time.sleep(0.005)
+    assert promote_t is not None, "standby never promoted"
+
+    # MTTR: kill -> first successful write, via the failover client's
+    # ordinary retry arm (unique probe names: a lost-response retry must
+    # not read as failure).
+    mttr = None
+    attempt = 0
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            writer.create(ConfigMap(
+                metadata=ObjectMeta(name=f"mttr-probe-{attempt}"), data={}
+            ))
+            mttr = time.time() - kill_t
+            break
+        except ApiUnavailableError:
+            attempt += 1
+            time.sleep(0.02)
+    assert mttr is not None, "no write ever succeeded on the standby"
+
+    # -- converge the whole burst on the promoted standby ------------------
+    post_kill_events = 0
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        post_kill_events += drain_all()
+        if succeeded() == jobs:
+            break
+        time.sleep(0.05)
+    all_done = succeeded() == jobs
+    # Heal the sessions fully before counting (late resubscribes).
+    for _ in range(10):
+        post_kill_events += drain_all()
+
+    # What a forced relist would have delivered to the same N sessions at
+    # promotion time: one event per live object of each watched kind.
+    try:
+        relist_events_per_session = (
+            len(writer.list("JAXJob")) + len(writer.list("Pod"))
+        )
+    except ApiUnavailableError:
+        relist_events_per_session = -1
+
+    replayed = M.wire_resume_replayed.total() - replay_before
+    block = {
+        "jobs": jobs,
+        "watch_sessions": watch_sessions,
+        "replication": {
+            "lease_seconds": lease_s,
+            "poll_timeout_s": poll_s,
+            "steady_lag_records_p50": (
+                statistics.median(lag_records) if lag_records else None
+            ),
+            "steady_lag_seconds_p50": (
+                round(statistics.median(lag_seconds), 4) if lag_seconds else None
+            ),
+            "records_applied_before_kill": ctrl_applied_before,
+            "bootstraps": ctrl.bootstraps,
+        },
+        "mid_burst_succeeded": mid_burst_succeeded,
+        "promote_s": round(promote_t - kill_t, 3),
+        "mttr_s": round(mttr, 3),
+        "write_attempts_during_outage": attempt,
+        "all_jobs_succeeded": all_done,
+        "auditor": {
+            "fail_fast": True,
+            "audits": auditor.audits,
+            "violations": len(auditor.last_violations),
+            "primary_errors": [repr(e) for e in p_errors],
+            "standby_errors": [repr(e) for e in s_errors],
+        },
+        "resume": {
+            "delta_resumes": M.wire_resume_delta.total() - delta_before,
+            "too_old_relists": M.wire_resume_too_old.total() - too_old_before,
+            "client_relist_calls": len(relists),
+            "events_replayed": replayed,
+            "events_received_post_kill": post_kill_events,
+            "forced_relist_events_per_session": relist_events_per_session,
+            "forced_relist_events_total": (
+                relist_events_per_session * watch_sessions
+                if relist_events_per_session >= 0 else None
+            ),
+            "replay_over_received": (
+                round(replayed / post_kill_events, 3)
+                if post_kill_events else None
+            ),
+        },
+    }
+
+    s_stop.set()
+    ctrl.stop()
+    s_thread.join(timeout=5)
+    try:
+        s_server.close()
+        s_store.close()
+    except Exception:
+        pass
+    with open(out, "w") as f:
+        json.dump({
+            "bench": "failover",
+            "method": (
+                "two in-process host stacks on real sockets + real clock; "
+                "primary (durable HostStore, WAL ring, host lease) killed "
+                "with SIGKILL semantics (listener + established conns "
+                "severed, store fd abandoned) mid-burst; standby tails "
+                "GET /wal, auto-promotes on lease expiry + dead tail, and "
+                "converges the burst under the fail-fast invariant auditor "
+                "(INV001-INV008). MTTR = kill -> first acknowledged write "
+                "through the failover client."
+            ),
+            **block,
+        }, f, indent=2)
+        f.write("\n")
+    return block
 
 
 def _jain(values):
@@ -1682,6 +1986,17 @@ def main():
                          "reap against a 1k-object cluster)")
     ap.add_argument("--wire-resume-objects", type=int, default=1000,
                     help="cluster size for the wire-resume block")
+    ap.add_argument("--failover-only", action="store_true",
+                    help="run ONLY the control-plane failover block: "
+                         "WAL-shipping standby, primary SIGKILL mid-burst, "
+                         "promotion MTTR + epoch-chained resume economics "
+                         "(writes BENCH_SELF_FAILOVER artifact)")
+    ap.add_argument("--failover-jobs", type=int, default=120,
+                    help="burst size for --failover-only (default 120)")
+    ap.add_argument("--failover-sessions", type=int, default=4,
+                    help="surviving watch sessions for --failover-only")
+    ap.add_argument("--failover-out", default="BENCH_SELF_FAILOVER_r12.json",
+                    help="artifact path for --failover-only")
     ap.add_argument("--node-chaos-only", action="store_true",
                     help="run only the node-loss MTTR block (kill one host "
                          "of a whole-slice TPU gang; measure detect -> "
@@ -1782,6 +2097,22 @@ def main():
             "unit": "x (forced-relist events / delta-resume events per reconnect)",
             "vs_baseline": None,
             "wire_resume": block,
+        }))
+        return
+
+    if args.failover_only:
+        block = run_failover(jobs=args.failover_jobs,
+                             watch_sessions=args.failover_sessions,
+                             out=args.failover_out)
+        print(json.dumps({
+            "metric": "failover_mttr_s",
+            "value": block["mttr_s"],
+            "unit": "s (primary SIGKILL -> first acknowledged write on the "
+                    "promoted standby, via the failover client's ordinary "
+                    "retry arm; promote_s isolates the detection+promotion "
+                    "share)",
+            "vs_baseline": None,
+            "failover": block,
         }))
         return
 

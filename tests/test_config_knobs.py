@@ -145,3 +145,94 @@ class TestWatchRingKnob:
             assert server._ring.size == 33
         finally:
             server.close()
+
+
+class TestReplicationKnobs:
+    """PR 9 satellite: the replication_* knobs ride the same
+    flag -> OperatorConfig -> real-construction path as every other knob
+    (make_host_store for the WAL ring; StandbyController for the tail)."""
+
+    def test_cli_flags_reach_config_and_store(self, tmp_path):
+        from training_operator_tpu.__main__ import make_host_store
+
+        args = parse_args([
+            "--replication-wal-ring", "128",
+            "--replication-lease-seconds", "2.5",
+            "--replication-poll-timeout", "0.75",
+            "--replication-max-lag-seconds", "11.0",
+        ])
+        cfg = build_config(args)
+        assert cfg.replication_wal_ring == 128
+        assert cfg.replication_lease_seconds == 2.5
+        assert cfg.replication_poll_timeout == 0.75
+        assert cfg.replication_max_lag_seconds == 11.0
+        store = make_host_store(cfg, str(tmp_path))
+        assert store.wal_ring == 128
+        store.close()
+
+    def test_config_file_round_trip(self, tmp_path):
+        path = tmp_path / "op.json"
+        path.write_text(json.dumps({
+            "replication_wal_ring": 64,
+            "replication_lease_seconds": 3.0,
+            "replication_poll_timeout": 1.5,
+            "replication_max_lag_seconds": 45.0,
+        }))
+        args = parse_args(["--config", str(path)])
+        cfg = build_config(args)
+        assert cfg.replication_wal_ring == 64
+        assert cfg.replication_lease_seconds == 3.0
+        assert cfg.replication_poll_timeout == 1.5
+        assert cfg.replication_max_lag_seconds == 45.0
+        # CLI overrides the file (the standard precedence).
+        args = parse_args(["--config", str(path),
+                           "--replication-lease-seconds", "9"])
+        assert build_config(args).replication_lease_seconds == 9.0
+
+    def test_knobs_reach_the_standby_controller(self, tmp_path):
+        from training_operator_tpu.cluster.replication import StandbyController
+
+        cfg = build_config(parse_args([
+            "--replication-poll-timeout", "0.5",
+            "--replication-lease-seconds", "4.0",
+        ]))
+        cluster = Cluster()
+        ctrl = StandbyController(
+            cluster, "http://127.0.0.1:1",
+            poll_timeout=cfg.replication_poll_timeout,
+            lease_duration=cfg.replication_lease_seconds,
+        )
+        assert ctrl.poll_timeout == 0.5
+        assert ctrl.lease_duration == 4.0
+
+    def test_defaults_match_store_defaults(self, tmp_path):
+        from training_operator_tpu.__main__ import make_host_store
+        from training_operator_tpu.cluster.store import HostStore
+
+        store = make_host_store(OperatorConfig(), str(tmp_path))
+        bare = HostStore(str(tmp_path / "bare"))
+        assert store.wal_ring == bare.wal_ring == 65536
+        store.close()
+        bare.close()
+
+    def test_validation_bounds(self):
+        with pytest.raises(ValueError):
+            OperatorConfig(replication_wal_ring=0).validate()
+        with pytest.raises(ValueError):
+            OperatorConfig(replication_lease_seconds=0.0).validate()
+        with pytest.raises(ValueError):
+            OperatorConfig(replication_poll_timeout=0.0).validate()
+        with pytest.raises(ValueError):
+            OperatorConfig(replication_max_lag_seconds=-1.0).validate()
+
+    def test_api_server_flag_accepts_ha_address_list(self):
+        from training_operator_tpu.__main__ import make_remote_api
+
+        cfg = build_config(parse_args([]))
+        remote = make_remote_api(
+            cfg, "http://127.0.0.1:1001, http://127.0.0.1:1002"
+        )
+        assert remote.addresses == [
+            "http://127.0.0.1:1001", "http://127.0.0.1:1002"
+        ]
+        assert remote.base_url == "http://127.0.0.1:1001"

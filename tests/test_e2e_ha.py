@@ -271,3 +271,189 @@ def test_token_authed_wire_deployment(tmp_path):
             anon.list("JAXJob")
     finally:
         _kill_all(procs)
+
+
+def _wait_job(client, name, pred, timeout):
+    """Poll a failover client for a job condition, absorbing the rotation
+    errors a dead address surfaces mid-failover."""
+    from training_operator_tpu.cluster.httpapi import ApiUnavailableError
+
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            last = client.try_get("JAXJob", "default", name)
+        except ApiUnavailableError:
+            last = None
+        if last is not None and pred(last):
+            return last
+        time.sleep(0.1)
+    raise AssertionError(f"job {name} never satisfied predicate; last={last}")
+
+
+def test_dual_failure_standby_promoted_then_new_primary_killed(tmp_path):
+    """PR 9 dual-failure e2e, ≥3 OS processes over localhost sockets:
+
+      host A (primary, durable)  <-WAL-  standby B (durable)  <-wire- op C
+
+    Kill -9 A mid-job -> B auto-promotes (lease expiry) and the SAME
+    operator process converges the job over the failover client. Writes
+    accepted on B's new epoch are then put to the sword: kill -9 B and
+    restart it from ITS OWN state dir — nothing accepted on either epoch
+    is lost, and the test-process client that stayed connected throughout
+    relists at most once (B's restart is an unchained incarnation; the
+    A->B failover itself heals by chained delta). The training_wire_resume
+    counters live in the SERVER processes here, so the relist evidence is
+    client-side: the watch client's relist arm goes through its own
+    `.list`, recorded for the whole scenario."""
+    inv = tmp_path / "cluster.json"
+    inv.write_text('{"cpu_pools": [{"nodes": 2, "cpu_per_node": 8.0}]}')
+    state_a = tmp_path / "state-a"
+    state_b = tmp_path / "state-b"
+    port_a, port_b = _free_port(), _free_port()
+
+    host_a = _spawn([
+        "--role", "host", "--serve-port", str(port_a), "--insecure",
+        "--gang-scheduler-name", "none", "--cluster", str(inv),
+        "--state-dir", str(state_a),
+        "--replication-lease-seconds", "1", "--leader-identity", "host-a",
+    ])
+    procs = [host_a]
+    try:
+        url_a = _read_line_with_prefix(host_a, "WIRE_API")
+        standby_b = _spawn([
+            "--standby-of", url_a, "--serve-port", str(port_b), "--insecure",
+            "--gang-scheduler-name", "none", "--state-dir", str(state_b),
+            "--replication-lease-seconds", "1",
+            "--replication-poll-timeout", "0.3",
+            "--leader-identity", "host-b",
+        ])
+        procs.append(standby_b)
+        url_b = _read_line_with_prefix(standby_b, "WIRE_API")
+
+        operator = _spawn([
+            "--role", "operator", "--api-server", f"{url_a},{url_b}",
+            "--enable-scheme", "jax", "--gang-scheduler-name", "none",
+        ])
+        procs.append(operator)
+        _read_line_with_prefix(operator, "OPERATOR_UP")
+
+        client = RemoteAPIServer(addresses=[url_a, url_b], timeout=5.0)
+        # A DEDICATED client for the watch, so every `.list` it makes is a
+        # relist (the CRUD/poll client below lists on purpose).
+        watcher = RemoteAPIServer(addresses=[url_a, url_b], timeout=5.0)
+        wq = watcher.watch(kinds=["JAXJob"])
+        relists = []
+        orig_list = watcher.list
+        watcher.list = lambda *a, **k: relists.append(a) or orig_list(*a, **k)
+
+        def drain():
+            from training_operator_tpu.cluster.httpapi import (
+                ApiUnavailableError,
+            )
+
+            try:
+                return wq.drain(timeout=0.2)
+            except ApiUnavailableError:
+                return []
+
+        client.create(_job("dual-1", run_seconds=6.0))
+        _wait_job(client, "dual-1", lambda j: capi.is_running(j.status),
+                  timeout=30)
+        drain()
+
+        # -- failure one: the primary dies mid-job ----------------------
+        host_a.send_signal(signal.SIGKILL)
+        host_a.communicate()
+        assert _read_line_with_prefix(standby_b, "PROMOTED", timeout=30.0) \
+            == "host-b"
+
+        job1 = _wait_job(client, "dual-1",
+                         lambda j: capi.is_succeeded(j.status), timeout=60)
+        assert capi.is_succeeded(job1.status)
+        # A write accepted on the NEW epoch (B's primacy).
+        from training_operator_tpu.cluster.httpapi import ApiUnavailableError
+
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                client.create(_job("dual-2", run_seconds=0.5))
+                break
+            except ApiUnavailableError:
+                assert time.monotonic() < deadline
+                time.sleep(0.1)
+        _wait_job(client, "dual-2",
+                  lambda j: capi.is_succeeded(j.status), timeout=60)
+        # The surviving watch session observed the post-failover history
+        # (delta over the epoch chain), without relisting.
+        deadline = time.monotonic() + 15
+        seen = set()
+        while time.monotonic() < deadline:
+            seen |= {e.obj.metadata.name for e in drain()}
+            if "dual-2" in seen:
+                break
+        assert "dual-2" in seen, f"watch never saw the post-failover job: {seen}"
+        assert relists == [], (
+            "the A->B failover forced a relist on a chained watermark"
+        )
+
+        # -- failure two: the NEW primary dies and restarts from disk ---
+        standby_b.send_signal(signal.SIGKILL)
+        standby_b.communicate()
+        host_b2 = _spawn([
+            "--role", "host", "--serve-port", str(port_b), "--insecure",
+            "--gang-scheduler-name", "none", "--cluster", str(inv),
+            "--state-dir", str(state_b),
+            "--replication-lease-seconds", "1", "--leader-identity", "host-b",
+        ])
+        procs.append(host_b2)
+        assert _read_line_with_prefix(host_b2, "WIRE_API") == url_b
+
+        # NOTHING accepted on either epoch was lost: the job driven by the
+        # old primary AND the one accepted only by the promoted standby
+        # both survive B's own death, terminal state intact.
+        deadline = time.monotonic() + 30
+        names = {}
+        while time.monotonic() < deadline:
+            try:
+                names = {j.metadata.name: j for j in client.list("JAXJob")}
+                if {"dual-1", "dual-2"} <= set(names):
+                    break
+            except ApiUnavailableError:
+                pass
+            time.sleep(0.2)
+        assert {"dual-1", "dual-2"} <= set(names), sorted(names)
+        assert capi.is_succeeded(names["dual-1"].status)
+        assert capi.is_succeeded(names["dual-2"].status)
+
+        # The surviving operator converges brand-new work end to end.
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                client.create(_job("dual-3", run_seconds=0.5))
+                break
+            except ApiUnavailableError:
+                assert time.monotonic() < deadline
+                time.sleep(0.1)
+        _wait_job(client, "dual-3",
+                  lambda j: capi.is_succeeded(j.status), timeout=60)
+        assert operator.poll() is None, "the operator process died"
+
+        # Drain until the watch has healed over B's restart, then count
+        # the damage: the chained A->B failover cost ZERO relists, B's
+        # unchained disk restart at most ONE — a third never happens.
+        deadline = time.monotonic() + 15
+        healed = False
+        while time.monotonic() < deadline:
+            if any(e.obj.metadata.name == "dual-3" for e in drain()):
+                healed = True
+                break
+        assert healed, "the watch never healed across B's restart"
+        # One relist EPISODE walks every registered kind once; count
+        # episodes by the watched kind's appearances.
+        episodes = sum(1 for a in relists if a and a[0] == "JAXJob")
+        assert episodes <= 1, (
+            f"{episodes} relist episodes for one unchained restart: {relists}"
+        )
+    finally:
+        _kill_all(procs)

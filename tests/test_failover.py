@@ -1,0 +1,630 @@
+"""Control-plane host failover: WAL-shipping warm standby, epoch-chained
+resume, HostChaos tier five (PR 9 tentpole).
+
+The harness runs TWO in-process "host processes" — a primary (durable
+HostStore, wire server, cluster services, v1 jax controllers, fail-fast
+invariant auditor, real-clock step thread) and a warm standby tailing the
+primary's WAL — plus failover clients (`RemoteAPIServer(addresses=[p, s])`).
+HostChaos kills the primary with SIGKILL semantics (step loop halted, wire
+dark, store fd abandoned un-flushed); the standby must EARN promotion via
+the replicated host lease, and surviving watch clients must heal by
+epoch-chained delta resume, never a relist storm.
+
+The acceptance pin lives in TestFailoverChaosBurst: primary killed mid
+120-job burst -> standby promoted -> every job terminal-Succeeded with the
+fail-fast auditor green on both hosts, and the surviving watch client
+replays at most 2x the delta event count with zero too-old relists.
+"""
+
+import threading
+import time
+
+import pytest
+
+import training_operator_tpu.api.common as capi
+from training_operator_tpu import config as config_mod
+from training_operator_tpu.api.common import (
+    Container,
+    PodTemplateSpec,
+    ReplicaSpec,
+)
+from training_operator_tpu.api.defaults import default_job
+from training_operator_tpu.api.jobs import JAXJob, ObjectMeta
+from training_operator_tpu.api.validation import validate_job
+from training_operator_tpu.cluster.chaos import HostChaos
+from training_operator_tpu.cluster.httpapi import (
+    ApiHTTPServer,
+    ApiUnavailableError,
+    RemoteAPIServer,
+)
+from training_operator_tpu.cluster.inventory import make_cpu_pool
+from training_operator_tpu.cluster.objects import ConfigMap
+from training_operator_tpu.cluster.replication import (
+    HOST_LEASE_NAME,
+    HOST_LEASE_NAMESPACE,
+    StandbyController,
+    make_snapshot_source,
+    start_host_lease,
+)
+from training_operator_tpu.cluster.runtime import ANNOTATION_SIM_DURATION, Cluster, WallClock
+from training_operator_tpu.cluster.store import HostStore
+from training_operator_tpu.config import OperatorConfig
+from training_operator_tpu.observe.invariants import (
+    RULES,
+    FleetSources,
+    InvariantAuditor,
+)
+from training_operator_tpu.utils import metrics
+from training_operator_tpu.__main__ import build_stack
+
+LEASE_SECONDS = 1.0   # short: auto-promotion keeps the tests fast
+POLL_TIMEOUT = 0.2    # standby /wal long-poll window
+
+
+def _cfg(**overrides) -> OperatorConfig:
+    base = dict(
+        enabled_schemes=["jax"],
+        gang_scheduler_name="none",
+        enable_v2=False,
+        fleet_audit_interval=0.0,  # the harness runs its OWN fail-fast auditor
+        replication_lease_seconds=LEASE_SECONDS,
+        replication_poll_timeout=POLL_TIMEOUT,
+    )
+    base.update(overrides)
+    return OperatorConfig(**base)
+
+
+def _register_admission(cluster) -> None:
+    # The run_host admission chain, minus v2 (enable_v2=False here).
+    def admit(job) -> None:
+        default_job(job, now=cluster.clock.now())
+        validate_job(job)
+
+    from training_operator_tpu.api.jobs import JOB_KINDS
+
+    for kind in JOB_KINDS:
+        cluster.api.register_admission(kind, admit)
+
+
+class PrimaryStack:
+    """An in-process primary 'host process': durable store, wire server
+    with the replication routes, cluster services + jax controllers,
+    host-primacy lease, fail-fast auditor, and a real-clock step thread."""
+
+    def __init__(self, state_dir, identity="primary-1", audit_interval=0.5,
+                 nodes=8, cpu_per_node=16.0):
+        self.cfg = _cfg()
+        self.cluster = Cluster(WallClock())
+        self.store = HostStore(str(state_dir), wal_ring=65536)
+        restored, _ = self.store.load_into(self.cluster.api)
+        self.store.attach(self.cluster.api)
+        if nodes and not restored:
+            self.cluster.add_nodes(make_cpu_pool(nodes, cpu_per_node=cpu_per_node))
+        _register_admission(self.cluster)
+        self.mgr, _ = build_stack(self.cluster, self.cfg)
+        self.server = ApiHTTPServer(
+            self.cluster.api, port=0, now_fn=self.cluster.clock.now
+        )
+        self.server.wal_source = self.store.wal_page
+        self.server.snapshot_source = make_snapshot_source(
+            self.cluster.api, self.store, self.server.resume_ring
+        )
+        start_host_lease(self.cluster, identity, LEASE_SECONDS)
+        self.auditor = InvariantAuditor(
+            self.cluster.api, self.cluster.clock.now,
+            sources=FleetSources(
+                expectations=self.mgr.unfulfilled_expectations,
+                journal_bytes=self.store.journal_bytes,
+                journal_bound=lambda: self.cfg.compact_max_journal_bytes,
+            ),
+            interval=audit_interval, fail_fast=True,
+        ).attach(self.cluster)
+        self.errors = []
+        self.stop = threading.Event()
+        self.thread = threading.Thread(
+            target=self._loop, name="primary-step", daemon=True
+        )
+        self.thread.start()
+
+    @property
+    def url(self) -> str:
+        return self.server.url
+
+    def _loop(self) -> None:
+        while not self.stop.is_set():
+            try:
+                self.cluster.step()
+            except Exception as e:  # noqa: BLE001 — surfaced to the test
+                self.errors.append(e)
+                self.stop.set()
+                return
+            time.sleep(0.005)
+
+    def shutdown(self) -> None:
+        """Graceful teardown (tests' finally); HostChaos is the violent one."""
+        self.stop.set()
+        self.thread.join(timeout=5.0)
+        try:
+            self.server.close()
+        except Exception:
+            pass
+        try:
+            self.store.close()
+        except Exception:
+            pass
+
+
+class StandbyStack:
+    """The warm-standby twin: bootstraps from the primary, tails its WAL,
+    serves read-only, and on promotion builds the full host stack over the
+    replicated state (the run_standby on_promote arm, in-process)."""
+
+    def __init__(self, state_dir, primary_url, identity="standby-1",
+                 auto_promote=True, audit_interval=0.5):
+        self.cfg = _cfg()
+        self.cluster = Cluster(WallClock())
+        self.store = HostStore(str(state_dir), wal_ring=65536)
+        self.ctrl = StandbyController(
+            self.cluster, primary_url, store=self.store,
+            poll_timeout=POLL_TIMEOUT, lease_duration=LEASE_SECONDS,
+            auto_promote=auto_promote, identity=identity,
+        )
+        self.ctrl.bootstrap()
+        _register_admission(self.cluster)
+        self.server = ApiHTTPServer(
+            self.cluster.api, port=0, now_fn=self.cluster.clock.now
+        )
+        self.ctrl.attach_server(self.server)
+        self.server.wal_source = self.store.wal_page
+        self.server.snapshot_source = make_snapshot_source(
+            self.cluster.api, self.store, self.server.resume_ring
+        )
+        self.mgr = None
+        # The run_standby wiring: the SERVER's fleet sources carry the
+        # replication feed, so GET /fleet and the auditor read one truth.
+        self._sources = self.server.fleet_sources
+        self._sources.replication_lag = self.ctrl.lag
+        self._sources.journal_bytes = self.store.journal_bytes
+        self._sources.journal_bound = (
+            lambda: self.cfg.compact_max_journal_bytes
+        )
+        self.ctrl.on_promote.append(self._on_promote)
+        self.auditor = InvariantAuditor(
+            self.cluster.api, self.cluster.clock.now,
+            sources=self._sources, interval=audit_interval, fail_fast=True,
+        ).attach(self.cluster)
+        self.ctrl.start()
+        self.errors = []
+        self.stop = threading.Event()
+        self.thread = threading.Thread(
+            target=self._loop, name="standby-step", daemon=True
+        )
+        self.thread.start()
+
+    @property
+    def url(self) -> str:
+        return self.server.url
+
+    def _on_promote(self) -> None:
+        self.mgr, _ = build_stack(self.cluster, self.cfg)
+        self._sources.expectations = self.mgr.unfulfilled_expectations
+
+    def _loop(self) -> None:
+        while not self.stop.is_set():
+            try:
+                self.cluster.step()
+                self.ctrl.maybe_complete_promotion()
+            except Exception as e:  # noqa: BLE001 — surfaced to the test
+                self.errors.append(e)
+                self.stop.set()
+                return
+            time.sleep(0.005)
+
+    def wait_caught_up(self, timeout=10.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            lag = self.ctrl.lag()
+            if lag["connected"] and lag["records"] == 0:
+                return
+            time.sleep(0.02)
+        raise AssertionError(f"standby never caught up: {self.ctrl.lag()}")
+
+    def wait_promoted(self, timeout=20.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.ctrl.promoted:
+                return
+            time.sleep(0.02)
+        raise AssertionError("standby was never promoted")
+
+    def shutdown(self) -> None:
+        self.ctrl.stop()
+        self.stop.set()
+        self.thread.join(timeout=5.0)
+        try:
+            self.server.close()
+        except Exception:
+            pass
+        try:
+            self.store.close()
+        except Exception:
+            pass
+
+
+def _cm(name: str) -> ConfigMap:
+    return ConfigMap(metadata=ObjectMeta(name=name), data={"k": name})
+
+
+def _job(name: str, run_seconds: float = 0.3, workers: int = 1) -> JAXJob:
+    return JAXJob(
+        metadata=ObjectMeta(name=name),
+        replica_specs={
+            "Worker": ReplicaSpec(
+                replicas=workers,
+                template=PodTemplateSpec(
+                    containers=[Container(name="jax", image="trainer",
+                                          resources={"cpu": 1.0})],
+                    annotations={ANNOTATION_SIM_DURATION: str(run_seconds)},
+                ),
+            )
+        },
+    )
+
+
+def _resume_counters():
+    return {
+        "delta": metrics.wire_resume_delta.total(),
+        "replayed": metrics.wire_resume_replayed.total(),
+        "too_old": metrics.wire_resume_too_old.total(),
+    }
+
+
+def _resume_deltas(before):
+    now = _resume_counters()
+    return {k: now[k] - before[k] for k in before}
+
+
+@pytest.fixture()
+def ha_pair(tmp_path):
+    primary = PrimaryStack(tmp_path / "primary")
+    standby = None
+    try:
+        standby = StandbyStack(tmp_path / "standby", primary.url)
+        yield primary, standby
+    finally:
+        if standby is not None:
+            standby.shutdown()
+        primary.shutdown()
+
+
+class TestWalShipping:
+    def test_standby_converges_and_serves_reads_but_rejects_writes(self, ha_pair):
+        primary, standby = ha_pair
+        client = RemoteAPIServer(primary.url, timeout=5.0)
+        for i in range(10):
+            client.create(_cm(f"ship-{i}"))
+        standby.wait_caught_up()
+
+        ro = RemoteAPIServer(standby.url, timeout=5.0)
+        names = sorted(c.metadata.name for c in ro.list("ConfigMap"))
+        assert names == sorted(f"ship-{i}" for i in range(10))
+        # resourceVersions are the PRIMARY's, verbatim (seq/rv lockstep).
+        for i in (0, 9):
+            assert (ro.get("ConfigMap", "default", f"ship-{i}").metadata
+                    .resource_version
+                    == client.get("ConfigMap", "default", f"ship-{i}").metadata
+                    .resource_version)
+        # A write to the standby is "not leader, try elsewhere", not a bug.
+        with pytest.raises(ApiUnavailableError):
+            ro.create(_cm("rejected"))
+        # The replicated host lease is the standby's failure detector.
+        lease = ro.get("Lease", HOST_LEASE_NAMESPACE, HOST_LEASE_NAME)
+        assert lease.holder == "primary-1"
+        # /fleet on the standby surfaces how warm it is (INV008's feed).
+        fleet = ro.get_fleet()
+        assert fleet["replication"]["role"] == "standby"
+        assert fleet["replication"]["connected"] is True
+
+    def test_deletes_and_events_replicate(self, ha_pair):
+        primary, standby = ha_pair
+        client = RemoteAPIServer(primary.url, timeout=5.0)
+        for i in range(4):
+            client.create(_cm(f"d-{i}"))
+        client.delete("ConfigMap", "default", "d-1")
+        client.delete("ConfigMap", "default", "d-3")
+        standby.wait_caught_up()
+        ro = RemoteAPIServer(standby.url, timeout=5.0)
+        assert sorted(c.metadata.name for c in ro.list("ConfigMap")) == ["d-0", "d-2"]
+
+
+class TestPromotion:
+    def test_explicit_promote_verb_drains_tail_and_opens_writes(self, ha_pair):
+        primary, standby = ha_pair
+        client = RemoteAPIServer(primary.url, timeout=5.0)
+        for i in range(5):
+            client.create(_cm(f"pre-{i}"))
+
+        sby = RemoteAPIServer(standby.url, timeout=15.0)
+        result = sby.promote()
+        assert result["promoted"] is True and result["identity"] == "standby-1"
+        standby.wait_promoted(timeout=5.0)
+
+        # The drained tail covers every pre-promotion write...
+        assert sorted(c.metadata.name for c in sby.list("ConfigMap")) == sorted(
+            f"pre-{i}" for i in range(5)
+        )
+        # ...and the write gate is open: the ex-standby IS the primary now.
+        sby.create(_cm("post-promote"))
+        assert sby.get("ConfigMap", "default", "post-promote") is not None
+        # It took over the host-primacy lease (takeover increments
+        # transitions — the observable failover record).
+        lease = sby.get("Lease", HOST_LEASE_NAMESPACE, HOST_LEASE_NAME)
+        assert lease.holder == "standby-1"
+        assert lease.transitions >= 1
+        # Promoted role: INV008 goes quiet (no standby to lag).
+        assert standby.ctrl.lag()["role"] == "primary"
+
+    def test_auto_promotion_needs_both_expired_lease_and_dead_tail(self, ha_pair):
+        """Split-brain guard: while WAL pages still flow, a merely-stale
+        lease must NOT promote (lag, not death)."""
+        primary, standby = ha_pair
+        standby.wait_caught_up()
+        # Give the detector several lease windows with a healthy primary.
+        time.sleep(LEASE_SECONDS * 3)
+        assert not standby.ctrl.promoted
+        assert not standby.ctrl._promote_requested.is_set()
+
+    def test_auth_failure_never_auto_promotes(self, ha_pair):
+        """The other split-brain guard: a standby that cannot AUTHENTICATE
+        has no evidence the primary is dead — only that its own credentials
+        are wrong (rotated token, TLS pin). The replicated lease expires
+        locally because replication stopped, which is exactly the wrongful-
+        promotion window if auth-blind read as disconnected."""
+        primary, standby = ha_pair
+        standby.wait_caught_up()
+        real_get_wal = standby.ctrl.remote.get_wal
+
+        def broken(*a, **k):
+            raise PermissionError("GET /wal: bad or missing bearer token")
+
+        standby.ctrl.remote.get_wal = broken
+        try:
+            time.sleep(LEASE_SECONDS * 3)
+            lag = standby.ctrl.lag()
+            assert lag["auth_failed"] is True and lag["connected"] is False
+            assert not standby.ctrl.promoted
+            assert not standby.ctrl._promote_requested.is_set()
+        finally:
+            standby.ctrl.remote.get_wal = real_get_wal
+        # Healed credentials: the tail reconnects and the flag clears.
+        standby.wait_caught_up()
+        assert standby.ctrl.lag()["auth_failed"] is False
+
+    def test_promotion_drain_is_not_page_capped(self, tmp_path):
+        """A lagging standby drains the WHOLE reachable WAL tail before
+        the write gate opens: the drain is wall-clock-bounded, not
+        page-capped (a 3-page cap used to silently lose every acknowledged
+        record past it on a planned promotion)."""
+        primary = PrimaryStack(tmp_path / "drain-primary")
+        try:
+            cluster = Cluster(WallClock())
+            ctrl = StandbyController(
+                cluster, primary.url, poll_timeout=POLL_TIMEOUT,
+                lease_duration=LEASE_SECONDS, auto_promote=False,
+                identity="lagging-standby", page_limit=8,
+            )
+            ctrl.bootstrap()
+            # The tailer is never started: the standby sits at its
+            # bootstrap cursor while the primary accumulates 100 records
+            # = 13 pages of backlog.
+            client = RemoteAPIServer(primary.url, timeout=5.0)
+            for i in range(100):
+                client.create(_cm(f"lag-{i}"))
+            ctrl.request_promotion("planned failover of a lagging standby")
+            assert ctrl.maybe_complete_promotion() is True
+            assert ctrl.lag_records == 0
+            names = {c.metadata.name for c in cluster.api.list("ConfigMap")}
+            assert names.issuperset({f"lag-{i}" for i in range(100)})
+        finally:
+            primary.shutdown()
+
+
+class TestEpochChainedResume:
+    def test_surviving_watch_heals_by_delta_across_failover(self, ha_pair, tmp_path):
+        primary, standby = ha_pair
+        client = RemoteAPIServer(
+            addresses=[primary.url, standby.url], timeout=5.0
+        )
+        wq = client.watch(kinds=["ConfigMap"])
+        for i in range(5):
+            client.create(_cm(f"w-{i}"))
+        got = []
+        deadline = time.monotonic() + 5.0
+        while len(got) < 5 and time.monotonic() < deadline:
+            got.extend(wq.drain(timeout=0.5))
+        assert len(got) == 5
+        standby.wait_caught_up()
+
+        chaos = HostChaos()
+        kill_t = chaos.kill_inprocess(
+            "primary-1", server=primary.server, store=primary.store,
+            stop=primary.stop, threads=[primary.thread],
+        )
+        standby.wait_promoted()
+
+        # MTTR: kill -> first successful write on the promoted standby,
+        # via the failover client's ordinary retry arm (kill_t is WALL
+        # time — HostChaos logs wall times, NodeChaos parity).
+        before = _resume_counters()
+        mttr = None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            try:
+                client.create(_cm("mttr-probe"))
+                mttr = time.time() - kill_t
+                break
+            except ApiUnavailableError:
+                time.sleep(0.05)
+        assert mttr is not None, "no write ever succeeded after failover"
+        assert 0 < mttr < 30.0, f"implausible failover MTTR {mttr}"
+
+        for i in range(3):
+            client.create(_cm(f"post-{i}"))
+
+        # The surviving watch session heals by CHAINED delta: the standby
+        # accepted the dead primary's epoch and seq watermarks. A relist
+        # would call client.list — record any.
+        lists = []
+        orig_list = client.list
+        client.list = lambda *a, **k: lists.append(a) or orig_list(*a, **k)
+        try:
+            events = []
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                try:
+                    events.extend(wq.drain(timeout=0.5))
+                except ApiUnavailableError:
+                    continue
+                names = {e.obj.metadata.name for e in events}
+                if {"mttr-probe", "post-0", "post-1", "post-2"} <= names:
+                    break
+        finally:
+            client.list = orig_list
+        names = [e.obj.metadata.name for e in events]
+        assert {"mttr-probe", "post-0", "post-1", "post-2"} <= set(names)
+        # Exactly once each: the replay/subscribe overlap dedups by seq.
+        assert len(names) == len(set(names))
+        got = _resume_deltas(before)
+        assert got["too_old"] == 0, "failover must not force a relist"
+        assert lists == [], "the relist arm must never fire across failover"
+        assert not standby.errors, standby.errors
+
+
+def test_inv008_replication_lag_rule():
+    """INV008 (satellite): lag over replication_max_lag_seconds on a
+    standby fires once per incident; a promoted (primary) role or healed
+    lag goes quiet."""
+    old = config_mod.current()
+    config_mod.set_current(OperatorConfig(replication_max_lag_seconds=1.0))
+    try:
+        cluster = Cluster()
+        lag = {"role": "standby", "records": 7, "seconds": 9.0,
+               "connected": False, "applied": 0, "bootstraps": 1}
+        auditor = InvariantAuditor(
+            cluster.api, cluster.clock.now,
+            sources=FleetSources(replication_lag=lambda: dict(lag)),
+            rules=[r for r in RULES if r.rule_id == "INV008"],
+        )
+        before = metrics.invariant_violations.value("INV008")
+        active = auditor.audit()
+        assert [v.rule for v in active] == ["INV008"]
+        assert "9.0s" in active[0].message
+        assert metrics.invariant_violations.value("INV008") == before + 1
+        # Once per incident, not once per audit pass.
+        auditor.audit()
+        assert metrics.invariant_violations.value("INV008") == before + 1
+        events = cluster.api.events(object_name="wal-tail", reason="INV008")
+        assert len(events) == 1 and events[0].event_type == "Warning"
+        # Healed: under the bound.
+        lag["seconds"] = 0.2
+        assert auditor.audit() == []
+        # A promoted ex-standby is the primary: lag is meaningless.
+        lag.update(role="primary", seconds=99.0)
+        assert auditor.audit() == []
+        # Standby again over the bound: a NEW incident reports again.
+        lag.update(role="standby", seconds=5.0)
+        assert [v.rule for v in auditor.audit()] == ["INV008"]
+        assert metrics.invariant_violations.value("INV008") == before + 2
+    finally:
+        config_mod.set_current(old)
+
+
+class TestFailoverChaosBurst:
+    def test_primary_sigkill_mid_burst_standby_converges_all_jobs(
+        self, ha_pair
+    ):
+        """THE acceptance pin: 120-job burst, primary SIGKILL'd mid-burst,
+        standby auto-promotes, every job reaches terminal success with the
+        fail-fast invariant auditor green on both hosts — and a client
+        with live watch sessions across the failover heals by delta,
+        replaying at most 2x the events it actually receives (no relist)."""
+        primary, standby = ha_pair
+        n_jobs = 120
+        client = RemoteAPIServer(
+            addresses=[primary.url, standby.url], timeout=5.0
+        )
+        wq = client.watch(kinds=["JAXJob", "Pod"])
+
+        for i in range(n_jobs):
+            client.create(_job(f"burst-{i:03d}", run_seconds=0.3))
+
+        def drain():
+            try:
+                return wq.drain(timeout=0.2)
+            except ApiUnavailableError:
+                return []
+
+        def succeeded():
+            try:
+                return sum(
+                    1 for j in client.list("JAXJob")
+                    if capi.is_succeeded(j.status)
+                )
+            except ApiUnavailableError:
+                return -1
+
+        # Mid-burst: wait for real progress (some terminal, most in flight).
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            drain()
+            if succeeded() >= 30:
+                break
+            time.sleep(0.05)
+        assert succeeded() >= 30, "burst never got going"
+        standby.wait_caught_up(timeout=20.0)
+
+        before = _resume_counters()
+        chaos = HostChaos()
+        kill_t = chaos.kill_inprocess(
+            "primary-1", server=primary.server, store=primary.store,
+            stop=primary.stop, threads=[primary.thread],
+        )
+        standby.wait_promoted()
+        assert chaos.kills and chaos.kills[0][1] == "primary-1"
+
+        # Every job converges on the promoted standby: the restored RUNNING
+        # pods finish (kubelet backlog), pending ones schedule and run.
+        post_kill_events = 0
+        all_done = False
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            post_kill_events += len(drain())
+            if succeeded() == n_jobs:
+                all_done = True
+                break
+            time.sleep(0.05)
+        assert all_done, (
+            f"only {succeeded()}/{n_jobs} jobs Succeeded after failover "
+            f"(standby errors: {standby.errors})"
+        )
+        recovery_wall = time.time() - kill_t
+        assert 0 < recovery_wall < 120.0
+
+        # Fail-fast auditors stayed green on BOTH hosts for the whole run
+        # (a violation raises out of the step loop into .errors).
+        assert not primary.errors, primary.errors
+        assert not standby.errors, standby.errors
+        assert standby.auditor.last_violations == []
+        assert standby.auditor.audits > 0
+
+        # The surviving watch client healed by chained resume: zero
+        # too-old relists, and the replayed events are bounded by what it
+        # actually received after the kill (<= 2x the delta, not O(store)).
+        got = _resume_deltas(before)
+        assert got["too_old"] == 0, "failover forced a relist"
+        assert got["delta"] >= 1, "the resume arm never fired"
+        assert post_kill_events >= 1
+        assert got["replayed"] <= 2 * post_kill_events, (
+            f"replayed {got['replayed']} events for {post_kill_events} "
+            f"delivered — a relist storm in delta clothing"
+        )

@@ -410,3 +410,148 @@ class TestJournalWriteFailure:
         api2 = _recover(tmp_path)
         names = {p.metadata.name for p in api2.list("Pod")}
         assert names == {"durable"}
+
+
+class TestTornTailTolerance:
+    """PR 9 satellite: a crash mid-append (routine with journal_fsync off)
+    must degrade to 'lose the torn suffix', never 'refuse to start' — and
+    replay itself must stay read-only so inspecting a crashed state dir
+    cannot alter the evidence. The physical truncation is deferred to the
+    next append (attach), the one moment it becomes load-bearing."""
+
+    def test_replay_is_read_only_counts_and_logs(self, tmp_path):
+        from training_operator_tpu.utils import metrics
+
+        api = APIServer()
+        store = HostStore(str(tmp_path))
+        store.load_into(api)
+        store.attach(api)
+        for i in range(3):
+            api.create(_pod(f"whole-{i}"))
+        store.close()
+        path = tmp_path / journal_name(0)
+        with open(path, "a") as f:
+            f.write('{"op": "put", "obj": {"kind": "Pod", "met')
+        size_torn = os.path.getsize(path)
+
+        before = metrics.journal_torn_tail.total()
+        api2 = APIServer()
+        store2 = HostStore(str(tmp_path))
+        store2.load_into(api2)
+        # Every whole record replayed; the tear detected and counted...
+        assert len(api2.list("Pod")) == 3
+        assert metrics.journal_torn_tail.total() == before + 1
+        # ...but the file is UNTOUCHED: replay never writes.
+        assert os.path.getsize(path) == size_torn
+        assert str(path) in store2._torn_tails
+
+    def test_attach_truncates_then_appends_cleanly(self, tmp_path):
+        api = APIServer()
+        store = HostStore(str(tmp_path))
+        store.load_into(api)
+        store.attach(api)
+        api.create(_pod("keep"))
+        store.close()
+        path = tmp_path / journal_name(0)
+        whole = os.path.getsize(path)
+        with open(path, "a") as f:
+            f.write('{"op": "put", "obj"')
+
+        api2 = APIServer()
+        store2 = HostStore(str(tmp_path))
+        store2.load_into(api2)
+        store2.attach(api2)  # the truncation moment
+        assert os.path.getsize(path) == whole
+        api2.create(_pod("after-tear"))  # appends at the clean boundary
+        store2.close()
+
+        api3 = _recover(tmp_path)
+        assert {p.metadata.name for p in api3.list("Pod")} == {
+            "keep", "after-tear"
+        }
+
+    def test_torn_tail_with_fsync_off_is_not_fatal_at_scale(self, tmp_path):
+        """A tear after MANY records: the full prefix survives, only the
+        torn suffix is lost, and startup never raises."""
+        api = APIServer()
+        store = HostStore(str(tmp_path))
+        store.load_into(api)
+        store.attach(api)
+        for i in range(50):
+            api.create(_pod(f"p-{i:02d}"))
+        store.close()
+        with open(tmp_path / journal_name(0), "a") as f:
+            f.write('{"op"')
+        api2 = _recover(tmp_path)
+        assert len(api2.list("Pod")) == 50
+
+
+class TestCrashSafeCompaction:
+    """PR 9 satellite: compaction's crash windows. The sequence is
+    temp-write + fsync -> atomic rename -> dir fsync -> THEN unlink old
+    journals; a crash at any point must leave either (old snapshot + all
+    journals) or (new snapshot + all journals) — never neither."""
+
+    def _seed(self, tmp_path, n=5):
+        api = APIServer()
+        store = HostStore(str(tmp_path))
+        store.load_into(api)
+        store.attach(api)
+        for i in range(n):
+            api.create(_pod(f"c-{i}"))
+        return api, store
+
+    def test_crash_between_temp_write_and_replace_loses_nothing(
+        self, tmp_path, monkeypatch
+    ):
+        api, store = self._seed(tmp_path)
+        real_replace = os.replace
+
+        def boom(src, dst, *a, **k):
+            if str(dst).endswith(SNAPSHOT):
+                raise OSError("injected crash before the rename")
+            return real_replace(src, dst, *a, **k)
+
+        monkeypatch.setattr(os, "replace", boom)
+        with pytest.raises(OSError):
+            store.compact(api)
+        monkeypatch.undo()
+
+        # The snapshot never landed, and every journal generation is still
+        # on disk (including the freshly rotated one the compact opened):
+        # recovery replays the full history.
+        assert not os.path.exists(tmp_path / SNAPSHOT)
+        api2 = _recover(tmp_path)
+        assert len(api2.list("Pod")) == 5
+
+    def test_crash_between_replace_and_unlink_loses_nothing(
+        self, tmp_path, monkeypatch
+    ):
+        api, store = self._seed(tmp_path)
+        real_unlink = os.unlink
+
+        def boom(path, *a, **k):
+            if journal_name(0) in str(path):
+                raise OSError("injected crash before old-journal unlink")
+            return real_unlink(path, *a, **k)
+
+        monkeypatch.setattr(os, "unlink", boom)
+        store.compact(api)  # unlink failure is absorbed (crash-equivalent)
+        monkeypatch.undo()
+
+        # New snapshot + the stale gen-0 journal coexist; recovery must
+        # skip the stale generation (gen filter), not double-apply it.
+        assert os.path.exists(tmp_path / SNAPSHOT)
+        assert os.path.exists(tmp_path / journal_name(0))
+        api2 = _recover(tmp_path)
+        assert len(api2.list("Pod")) == 5
+        # The stale journal is cleaned up by that recovery pass.
+        assert not os.path.exists(tmp_path / journal_name(0))
+
+    def test_leftover_temp_snapshot_is_ignored(self, tmp_path):
+        api, store = self._seed(tmp_path)
+        # A crash mid-temp-write leaves a partial .tmp; it must never be
+        # read as a snapshot.
+        (tmp_path / (SNAPSHOT + ".tmp")).write_text('{"rv": 999, "objec')
+        api2 = _recover(tmp_path)
+        assert len(api2.list("Pod")) == 5

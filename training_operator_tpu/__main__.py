@@ -91,14 +91,42 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     ap.add_argument("--config", help="OperatorConfig JSON file (see config.py)")
     ap.add_argument(
-        "--role", default="standalone", choices=("standalone", "host", "operator"),
+        "--role", default="standalone",
+        choices=("standalone", "host", "operator", "standby"),
         help="standalone: full in-process stack (default). "
              "host: substrate only — API server over HTTP (--serve-port), "
              "default scheduler, kubelet, gang scheduler; no job controllers. "
              "operator: job controllers only, against a remote --api-server "
              "(the reference's real deployment shape: operator pods talking "
-             "to a kube-apiserver; cmd/training-operator.v1/main.go:134-166)",
+             "to a kube-apiserver; cmd/training-operator.v1/main.go:134-166). "
+             "standby: warm standby of a primary host (--standby-of URL) — "
+             "tails its WAL, serves bounded-staleness reads, promotes to "
+             "primary on lease expiry or POST /promote",
     )
+    ap.add_argument("--standby-of", default=None, metavar="URL",
+                    help="standby role (implied by this flag): primary host "
+                         "to replicate from — bootstrap via GET /replication/"
+                         "snapshot, then tail GET /wal")
+    ap.add_argument("--no-auto-promote", dest="auto_promote",
+                    action="store_false", default=True,
+                    help="standby role: never promote on lease expiry — only "
+                         "the explicit promote verb (planned failover) "
+                         "flips this standby to primary")
+    ap.add_argument("--replication-wal-ring", type=int, default=None,
+                    help="host role: journaled records retained in memory "
+                         "for standby WAL tailing; further behind than this "
+                         "re-bootstraps from a snapshot (default 65536)")
+    ap.add_argument("--replication-lease-seconds", type=float, default=None,
+                    help="host-primacy lease duration: primary silence "
+                         "(lease expired AND WAL tail dead this long) "
+                         "before a standby auto-promotes (default 5)")
+    ap.add_argument("--replication-poll-timeout", type=float, default=None,
+                    help="standby role: /wal long-poll window in seconds — "
+                         "bounds steady-state replication lag (default 2)")
+    ap.add_argument("--replication-max-lag-seconds", type=float, default=None,
+                    help="standby role: INV008 threshold — replication lag "
+                         "older than this is a standing invariant "
+                         "violation (default 30)")
     ap.add_argument("--serve-port", type=int, default=0,
                     help="host role: HTTP API port (0 = ephemeral; the chosen "
                          "endpoint is printed as WIRE_API=... on stdout)")
@@ -128,7 +156,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                          "than the ring falls back to a full relist "
                          "(default 8192)")
     ap.add_argument("--api-server", default=None, metavar="URL",
-                    help="operator role: base URL of the serving host")
+                    help="operator role: base URL of the serving host; a "
+                         "comma-separated list (\"primary,standby\") makes "
+                         "the client fail over on transport failure or a "
+                         "NotLeader answer")
     ap.add_argument("--wire-pipeline-depth", type=int, default=None,
                     help="operator role: max requests framed into one "
                          "POST /batch envelope (wire protocol v2 request "
@@ -279,6 +310,14 @@ def build_config(args: argparse.Namespace) -> OperatorConfig:
         cfg.fleet_audit_interval = args.audit_interval
     if args.controller_threads is not None:
         cfg.controller_threads = args.controller_threads
+    if args.replication_wal_ring is not None:
+        cfg.replication_wal_ring = args.replication_wal_ring
+    if args.replication_lease_seconds is not None:
+        cfg.replication_lease_seconds = args.replication_lease_seconds
+    if args.replication_poll_timeout is not None:
+        cfg.replication_poll_timeout = args.replication_poll_timeout
+    if args.replication_max_lag_seconds is not None:
+        cfg.replication_max_lag_seconds = args.replication_max_lag_seconds
     if args.compact_every is not None:
         cfg.compact_every = args.compact_every
     if args.compact_max_journal_bytes is not None:
@@ -575,6 +614,7 @@ def make_host_store(cfg: OperatorConfig, state_dir: str):
         compact_every=cfg.compact_every,
         compact_max_bytes=cfg.compact_max_journal_bytes,
         fsync_per_record=cfg.journal_fsync,
+        wal_ring=cfg.replication_wal_ring,
     )
 
 
@@ -583,11 +623,16 @@ def make_remote_api(cfg: OperatorConfig, url: str, token: "str | None" = None,
     """The wire client exactly as run_operator constructs it — factored out
     so the knob round-trip tests exercise the REAL flag->config->client
     path (make_host_store pattern). wire_pipeline_depth=0 pins protocol v1
-    (no batch envelopes, no coalescing), whatever the other knobs say."""
+    (no batch envelopes, no coalescing), whatever the other knobs say.
+
+    `url` may be a comma-separated HA endpoint list ("primary,standby"):
+    the client speaks to the first and rotates on transport failure or a
+    NotLeader answer (RemoteAPIServer addresses)."""
     from training_operator_tpu.cluster.httpapi import RemoteAPIServer
 
+    addresses = [u.strip() for u in url.split(",") if u.strip()]
     return RemoteAPIServer(
-        url,
+        addresses=addresses,
         token=token,
         ca_file=ca_file,
         pipeline=cfg.wire_pipeline_depth > 0,
@@ -597,6 +642,29 @@ def make_remote_api(cfg: OperatorConfig, url: str, token: "str | None" = None,
         # hatch really reproduces v1 wire traffic, not a hybrid.
         list_page_limit=cfg.list_page_limit if cfg.wire_pipeline_depth > 0 else 0,
     )
+
+
+def _schedule_cert_rotation(cluster, server, args, cert_dir, ca_path, ca_key):
+    """Re-mint the server cert on a timer (half its lifetime by default) so
+    a long-lived host OR standby never serves an expired cert — pinned
+    clients keep verifying because the CA key pair is reused. Shared by
+    run_host and run_standby: a warm standby is by design the longer-lived
+    process, and an expired cert there kills the failover path exactly when
+    it is needed."""
+    from training_operator_tpu.cluster import certs
+
+    rotate_every = args.tls_rotate_seconds or (
+        certs.SERVER_CERT_DAYS * 86400 / 2
+    )
+
+    def rotate():
+        fresh = certs.mint_server_cert(
+            cert_dir, ca_path, ca_key, hosts=args.tls_san or []
+        )
+        server.rotate_cert(*fresh)
+        cluster.schedule_after(rotate_every, rotate)
+
+    cluster.schedule_after(rotate_every, rotate)
 
 
 def run_host(args, cfg) -> int:
@@ -680,25 +748,31 @@ def run_host(args, cfg) -> int:
         server.fleet_sources.journal_bound = (
             lambda: cfg.compact_max_journal_bytes
         )
+        # Replication plane: a durable host ships its WAL (GET /wal), serves
+        # bootstrap snapshots, and renews the host-primacy lease AGAINST
+        # ITSELF — the renewals journal, ship, and apply, so a standby's
+        # local lease copy goes stale exactly when replication does (the
+        # failure detector rides the replicated data path it guards).
+        from training_operator_tpu.cluster.replication import (
+            make_snapshot_source,
+            start_host_lease,
+        )
+
+        server.wal_source = store.wal_page
+        server.snapshot_source = make_snapshot_source(
+            cluster.api, store, server.resume_ring
+        )
+        start_host_lease(
+            cluster,
+            cfg.leader_identity or f"host-{_os.getpid()}",
+            cfg.replication_lease_seconds,
+        )
     _collector, auditor = wire_fleet_plane(
         cluster, cfg, sources=server.fleet_sources
     )
     server.auditor = auditor
     if tls is not None:
-        from training_operator_tpu.cluster import certs
-
-        rotate_every = args.tls_rotate_seconds or (
-            certs.SERVER_CERT_DAYS * 86400 / 2
-        )
-
-        def rotate():
-            fresh = certs.mint_server_cert(
-                cert_dir, ca_path, ca_key, hosts=args.tls_san or []
-            )
-            server.rotate_cert(*fresh)
-            cluster.schedule_after(rotate_every, rotate)
-
-        cluster.schedule_after(rotate_every, rotate)
+        _schedule_cert_rotation(cluster, server, args, cert_dir, ca_path, ca_key)
     # Machine-parsable endpoint announcements (the e2e harness reads these).
     print(f"WIRE_API={server.url}", flush=True)
     if ca_path is not None:
@@ -731,6 +805,216 @@ def run_host(args, cfg) -> int:
         server.close()
         if store is not None:
             store.close()
+    return 0
+
+
+def run_standby(args, cfg) -> int:
+    """Standby role: the warm-standby host — bootstrap from the primary's
+    replication snapshot, tail its WAL, serve bounded-staleness reads
+    (every write answers 503 NotLeader), and promote to a full host on
+    lease expiry or POST /promote (cluster/replication.py). The etcd-lite
+    answer to the host process being the last unprotected failure domain."""
+    from training_operator_tpu.api.defaults import default_job
+    from training_operator_tpu.api.validation import validate_job
+    from training_operator_tpu.cluster.httpapi import ApiHTTPServer
+    from training_operator_tpu.cluster.replication import (
+        StandbyController,
+        make_snapshot_source,
+    )
+    from training_operator_tpu.cluster.runtime import WallClock
+
+    if not args.standby_of:
+        raise SystemExit("--role standby requires --standby-of URL")
+    if args.virtual_clock:
+        raise SystemExit("--role standby requires a real clock (remote processes share no virtual time)")
+    if args.workload:
+        raise SystemExit("--workload runs controllers; submit via an operator/SDK instead")
+    # A BARE cluster: no inventory. Every object — nodes included — arrives
+    # replicated from the primary; building local nodes here would collide
+    # with the replicated ones at the first applied record.
+    cluster = Cluster(WallClock())
+    store = None
+    if args.state_dir:
+        store = make_host_store(cfg, args.state_dir)
+    import os as _os
+
+    token = args.api_token or _os.environ.get("TPU_OPERATOR_API_TOKEN") or None
+    ca_file = args.ca_cert or _os.environ.get("TPU_OPERATOR_CA_CERT") or None
+    ctrl = StandbyController(
+        cluster,
+        args.standby_of,
+        store=store,
+        token=token,
+        ca_file=ca_file,
+        poll_timeout=cfg.replication_poll_timeout,
+        lease_duration=cfg.replication_lease_seconds,
+        auto_promote=args.auto_promote,
+        identity=cfg.leader_identity,
+    )
+    stop = _install_stop()
+    # Bootstrap BEFORE serving: the first read answered is already a full
+    # bounded-staleness view, never an empty store. A standby started
+    # before its primary just waits here.
+    # Only transport/5xx faults are waited out: a bad bearer token or TLS
+    # pin mismatch surfaces as PermissionError and retrying it forever
+    # would hide a config error (wire_transport's retry taxonomy), and a
+    # 404 from /replication/snapshot means the primary can't ship state
+    # at all — both fail fast with the cause.
+    from training_operator_tpu.cluster.apiserver import NotFoundError
+    from training_operator_tpu.cluster.wire_transport import (
+        ApiServerError,
+        ApiUnavailableError,
+    )
+
+    while not stop.is_set():
+        try:
+            ctrl.bootstrap()
+            break
+        except (ApiUnavailableError, ApiServerError) as e:
+            log.warning("standby bootstrap failed (%s); retrying", e)
+            stop.wait(1.0)
+        except NotFoundError:
+            raise SystemExit(
+                f"--standby-of {args.standby_of}: primary serves no "
+                "replication snapshot — is it running --role host with "
+                "--state-dir (WAL shipping needs the durable store)?"
+            )
+    if stop.is_set():
+        return 0
+
+    # Admission registered NOW so writes are gated the moment promotion
+    # opens them; the replicated ingest path bypasses admission by design
+    # (every shipped record already passed it on the primary).
+    def admit(job) -> None:
+        default_job(job, now=cluster.clock.now())
+        validate_job(job)
+
+    for kind_cls, _ in JOB_KINDS.values():
+        cluster.api.register_admission(kind_cls.KIND, admit)
+    from training_operator_tpu.runtime.webhooks import register_v2_admission
+
+    register_v2_admission(cluster.api)
+
+    tls = None
+    ca_path = None
+    if not args.insecure:
+        # Mirror run_host: CA in the state dir (reused across restarts).
+        # NOTE an operator pinning the PRIMARY's CA will reject this cert —
+        # HA TLS deployments share the CA key pair across both hosts'
+        # state dirs (certs.mint_ca reuses an existing ca.pem/ca.key).
+        from training_operator_tpu.cluster import certs
+
+        cert_dir = args.state_dir or tempfile.mkdtemp(prefix="tpu-operator-certs-")
+        ca_path, ca_key = certs.mint_ca(cert_dir)
+        tls = certs.mint_server_cert(
+            cert_dir, ca_path, ca_key, hosts=args.tls_san or []
+        )
+    server = ApiHTTPServer(
+        cluster.api, port=args.serve_port, bind=args.serve_bind, token=token,
+        now_fn=cluster.clock.now, tls=tls,
+        resume_ring_size=cfg.watch_ring_size,
+        # The write gate must exist BEFORE the serve thread answers its
+        # first request: installed only by attach_server, a client already
+        # retrying against this address (standby restart on a fixed port)
+        # could land a write in the gap, minting a local rv/uid/seq that
+        # diverges the replicated lockstep.
+        read_only_fn=lambda: not ctrl.promoted,
+    )
+    ctrl.attach_server(server)
+    if tls is not None:
+        _schedule_cert_rotation(cluster, server, args, cert_dir, ca_path, ca_key)
+    if store is not None:
+        server.fleet_sources.journal_bytes = store.journal_bytes
+        server.fleet_sources.journal_bound = (
+            lambda: cfg.compact_max_journal_bytes
+        )
+        # This standby ships its OWN WAL too: post-promotion a fresh
+        # standby can chain off it, and pre-promotion a read-only tailer
+        # (backup, analytics) is legal.
+        server.wal_source = store.wal_page
+        server.snapshot_source = make_snapshot_source(
+            cluster.api, store, server.resume_ring
+        )
+    # INV008's feed: the auditor (and GET /fleet) sees replication lag.
+    server.fleet_sources.replication_lag = ctrl.lag
+    _collector, auditor = wire_fleet_plane(
+        cluster, cfg, sources=server.fleet_sources
+    )
+    server.auditor = auditor
+
+    def on_promote():
+        # Become an ordinary host: cluster services constructed over the
+        # replicated state — the same construction-after-restore order
+        # run_host uses with a disk-recovered store. The host-primacy
+        # lease is already held (takeover happened inside promotion).
+        wire_cluster_services(cluster, cfg)
+
+    ctrl.on_promote.append(on_promote)
+    ctrl.start()
+
+    print(f"WIRE_API={server.url}", flush=True)
+    if ca_path is not None:
+        print(f"WIRE_CA={ca_path}", flush=True)
+    print(f"STANDBY_OF={args.standby_of}", flush=True)
+    log.info("standby up: api=%s primary=%s auto_promote=%s",
+             server.url, args.standby_of, args.auto_promote)
+    if cfg.health_port:
+        serve_probes(cluster, cfg.health_port, cfg.metrics_token,
+                     cfg.health_bind_address)
+
+    deadline = (
+        cluster.clock.now() + args.run_seconds if args.run_seconds is not None else None
+    )
+    try:
+        while not stop.is_set():
+            cluster.step()
+            if ctrl.maybe_complete_promotion():
+                print(f"PROMOTED={ctrl.identity}", flush=True)
+            if store is not None:
+                if store.degraded:
+                    log.critical("host store DEGRADED (journal write failed); exiting")
+                    return 1
+                store.maybe_compact(cluster.api)
+            if deadline is not None and cluster.clock.now() >= deadline:
+                break
+            time.sleep(0.01)
+    finally:
+        ctrl.stop()
+        server.close()
+        if store is not None:
+            store.close()
+    return 0
+
+
+def run_promote(argv) -> int:
+    """`python -m training_operator_tpu promote --api-server URL` — the
+    planned-failover verb: flip a standby host to primary (POST /promote).
+    The standby drains the WAL tail it can still reach, takes over the
+    host-primacy lease, and starts accepting writes."""
+    import os as _os
+
+    ap = argparse.ArgumentParser(
+        prog="python -m training_operator_tpu promote",
+        description="promote a standby host to primary (planned failover)",
+    )
+    ap.add_argument("--api-server", required=True, metavar="URL",
+                    help="base URL of the STANDBY host (WIRE_API=...)")
+    ap.add_argument("--api-token", default=None,
+                    help="bearer token (env TPU_OPERATOR_API_TOKEN)")
+    ap.add_argument("--ca-cert", default=None, metavar="PEM",
+                    help="CA bundle pinning an https host (WIRE_CA=...; "
+                         "env TPU_OPERATOR_CA_CERT)")
+    args = ap.parse_args(argv)
+    from training_operator_tpu.cluster.httpapi import RemoteAPIServer
+
+    api = RemoteAPIServer(
+        args.api_server,
+        token=args.api_token or _os.environ.get("TPU_OPERATOR_API_TOKEN") or None,
+        ca_file=args.ca_cert or _os.environ.get("TPU_OPERATOR_CA_CERT") or None,
+    )
+    result = api.promote()
+    print(f"promoted: {result.get('identity')} "
+          f"(seq={result.get('seq')}, {result.get('applied')} records applied)")
     return 0
 
 
@@ -997,7 +1281,11 @@ def main(argv=None) -> int:
         return run_queues(raw[1:])
     if raw and raw[0] in ("cordon", "uncordon", "drain"):
         return run_node_verb(raw[0], raw[1:])
+    if raw and raw[0] == "promote":
+        return run_promote(raw[1:])
     args = parse_args(argv)
+    if args.standby_of and args.role == "standalone":
+        args.role = "standby"  # --standby-of implies the standby role
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
@@ -1005,6 +1293,8 @@ def main(argv=None) -> int:
     cfg = set_current(build_config(args))
     if args.role == "host":
         return run_host(args, cfg)
+    if args.role == "standby":
+        return run_standby(args, cfg)
     if args.role == "operator":
         return run_operator(args, cfg)
     cluster = build_cluster(args)

@@ -265,24 +265,16 @@ class APIServer:
         and uid assignment — these objects already passed both in their
         first life — but announces each as an Added watch event so informers
         constructed before the restore converge. Advances the uid counter
-        past every restored uid so a recreated name can never collide with
-        a dead incarnation's uid (controllers key liveness on uid)."""
-        import itertools as _it
-        import re as _re
-
-        from training_operator_tpu.api.jobs import ObjectMeta
-
+        past every restored uid (advance_uid_floor) so a recreated name can
+        never collide with a dead incarnation's uid (controllers key
+        liveness on uid)."""
         with self._lock:
-            max_uid_seq = 0
             for obj in objects:
                 key = self._key(obj)
                 stored = self._clone(obj)
                 self._objects[key] = stored
                 self._by_kind.setdefault(key[0], {})[key[1:]] = stored
                 self._index_labels(key, stored)
-                m = _re.search(r"-(\d+)$", obj.metadata.uid or "")
-                if m:
-                    max_uid_seq = max(max_uid_seq, int(m.group(1)))
                 self._notify("Added", self._clone(stored))
             self._rv_value = max(self._rv_value, rv)
             for ev in events or []:
@@ -295,11 +287,95 @@ class APIServer:
                     self._pod_logs[key2] = {
                         "lines": list(buf["lines"]), "base": int(buf["base"])
                     }
-            if max_uid_seq:
+            self.advance_uid_floor()
+
+    def apply_replicated(self, rec: Dict[str, Any]) -> None:
+        """Apply one shipped WAL record (the standby's ingest path): the
+        same op vocabulary HostStore._apply replays from disk, but into the
+        LIVE store — with watch notify (standby watch sessions and the
+        resume ring observe replicated events), local write-ahead journal
+        (a standby with its own state dir is durable in its own right), and
+        the primary's resourceVersions preserved verbatim. Bypasses
+        admission and optimistic concurrency: these writes already passed
+        both on the primary, and the journal order being applied IS the
+        primary's write order.
+
+        Seq lockstep invariant: every put/del record advances _event_seq by
+        EXACTLY one (put and del each notify once; a del of a key this
+        store never saw — a gap that a complete stream cannot produce —
+        still burns its seq), and event/log records never notify, mirroring
+        record_event/append_pod_log on the primary. See set_event_seq."""
+        from training_operator_tpu.cluster import wire
+
+        op = rec.get("op")
+        if op == "event":
+            self.record_event(wire.decode(rec["event"], Event))
+            return
+        if op == "log":
+            self.append_pod_log(
+                rec.get("ns", ""), rec["name"], str(rec.get("line", "")),
+                float(rec.get("ts", 0.0)),
+            )
+            return
+        with self._lock:
+            if op == "put":
+                obj = wire.decode(rec["obj"])
+                key = self._key(obj)
+                status_only = bool(rec.get("so"))
+                if self._journal is not None:  # write-ahead, see create()
+                    self._journal("put", obj, status_only)
+                prev = self._objects.get(key)
+                if prev is not None:
+                    self._unindex_labels(key, prev)
+                self._objects[key] = obj
+                self._by_kind.setdefault(key[0], {})[key[1:]] = obj
+                self._index_labels(key, obj)
+                self._rv_value = max(
+                    self._rv_value, int(obj.metadata.resource_version or 0)
+                )
+                self._notify(
+                    "Added" if prev is None else "Modified",
+                    self._clone(obj), status_only=status_only,
+                )
+            elif op == "del":
+                key = (rec["kind"], rec.get("ns", "") or "", rec["name"])
+                if self._journal is not None:  # write-ahead, see create()
+                    self._journal("del", key[0], key[1], key[2],
+                                  int(rec.get("rv", 0)))
+                obj = self._objects.pop(key, None)
+                self._by_kind.get(key[0], {}).pop(key[1:], None)
+                self._rv_value = max(self._rv_value, int(rec.get("rv", 0)))
+                if obj is not None:
+                    self._unindex_labels(key, obj)
+                    if key[0] == "Pod":
+                        self._pod_logs.pop(key[1:], None)
+                    self._notify("Deleted", obj)
+                else:  # pragma: no cover - complete streams can't get here
+                    self._event_seq += 1  # burn the seq: lockstep holds
+
+    def advance_uid_floor(self) -> None:
+        """Advance the process-wide uid counter past every stored object's
+        uid sequence, so the next create() can never mint a uid that
+        collides with a recovered/replicated object's (controllers key
+        liveness on uid). The one re-anchor implementation, shared by
+        restore() (journal recovery) and promotion (apply_replicated
+        preserves the PRIMARY's uids without tracking a running max)."""
+        import itertools as _it
+        import re as _re
+
+        from training_operator_tpu.api.jobs import ObjectMeta
+
+        with self._lock:
+            max_seq = 0
+            for obj in self._objects.values():
+                m = _re.search(r"-(\d+)$", obj.metadata.uid or "")
+                if m:
+                    max_seq = max(max_seq, int(m.group(1)))
+            if max_seq:
                 # Class-level counter: all stores in-process share it, so
                 # only ever advance it.
                 current = next(ObjectMeta._uid_counter)
-                ObjectMeta._uid_counter = _it.count(max(current, max_uid_seq + 1))
+                ObjectMeta._uid_counter = _it.count(max(current, max_seq + 1))
 
     # -- admission ---------------------------------------------------------
 
@@ -340,6 +416,17 @@ class APIServer:
         resume ring is born at (wire_server._ResumeRing)."""
         with self._lock:
             return self._event_seq
+
+    def set_event_seq(self, seq: int) -> None:
+        """Advance (never rewind) the watch-event sequence counter — the
+        standby's bootstrap alignment: after restoring the primary's
+        snapshot it pins its counter to the primary's, and from there every
+        replicated put/del notifies exactly once (apply_replicated), so the
+        two processes assign IDENTICAL seq numbers to identical events.
+        That lockstep is what lets a promoted standby answer a surviving
+        client's primary-epoch watermark with a delta instead of a relist."""
+        with self._lock:
+            self._event_seq = max(self._event_seq, int(seq))
 
     def object_counts(self) -> Dict[str, int]:
         """Live object count per kind — the fleet collector's store-size
@@ -508,7 +595,10 @@ class APIServer:
             obj.metadata.resource_version = self._next_rv()
             stored = self._clone(obj)
             if self._journal is not None:  # write-ahead, see create()
-                self._journal("put", stored)
+                # status_only rides the journal record so a standby's
+                # replicated watch events carry the same predicate (managers
+                # skip re-enqueueing their own status echoes after failover).
+                self._journal("put", stored, status_only)
             self._unindex_labels(key, current)
             self._objects[key] = stored
             self._by_kind.setdefault(key[0], {})[key[1:]] = stored

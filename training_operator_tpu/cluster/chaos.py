@@ -245,6 +245,85 @@ class NodeChaos:
             self.cluster.schedule_after(self.interval, self._strike)
 
 
+class HostChaos:
+    """Control-plane HOST death — the fifth chaos tier. ChaosMonkey kills
+    pods, NodeChaos kills worker hosts, APIChaos corrupts store semantics,
+    WireChaos corrupts the transport; this tier kills the process that IS
+    the control plane, mid-burst, so everything PR 9 built — WAL-shipped
+    warm standby, lease-expiry promotion, epoch-chained watch resume,
+    client address failover — must be EARNED, not assumed.
+
+    Two kill shapes, matching the two ways tests run a host:
+
+      kill_inprocess(...)   SIGKILL semantics for an in-process host stack:
+                            the step loop stops mid-stride (stop event),
+                            the HTTP listener and its sessions die
+                            (server.close), and the durable store's fd is
+                            ABANDONED — never flushed or compacted again
+                            (HostStore.abandon), exactly the state kill -9
+                            leaves on disk. Components are keyword-optional
+                            so partial stacks (no store) inject the same.
+      kill_process(proc)    SIGKILL a real host OS process (subprocess
+                            .Popen) and reap it — the cross-process twin.
+
+    `log` records (wall time, action, target) and `kills` mirrors the
+    NodeChaos (time, target) schedule for replay/assertions."""
+
+    def __init__(self):
+        import time as _time
+
+        self._now = _time.time
+        self.kills: List[Tuple[float, str]] = []
+        self.log: List[Tuple[float, str, str]] = []
+
+    def _record(self, action: str, target: str) -> float:
+        now = self._now()
+        self.log.append((now, action, target))
+        return now
+
+    def kill_inprocess(self, name: str = "primary", server=None, store=None,
+                       stop=None, threads=()) -> float:
+        """Abruptly kill an in-process host stack; returns the kill wall
+        time (MTTR measurements start here). Order matters: the step loop
+        is halted FIRST so no timer fires into a half-dead stack, then the
+        wire goes dark, then the store is abandoned."""
+        if stop is not None:
+            stop.set()
+        for t in threads:
+            # Step threads are daemons; a bounded join keeps the kill
+            # "instant" from the cluster's perspective without leaking an
+            # actively stepping loop into the post-mortem assertions.
+            t.join(timeout=5.0)
+        if server is not None:
+            # kill() severs established keep-alive connections too (a
+            # graceful close would let the standby's WAL long-poll keep
+            # being served by a "dead" host); plain close() for servers
+            # without the abrupt arm.
+            getattr(server, "kill", server.close)()
+        if store is not None:
+            store.abandon()
+        now = self._record("kill_inprocess", name)
+        self.kills.append((now, name))
+        return now
+
+    def kill_process(self, proc, name: str = "primary") -> float:
+        """SIGKILL a host OS process and reap it; returns the kill time."""
+        import signal as _signal
+
+        proc.send_signal(_signal.SIGKILL)
+        proc.wait()
+        now = self._record("kill_process", name)
+        self.kills.append((now, name))
+        return now
+
+    def promote(self, standby_controller, reason: str = "chaos promotion") -> None:
+        """Request promotion on an in-process StandbyController (the
+        explicit-verb arm; lease-expiry auto-promotion needs no help).
+        The owner's loop completes it via maybe_complete_promotion."""
+        standby_controller.request_promotion(reason)
+        self._record("promote", standby_controller.identity)
+
+
 class APIChaos:
     """Control-plane fault injection against one APIServer.
 

@@ -1,0 +1,575 @@
+"""Control-plane replication: WAL-shipping warm standby + failover.
+
+The reference operator survives process death because etcd is replicated
+and the apiserver is stateless; this framework's `--role host` process is
+both collapsed into one, so after PR 5 closed the node failure domain the
+host itself was the last unprotected one. This module is the etcd/raft-lite
+answer (PAPERS.md: etcd's WAL + snapshot replication), scoped to one warm
+standby:
+
+  primary   a normal `--role host --state-dir` process. Its `HostStore`
+            keeps an in-memory ring of every journaled record tagged with a
+            monotonic replication seq (`wal_page`), served at `GET /wal`;
+            `GET /replication/snapshot` serves an atomic (state, watch-seq,
+            WAL-cursor, resume-floor) capture for bootstrap; a host Lease
+            (`HOST_LEASE_NAME`, renewed on the host's own store and
+            therefore REPLICATED) is the failure detector.
+  standby   `--standby-of <primary>` (`StandbyController` here): bootstraps
+            from the snapshot, tails `/wal` with long-polls, applies each
+            record through `APIServer.apply_replicated` — live watch
+            notify, local write-ahead journal, primary resourceVersions
+            and watch seqs preserved — and serves bounded-staleness reads
+            while answering every write 503 NotLeader.
+
+Seq lockstep is the point: `apply_replicated` advances the standby's watch
+event counter exactly as the primary's own `_notify` did, so the standby's
+resume ring assigns IDENTICAL seq numbers to identical events. Combined
+with the accepted-epoch chain (`_ResumeRing.seed`), a surviving client that
+presents its dead-primary watermarks to the promoted standby gets a DELTA
+replay instead of a relist storm — failover costs survivors O(missed
+events), which is what the PR 3 resume protocol was built to buy.
+
+Promotion (lease expiry while disconnected, or the explicit `promote`
+verb / `POST /promote`) drains the WAL tail already fetched, advances the
+uid floor, flips the write gate, takes over the host lease with the
+LeaderElector takeover arm (controllers/leader.py semantics), and runs the
+`on_promote` callbacks the owning process registered (cluster services,
+fleet plane). Clients fail over via `RemoteAPIServer(addresses=[primary,
+standby])`: transport failures and NotLeader answers rotate the address,
+watches heal by chained resume, and the write coalescer replays its
+unacknowledged envelope as per-op conflicts.
+
+Split-brain note: auto-promotion requires BOTH the replicated lease to be
+expired AND the WAL tail to be disconnected for a full lease duration — a
+partition where the primary still serves clients but not the standby can
+still promote wrongly (the classic two-node limit; the reference leans on
+etcd quorum for this). Clocks must be comparable across hosts (NTP); the
+lease math is wall-clock.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time as _time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from training_operator_tpu.cluster.apiserver import encode_snapshot
+from training_operator_tpu.cluster.store import HostStore, decode_snapshot
+from training_operator_tpu.cluster.wire_transport import (
+    ApiServerError,
+    ApiUnavailableError,
+    RemoteAPIServer,
+)
+from training_operator_tpu.utils import metrics
+
+log = logging.getLogger(__name__)
+
+# The host-primacy lease: who is allowed to accept writes. Renewed by the
+# primary against its OWN store, so renewals journal -> ship -> apply, and
+# the standby's local copy goes stale exactly when replication does.
+HOST_LEASE_NAME = "training-host-primary"
+HOST_LEASE_NAMESPACE = "operator-system"
+
+
+def make_snapshot_source(api, store: HostStore, ring) -> Callable[[], Dict[str, Any]]:
+    """The host side of `GET /replication/snapshot`: one atomic capture of
+    (state refs, watch-event seq, WAL cursor+epoch, resume floors, epoch
+    chain) under the API lock — mutators hold that lock when the journal
+    sink assigns WAL seqs, so the cursor is exactly consistent with the
+    captured state — with the expensive wire-encode done OUTSIDE it."""
+
+    def snapshot_source() -> Dict[str, Any]:
+        with api.locked():
+            refs = api.snapshot_refs()
+            seq = api.event_seq()
+            wal_head, wal_epoch = store.wal_state()
+            ring.sync()  # events committed before this instant are in-ring
+            kind_seqs = ring.kind_seqs()
+            epochs = sorted(ring.epochs)
+        metrics.replication_snapshots_served.inc()
+        return {
+            "snap": encode_snapshot(refs),
+            "seq": seq,
+            "wal": wal_head,
+            "wal_epoch": wal_epoch,
+            "kind_seqs": kind_seqs,
+            "ring_epochs": epochs,
+        }
+
+    return snapshot_source
+
+
+def start_host_lease(cluster, identity: str, duration: float,
+                     renew_interval: Optional[float] = None):
+    """Run the host-primacy lease on the cluster clock: acquire/renew every
+    duration/3 (controllers/leader.py semantics, reused verbatim). Returns
+    the elector; the caller owns shutdown via elector.release()."""
+    from training_operator_tpu.controllers.leader import LeaderElector
+
+    elector = LeaderElector(
+        cluster.api, cluster.clock.now, identity,
+        lease_name=HOST_LEASE_NAME, namespace=HOST_LEASE_NAMESPACE,
+        lease_duration=duration, renew_interval=renew_interval,
+    )
+
+    def tick():
+        elector.tick()
+        cluster.schedule_after(elector.renew_interval, tick)
+
+    cluster.schedule_after(0.0, tick)
+    return elector
+
+
+class StandbyController:
+    """The warm-standby role: bootstrap, tail, serve stale, promote.
+
+    Owns the replication client against the primary and the standby's
+    replication state machine. The owning process (``__main__.run_standby``
+    or an in-process test stack) drives two things: the cluster step loop
+    (timers: the lease monitor), and `maybe_complete_promotion()` once per
+    iteration — promotion is REQUESTED from any thread (lease timer, the
+    HTTP `/promote` handler) but COMPLETED only on the owner's loop, so
+    service construction never races the step loop it will join.
+    """
+
+    def __init__(
+        self,
+        cluster,
+        primary_url: str,
+        store: Optional[HostStore] = None,
+        token: Optional[str] = None,
+        ca_file: Optional[str] = None,
+        poll_timeout: float = 2.0,
+        lease_duration: float = 5.0,
+        auto_promote: bool = True,
+        identity: Optional[str] = None,
+        page_limit: int = 1024,
+    ):
+        self.cluster = cluster
+        self.api = cluster.api
+        self.store = store
+        self.primary_url = primary_url
+        # Dedicated single-address client: resume/pipelining are watch/write
+        # machinery this tail never uses, and rotation has nowhere to go.
+        self.remote = RemoteAPIServer(
+            primary_url, token=token, ca_file=ca_file,
+            timeout=max(30.0, poll_timeout * 3), resume=False, pipeline=False,
+        )
+        self.poll_timeout = poll_timeout
+        self.lease_duration = lease_duration
+        self.auto_promote = auto_promote
+        self.identity = identity or f"standby-{uuid.uuid4().hex[:8]}"
+        self.page_limit = max(1, int(page_limit))
+        # Set after the server exists (attach_server): the ring the
+        # bootstrap seeds, and the promote hook's home.
+        self.server = None
+        self.elector = None  # set at promotion (host-lease takeover)
+        self.on_promote: List[Callable[[], None]] = []
+        # Replication cursor state (tailer thread only, once started).
+        self._cursor = 0
+        self._wal_epoch: Optional[str] = None
+        self._chain_seed: Optional[Dict[str, Any]] = None  # pre-server seed
+        # Lag as of the last page: (records behind, seconds behind).
+        self.lag_records = 0
+        self.lag_seconds = 0.0
+        self.applied = 0
+        self.bootstraps = 0
+        self.apply_errors = 0
+        self.auth_failed = False
+        self.connected = False
+        self._last_contact: Optional[float] = None  # monotonic
+        self._last_apply: Optional[float] = None  # monotonic, successful apply
+        self.promoted = False
+        self._promote_reason: Optional[str] = None
+        self._promote_requested = threading.Event()
+        self._promote_done = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- bootstrap ---------------------------------------------------------
+
+    def bootstrap(self) -> None:
+        """Full-state sync from the primary: first contact installs the
+        snapshot wholesale (store adopt + APIServer.restore); a RE-bootstrap
+        (WAL ring outrun, or a new primary incarnation) diff-applies it
+        through `apply_replicated` so live standby watchers see the changes
+        as ordinary events. Either way the watch-event counter is pinned to
+        the primary's (`set_event_seq`) and the resume ring inherits the
+        shipped floors + epoch chain — the seq-lockstep foundation."""
+        payload = self.remote.get_replication_snapshot()
+        first = self.bootstraps == 0
+        snap = payload["snap"]
+        seed = (dict(payload.get("kind_seqs", {})),
+                list(payload.get("ring_epochs", [])))
+        if self.store is not None:
+            self.store.adopt_snapshot(snap)
+            self.store.attach(self.api)
+        if first:
+            objects, rv, events, pod_logs = decode_snapshot(snap)
+            self.api.restore(objects, rv, events, pod_logs)
+        else:
+            # Raise the resume floors BEFORE the diff notifies: the diff's
+            # events carry LOW standby-local seqs (the counter froze at the
+            # outrun cursor), so a chained resume answered between diff and
+            # seed would pass the too-old check against the stale floor yet
+            # replay none of the gap — silently incomplete forever. Floor
+            # first (max-merge, idempotent), and that client answers
+            # too_old -> one honest relist instead.
+            if self.server is not None:
+                self.server.resume_ring.seed(*seed)
+            self._diff_apply(snap)
+        self.api.set_event_seq(int(payload.get("seq", 0)))
+        self._cursor = int(payload.get("wal", 0))
+        self._wal_epoch = payload.get("wal_epoch")
+        if self.server is not None:
+            self.server.resume_ring.seed(*seed)
+        else:
+            # Server not built yet (boot order: bootstrap -> serve); the
+            # owner seeds at attach_server.
+            self._chain_seed = seed
+        self.bootstraps += 1
+        metrics.replication_bootstraps.inc()
+        log.info(
+            "standby bootstrap #%d from %s: rv=%s seq=%s wal=%s",
+            self.bootstraps, self.primary_url, snap.get("rv"),
+            payload.get("seq"), payload.get("wal"),
+        )
+
+    def _diff_apply(self, snap: Dict[str, Any]) -> None:
+        """Converge the live store onto a re-fetched snapshot using the
+        replicated-record vocabulary: upsert every object whose stored
+        resourceVersion differs, delete everything the snapshot no longer
+        holds. Each change notifies exactly once, and there are at most as
+        many diffs as records missed, so the diff seqs can never run past
+        the primary's counter before set_event_seq re-pins it. Events and
+        pod logs missed across an outrun gap stay missed (append-only
+        diagnostics; the objects are the state that matters)."""
+        from training_operator_tpu.cluster import wire
+
+        rv = int(snap.get("rv", 0))
+        keep = set()
+        for data in snap.get("objects", []):
+            obj = wire.decode(data)
+            ns = getattr(obj.metadata, "namespace", "") or ""
+            key = (obj.KIND, ns, obj.metadata.name)
+            keep.add(key)
+            if (self.api.resource_version(*key)
+                    != obj.metadata.resource_version):
+                self.api.apply_replicated({"op": "put", "obj": data})
+        stale = []
+        with self.api.locked():
+            # Public enumeration (no _objects poke): the tailer thread is
+            # the only writer on a read-only standby, but the lock keeps
+            # the two-call walk one consistent cut regardless.
+            for kind in self.api.object_counts():
+                for ref in self.api.list_refs(kind):
+                    key = (
+                        kind,
+                        getattr(ref.metadata, "namespace", "") or "",
+                        ref.metadata.name,
+                    )
+                    if key not in keep:
+                        stale.append(key)
+        for kind, ns, name in stale:
+            self.api.apply_replicated(
+                {"op": "del", "kind": kind, "ns": ns, "name": name, "rv": rv}
+            )
+
+    # -- tailing -----------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the WAL tailer thread and (with auto_promote) the lease
+        monitor on the cluster clock. Call after bootstrap()."""
+        self._thread = threading.Thread(
+            target=self._tail_loop, name="wal-tail", daemon=True
+        )
+        self._thread.start()
+        self.cluster.schedule_after(
+            max(0.5, self.lease_duration / 3.0), self._lease_check
+        )
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _fetch_page(self, timeout: float) -> Dict[str, Any]:
+        return self.remote.get_wal(
+            after=self._cursor, limit=self.page_limit, timeout=timeout,
+        )
+
+    def _apply_page(self, page: Dict[str, Any]) -> int:
+        applied = 0
+        last_t = None
+        for rec in page.get("records", []):
+            self.api.apply_replicated(rec["r"])
+            self._cursor = int(rec["s"])
+            last_t = rec.get("t")
+            applied += 1
+        self.applied += applied
+        if applied:
+            metrics.replication_records_applied.inc(amount=applied)
+        head = int(page.get("head", self._cursor))
+        self.lag_records = max(0, head - self._cursor)
+        if self.lag_records == 0:
+            self.lag_seconds = 0.0
+        elif last_t is not None:
+            # Behind mid-page: age the backlog from the newest record we DID
+            # apply against the primary's own clock (no cross-host skew).
+            self.lag_seconds = max(0.0, float(page.get("now", 0.0)) - float(last_t))
+        metrics.replication_lag_records.set(value=float(self.lag_records))
+        metrics.replication_lag_seconds.set(value=self.lag_seconds)
+        self._last_apply = _time.monotonic()
+        return applied
+
+    def _tail_loop(self) -> None:
+        while not self._stop.is_set() and not self.promoted:
+            try:
+                page = self._fetch_page(self.poll_timeout)
+            except Exception as e:  # noqa: BLE001 — the tail outlives any fault
+                if self._stop.is_set() or self.promoted:
+                    return
+                if isinstance(e, PermissionError):
+                    # Config error (rotated bearer token, TLS pin mismatch):
+                    # keep retrying — the operator may fix credentials —
+                    # but LOUDLY (once per incident), and never let it read
+                    # as a dead primary: auth-blind is not proof of death,
+                    # and _lease_check auto-promoting here would split-brain
+                    # against a healthy, still-serving primary.
+                    if not self.auth_failed:
+                        log.warning(
+                            "wal tail: auth failure against %s: %s",
+                            self.primary_url, e,
+                        )
+                    self.auth_failed = True
+                else:
+                    self.auth_failed = False
+                    log.debug("wal tail: primary unreachable (%s)", e)
+                self.connected = False
+                # Lag grows while blind: age since the last applied record.
+                if self._last_contact is not None:
+                    self.lag_seconds = _time.monotonic() - self._last_contact
+                    metrics.replication_lag_seconds.set(value=self.lag_seconds)
+                self._stop.wait(min(0.5, self.poll_timeout))
+                continue
+            self._last_contact = _time.monotonic()
+            self.connected = True
+            self.auth_failed = False
+            if self._stop.is_set() or self.promoted:
+                # Promotion (or shutdown) raced this fetch: do NOT apply —
+                # the promotion drain re-fetches from the same cursor, and
+                # applying here too would double-apply the page (an extra
+                # notify per record breaks the seq lockstep chained resume
+                # depends on).
+                return
+            if page.get("reset") or page.get("wal_epoch") != self._wal_epoch:
+                # Outrun (cursor below the primary's ring floor) or a NEW
+                # primary incarnation: the tail can't be resumed — full
+                # snapshot re-bootstrap, diff-applied into the live store.
+                log.warning(
+                    "wal tail reset (epoch %s -> %s): re-bootstrapping",
+                    self._wal_epoch, page.get("wal_epoch"),
+                )
+                try:
+                    self.bootstrap()
+                except (ApiUnavailableError, ApiServerError) as e:
+                    log.warning("re-bootstrap failed (%s); retrying", e)
+                    self._stop.wait(min(0.5, self.poll_timeout))
+                continue
+            try:
+                self._apply_page(page)
+            except Exception as e:  # noqa: BLE001 — a sick standby must stay visible
+                if self._stop.is_set() or self.promoted:
+                    return
+                # The fetch succeeded but the LOCAL apply did not (own
+                # journal write failed, undecodable record). The cursor
+                # stopped at the last record that did apply, so the next
+                # fetch retries the remainder — but if the fault is
+                # persistent the thread must not die with connected=True
+                # and the lag gauges frozen at a healthy 0: that would
+                # blind INV008 AND the auto-promotion disconnect check at
+                # once. Surface the backlog as lag so the auditor fires.
+                self.apply_errors += 1
+                head = int(page.get("head", self._cursor))
+                self.lag_records = max(0, head - self._cursor)
+                # Age from the last record that DID apply — NOT _last_contact,
+                # which every successful fetch resets to "now".
+                since = self._last_apply or self._last_contact
+                if since is not None:
+                    self.lag_seconds = max(
+                        self.lag_seconds, _time.monotonic() - since
+                    )
+                metrics.replication_lag_records.set(value=float(self.lag_records))
+                metrics.replication_lag_seconds.set(value=self.lag_seconds)
+                log.error("wal apply failed at seq %d: %s", self._cursor, e)
+                self._stop.wait(min(0.5, self.poll_timeout))
+
+    def lag(self) -> Dict[str, Any]:
+        """The fleet/INV008 feed: current replication lag + role."""
+        seconds = self.lag_seconds
+        if not self.connected and self._last_contact is not None:
+            seconds = max(seconds, _time.monotonic() - self._last_contact)
+        return {
+            "role": "primary" if self.promoted else "standby",
+            "records": self.lag_records,
+            "seconds": seconds,
+            "connected": self.connected,
+            "auth_failed": self.auth_failed,
+            "applied": self.applied,
+            "apply_errors": self.apply_errors,
+            "bootstraps": self.bootstraps,
+        }
+
+    # -- promotion ---------------------------------------------------------
+
+    def attach_server(self, server) -> None:
+        """Wire the standby's own ApiHTTPServer: write gate, promote verb,
+        and the inherited resume chain (seed deferred from bootstrap)."""
+        self.server = server
+        server.read_only_fn = lambda: not self.promoted
+        server.promote_hook = self._promote_hook
+        if self._chain_seed is not None:
+            server.resume_ring.seed(*self._chain_seed)
+            self._chain_seed = None
+
+    def _promote_hook(self) -> Dict[str, Any]:
+        """POST /promote (handler thread): request and wait for the owner's
+        loop to complete the promotion — synchronous for the caller."""
+        self.request_promotion("explicit promote verb")
+        if not self._promote_done.wait(30.0):
+            raise ApiServerError("promotion did not complete within 30s")
+        return {
+            "promoted": True,
+            "identity": self.identity,
+            "applied": self.applied,
+            "seq": self.api.event_seq(),
+        }
+
+    def request_promotion(self, reason: str) -> None:
+        if not self._promote_requested.is_set():
+            self._promote_reason = reason
+            self._promote_requested.set()
+
+    def _lease_check(self) -> None:
+        """The failure detector (cluster timer): promote only when the
+        REPLICATED host lease is expired AND the WAL tail has been
+        disconnected a full lease duration — while pages still flow, a
+        stale lease just means replication lag, not a dead primary."""
+        if self._stop.is_set() or self.promoted:
+            return
+        if self.auto_promote and not self._promote_requested.is_set():
+            lease = self.api.try_get(
+                "Lease", HOST_LEASE_NAMESPACE, HOST_LEASE_NAME
+            )
+            # auth_failed excluded: a standby that cannot AUTHENTICATE has
+            # no evidence the primary is dead — only that its own
+            # credentials are wrong. Explicit `promote` stays available.
+            disconnected = not self.connected and not self.auth_failed and (
+                self._last_contact is None
+                or _time.monotonic() - self._last_contact >= self.lease_duration
+            )
+            if (lease is not None and disconnected
+                    and lease.expired(self.cluster.clock.now())):
+                log.warning(
+                    "host lease held by %r expired and primary unreachable: "
+                    "requesting promotion", lease.holder,
+                )
+                self.request_promotion("host lease expired")
+        self.cluster.schedule_after(
+            max(0.5, self.lease_duration / 3.0), self._lease_check
+        )
+
+    def maybe_complete_promotion(self) -> bool:
+        """Owner-loop hook: complete a requested promotion. Returns True
+        the first time the standby becomes the primary."""
+        if self.promoted or not self._promote_requested.is_set():
+            return False
+        self._complete_promotion()
+        return True
+
+    def _complete_promotion(self) -> None:
+        log.warning("promoting standby %s (%s)", self.identity,
+                    self._promote_reason)
+        # Stop the tailer FIRST and wait it out: the drain below re-fetches
+        # from the shared cursor, and a tailer mid-long-poll applying the
+        # same page concurrently would double-apply it (see _tail_loop's
+        # post-fetch stop check, the other half of this handshake).
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=max(5.0, self.poll_timeout * 3))
+        # Drain whatever WAL tail is still reachable — on a planned
+        # promotion (explicit verb, primary alive) this closes the gap
+        # before the write gate opens; on a crash it returns immediately
+        # unreachable. Bounded by WALL CLOCK, not page count: a standby
+        # thousands of records behind must not promote with acknowledged
+        # writes still sitting on the reachable old primary. A reset page
+        # (cursor outran the ring) can't be drained record-by-record — one
+        # snapshot re-bootstrap diff-applies the gap instead.
+        deadline = _time.monotonic() + max(5.0, self.poll_timeout * 3)
+        rebootstrapped = False
+        while _time.monotonic() < deadline:
+            try:
+                page = self._fetch_page(0.0)
+            except (ApiUnavailableError, ApiServerError, PermissionError):
+                break
+            try:
+                if page.get("reset") or page.get("wal_epoch") != self._wal_epoch:
+                    if rebootstrapped:
+                        break
+                    rebootstrapped = True
+                    self.bootstrap()
+                    continue
+                if self._apply_page(page) == 0:
+                    break
+            except (ApiUnavailableError, ApiServerError, PermissionError):
+                break
+            except Exception:  # noqa: BLE001 — promote anyway, but loudly
+                log.exception("promotion drain: local apply failed at seq %d",
+                              self._cursor)
+                break
+        if self.lag_records:
+            log.warning(
+                "promoting %d WAL records behind the last reachable head "
+                "(seq %d)", self.lag_records, self._cursor,
+            )
+        # Replicated objects carry the PRIMARY's uids; the first local
+        # create must not mint a colliding one.
+        self.api.advance_uid_floor()
+        self.promoted = True  # write gate opens (read_only_fn)
+        self.lag_records = 0
+        self.lag_seconds = 0.0
+        metrics.replication_lag_records.set(value=0.0)
+        metrics.replication_lag_seconds.set(value=0.0)
+        metrics.replication_promotions.inc()
+        # Take over the host-primacy lease NOW, expired or not: on a
+        # planned promotion (explicit verb) the old primary is still
+        # renewing, and waiting out its lease would leave the failover
+        # record (holder + transitions) pointing at a host that no longer
+        # owns the writes this store is already accepting. Force-write,
+        # then keep renewing with the LeaderElector so a future standby of
+        # THIS host has its failure detector.
+        now = self.cluster.clock.now()
+        lease = self.api.try_get("Lease", HOST_LEASE_NAMESPACE, HOST_LEASE_NAME)
+        if lease is not None and lease.holder != self.identity:
+            lease.holder = self.identity
+            lease.acquire_time = now
+            lease.renew_time = now
+            lease.lease_duration = self.lease_duration
+            lease.transitions += 1
+            self.api.update(lease, check_version=False)
+        self.elector = start_host_lease(
+            self.cluster, self.identity, self.lease_duration
+        )
+        self.elector.tick()
+        for cb in self.on_promote:
+            try:
+                cb()
+            except Exception:
+                log.exception("on_promote callback failed")
+        self._promote_done.set()
+        log.warning(
+            "standby %s is now PRIMARY (seq=%d, %d records applied, "
+            "%d bootstraps)",
+            self.identity, self.api.event_seq(), self.applied, self.bootstraps,
+        )

@@ -48,21 +48,43 @@ the journal-bytes bound are knobs too: see __init__.
 
 from __future__ import annotations
 
+import itertools as _itertools
 import json
 import logging
 import os
 import re
 import threading
+import time as _time
+import uuid
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from training_operator_tpu.cluster import wire
 from training_operator_tpu.cluster.apiserver import APIServer
 from training_operator_tpu.cluster.objects import Event
+from training_operator_tpu.utils import metrics
 
 log = logging.getLogger(__name__)
 
 SNAPSHOT = "snapshot.json"
 _JOURNAL_RE = re.compile(r"^journal\.(\d+)\.jsonl$")
+
+
+def decode_snapshot(snap: Dict[str, Any]) -> Tuple[List[Any], int, List[Event], Dict[Tuple[str, str], Dict[str, Any]]]:
+    """Decode an encode_snapshot payload back into live state:
+    (objects, rv, events, pod_logs). THE inverse of
+    apiserver.encode_snapshot — shared by local snapshot-file recovery
+    (load_into) and the standby's replication bootstrap
+    (GET /replication/snapshot), so the two cannot drift."""
+    objects = [wire.decode(d) for d in snap.get("objects", [])]
+    events = [wire.decode(d, Event) for d in snap.get("events", [])]
+    pod_logs: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for entry in snap.get("pod_logs", []):
+        pod_logs[(entry["ns"], entry["name"])] = {
+            "lines": [(float(ts), ln) for ts, ln in entry["lines"]],
+            "base": int(entry["base"]),
+        }
+    return objects, int(snap.get("rv", 0)), events, pod_logs
 
 
 class JournalWriteError(RuntimeError):
@@ -100,6 +122,7 @@ class HostStore:
         compact_every: int = 4096,
         compact_max_bytes: int = 64 * 1024 * 1024,
         fsync_per_record: bool = False,
+        wal_ring: int = 65536,
     ):
         """Durability knobs (OperatorConfig.compact_every /
         .compact_max_journal_bytes / .journal_fsync + the matching CLI
@@ -110,7 +133,14 @@ class HostStore:
         upgrades the per-record flush to a real fsync: survives power
         loss, not just kill -9, at the price of gating every control-plane
         write on disk latency (the reference's etcd batches fsyncs for
-        the same reason — this is deliberately opt-in)."""
+        the same reason — this is deliberately opt-in).
+
+        `wal_ring` (OperatorConfig.replication_wal_ring) bounds the
+        in-memory replication tail: every journaled record also lands in a
+        ring served at GET /wal so a warm standby can tail the write-ahead
+        log without touching disk. A standby that falls further behind
+        than the ring retains re-bootstraps from a full snapshot — the
+        etcd snapshot+WAL replication shape."""
         self.root = root
         os.makedirs(root, exist_ok=True)
         self.compact_every = compact_every
@@ -121,6 +151,26 @@ class HostStore:
         self._gen = 0
         self._records_since_snapshot = 0
         self._bytes_since_snapshot = 0
+        # WAL shipping state: monotonic replication seq per record, a
+        # bounded ring of (seq, wall-time, record), and an epoch scoping
+        # seqs to THIS store incarnation (they restart with the process; a
+        # standby holding a cursor from a dead incarnation must re-
+        # bootstrap, never silently resume at a colliding number).
+        self.wal_ring = max(1, int(wal_ring))
+        self.wal_epoch = uuid.uuid4().hex
+        self._wal: "deque[Tuple[int, float, Dict[str, Any]]]" = deque()
+        self._wal_seq = 0
+        self._wal_floor = 0  # newest seq NOT retained (0 = nothing evicted)
+        # Signalled on every WAL append so GET /wal can long-poll instead
+        # of spinning; shares the store lock (waiters release it atomically).
+        self._wal_cond = threading.Condition(self._lock)
+        # Torn trailing records found during replay: path -> byte offset of
+        # the last whole record. Physically truncated lazily by attach()
+        # (the next append), NOT during replay — replay stays read-only, so
+        # recovery inspection of a crashed state dir can never itself
+        # modify the evidence, and a replay-time I/O error can't refuse
+        # startup (training_journal_torn_tail_total counts detections).
+        self._torn_tails: Dict[str, int] = {}
         # Latched on the first journal write failure; read by the host main
         # loop, which exits rather than keep serving writes whose journal
         # records are silently missing (see JournalWriteError).
@@ -144,18 +194,10 @@ class HostStore:
         if os.path.exists(snap_path):
             with open(snap_path) as f:
                 snap = json.load(f)
-            rv = int(snap.get("rv", 0))
             snap_gen = int(snap.get("gen", 0))
-            for data in snap.get("objects", []):
-                obj = wire.decode(data)
+            decoded, rv, events, pod_logs = decode_snapshot(snap)
+            for obj in decoded:
                 objects[_key(obj)] = obj
-            for data in snap.get("events", []):
-                events.append(wire.decode(data, Event))
-            for entry in snap.get("pod_logs", []):
-                pod_logs[(entry["ns"], entry["name"])] = {
-                    "lines": [(float(ts), ln) for ts, ln in entry["lines"]],
-                    "base": int(entry["base"]),
-                }
 
         replayed = 0
         gens = self._journal_gens()
@@ -201,14 +243,19 @@ class HostStore:
 
     def _replay_file(self, path, objects, events, pod_logs) -> Tuple[int, int]:
         """Replay one journal file; returns (records, max rv watermark seen).
-        Truncates a torn trailing record so a future append to the same
-        generation cannot merge with the fragment into one corrupt line
-        that would hide later records."""
+        A torn trailing record (crash mid-append — routine with
+        `journal_fsync` off) stops replay cleanly at the last whole record:
+        it is logged, counted in training_journal_torn_tail_total, and
+        remembered for PHYSICAL truncation on the next append (attach) so a
+        later process appending to the same generation can never merge with
+        the fragment into one corrupt line that would hide acknowledged
+        records behind it. Replay itself never refuses to start over a
+        tear, and never writes."""
         replayed = 0
         max_rv = 0
         valid_end = 0
         torn = False
-        with open(path, "r+") as f:
+        with open(path, "r") as f:
             while True:
                 line = f.readline()
                 if not line:
@@ -231,12 +278,14 @@ class HostStore:
                 valid_end = f.tell()
                 replayed += 1
                 max_rv = max(max_rv, self._apply(rec, objects, events, pod_logs))
-            if torn:
-                f.truncate(valid_end)
-                log.warning(
-                    "%s ended in a torn record; truncated to %d bytes",
-                    path, valid_end,
-                )
+        if torn:
+            self._torn_tails[path] = valid_end
+            metrics.journal_torn_tail.inc()
+            log.warning(
+                "%s ends in a torn record after %d whole record(s); replay "
+                "stopped at byte %d (truncated on next append)",
+                path, replayed, valid_end,
+            )
         return replayed, max_rv
 
     @staticmethod
@@ -266,20 +315,52 @@ class HostStore:
 
     # -- journal sink ------------------------------------------------------
 
+    def _fsync_dir(self) -> None:
+        """fsync the state directory: a rename (snapshot replace) or a
+        newly created journal file is only durable once its directory
+        entry is — without this, a power loss can reorder the metadata
+        ops the crash-window analysis depends on. Best-effort on
+        platforms whose directories refuse fsync."""
+        try:
+            fd = os.open(self.root, os.O_RDONLY)
+        except OSError:  # pragma: no cover - platform-dependent
+            return
+        try:
+            os.fsync(fd)
+        except OSError:  # pragma: no cover - platform-dependent
+            pass
+        finally:
+            os.close(fd)
+
     def attach(self, api: APIServer) -> None:
         """Open the current-generation journal for append and register as
         the APIServer's journal sink. From here on every mutation lands in
         the journal before the API call returns (the sink runs inside the
-        store lock)."""
-        self._journal_fh = open(
-            os.path.join(self.root, journal_name(self._gen)), "a"
-        )
+        store lock). A torn tail recorded during replay is physically
+        truncated HERE — the moment before the first new append could have
+        merged with the fragment."""
+        path = os.path.join(self.root, journal_name(self._gen))
+        torn_at = self._torn_tails.pop(path, None)
+        if torn_at is not None and os.path.exists(path):
+            with open(path, "r+b") as f:
+                f.truncate(torn_at)
+            log.warning("truncated torn journal tail: %s -> %d bytes", path, torn_at)
+        self._journal_fh = open(path, "a")
+        # The dirent of a brand-new generation file must be durable before
+        # records in it count as persisted.
+        self._fsync_dir()
         api.attach_journal(self._sink)
 
     def _sink(self, op: str, *args: Any) -> None:
         if op == "put":
-            (obj,) = args
+            obj = args[0]
             rec = {"op": "put", "obj": wire.encode(obj)}
+            if len(args) > 1 and args[1]:
+                # status_only marker: replicated watch events on a standby
+                # re-announce with the same predicate the primary's did, so
+                # a post-failover operator doesn't re-enqueue its own
+                # status echoes (GenerationChangedPredicate parity).
+                rec["so"] = 1
         elif op == "del":
             kind, ns, name, rv = args
             rec = {"op": "del", "kind": kind, "ns": ns, "name": name, "rv": rv}
@@ -324,6 +405,78 @@ class HostStore:
             # ASCII: len(line) IS the byte count — no second encode of a
             # possibly-megabyte record on the write-ahead hot path.
             self._bytes_since_snapshot += len(line)
+            # Replication tail: the durably journaled record becomes
+            # shippable. Appended only AFTER the append succeeded — a
+            # standby must never apply a record the primary's own journal
+            # does not hold.
+            self._wal_seq += 1
+            self._wal.append((self._wal_seq, _time.time(), rec))
+            if len(self._wal) > self.wal_ring:
+                evicted_seq, _, _ = self._wal.popleft()
+                self._wal_floor = evicted_seq
+            self._wal_cond.notify_all()
+
+    # -- WAL shipping ------------------------------------------------------
+
+    def wal_state(self) -> Tuple[int, str]:
+        """(head seq, wal epoch) — what a snapshot bootstrap hands the
+        standby as its starting cursor. Callers needing the cursor
+        consistent with a state capture take api.locked() around both
+        (mutators hold the api lock when the sink appends here)."""
+        with self._lock:
+            return self._wal_seq, self.wal_epoch
+
+    def wal_page(
+        self, after: int = 0, limit: int = 1024, timeout: float = 0.0,
+    ) -> Dict[str, Any]:
+        """One page of the replication tail: every retained record with
+        seq > `after`, oldest first, at most `limit`. With `timeout` > 0
+        an empty page long-polls on the store condition until a record
+        lands or the window closes (the standby's low-lag tail without a
+        spin). Response:
+
+          {"wal_epoch": ..., "head": <newest seq>, "now": <host wall time>,
+           "records": [{"s": seq, "t": wall-time, "r": record}, ...]}
+          {"wal_epoch": ..., "reset": true, ...}  cursor below the ring
+            floor (standby outrun) or from another incarnation — the
+            standby must re-bootstrap from a full snapshot.
+        """
+        after = int(after)
+        limit = max(1, int(limit))
+        deadline = _time.monotonic() + max(0.0, float(timeout))
+        with self._wal_cond:
+            while True:
+                if after < self._wal_floor:
+                    return {
+                        "wal_epoch": self.wal_epoch,
+                        "head": self._wal_seq,
+                        "now": _time.time(),
+                        "reset": True,
+                        "records": [],
+                    }
+                if self._wal_seq > after:
+                    break
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0 or not self._wal_cond.wait(remaining):
+                    break
+            records = []
+            if self._wal:
+                # Ring seqs are contiguous (one +=1 per append, evictions
+                # only from the left), so the first record past `after` is
+                # at a computable offset — a skip-scan from the head would
+                # cost O(ring) under the store lock on EVERY poll, stalling
+                # the write path behind each caught-up tailer.
+                start = max(0, after - self._wal[0][0] + 1)
+                for seq, t, rec in _itertools.islice(
+                    self._wal, start, start + limit
+                ):
+                    records.append({"s": seq, "t": t, "r": rec})
+            return {
+                "wal_epoch": self.wal_epoch,
+                "head": self._wal_seq,
+                "now": _time.time(),
+                "records": records,
+            }
 
     def journal_bytes(self) -> int:
         """Bytes appended to the current journal generation since the last
@@ -396,16 +549,14 @@ class HostStore:
                 old_gen, self._gen = self._gen, new_gen
                 self._records_since_snapshot = 0
                 self._bytes_since_snapshot = 0
+        # The fresh generation's dirent must be durable BEFORE old journals
+        # become deletable: without it a power loss could surface the
+        # unlinks but not the new file — acknowledged records gone.
+        self._fsync_dir()
         snap = encode_snapshot(refs)
         snap["gen"] = self._gen  # journals >= this gen are NOT in the snapshot
-
-        tmp = os.path.join(self.root, SNAPSHOT + ".tmp")
-        with open(tmp, "w") as f:
-            json.dump(snap, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, os.path.join(self.root, SNAPSHOT))
-        # Only after the snapshot durably covers them:
+        self._write_snapshot_file(snap)
+        # Only after the snapshot (and its rename) durably cover them:
         for gen in self._journal_gens():
             if gen <= old_gen:
                 try:
@@ -416,6 +567,65 @@ class HostStore:
             "compacted state into %s (gen %d)",
             os.path.join(self.root, SNAPSHOT), self._gen,
         )
+
+    def _write_snapshot_file(self, snap: Dict[str, Any]) -> None:
+        """Crash-safe snapshot install: temp file, fsync the DATA, atomic
+        rename, then fsync the DIRECTORY — the rename itself is a metadata
+        op, and old-journal deletion (the caller's next step) must never
+        become durable before it. A crash anywhere in this sequence leaves
+        either the old snapshot + all journals, or the new snapshot + all
+        journals: never neither."""
+        tmp = os.path.join(self.root, SNAPSHOT + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.root, SNAPSHOT))
+        self._fsync_dir()
+
+    def adopt_snapshot(self, snap: Dict[str, Any]) -> None:
+        """Standby bootstrap: install a snapshot FETCHED from the primary
+        (GET /replication/snapshot) as this store's durable base, rotating
+        to a fresh journal generation for the WAL records that will follow
+        it. Existing local state (a previous standby term's snapshot and
+        journals) is superseded wholesale — the primary's state is the
+        truth, and mixing generations across bootstraps could double-apply
+        append-only records. Call before attach()."""
+        with self._lock:
+            if self._journal_fh is not None:
+                try:
+                    self._journal_fh.close()
+                except OSError:
+                    log.error("journal close failed during adopt", exc_info=True)
+                self._journal_fh = None
+            old_gens = self._journal_gens()
+            self._gen = (max(old_gens) if old_gens else self._gen) + 1
+            self._records_since_snapshot = 0
+            self._bytes_since_snapshot = 0
+            self._torn_tails.clear()
+            gen = self._gen
+        installed = dict(snap)
+        installed["gen"] = gen
+        self._write_snapshot_file(installed)
+        for g in old_gens:
+            try:
+                os.unlink(os.path.join(self.root, journal_name(g)))
+            except OSError:
+                pass
+        log.info("adopted primary snapshot at rv=%s (gen %d) into %s",
+                 snap.get("rv"), gen, self.root)
+
+    def abandon(self) -> None:
+        """SIGKILL semantics for in-process chaos (HostChaos): drop the
+        journal fd without a graceful close. Records already appended are
+        on their way to disk (the sink flushes per record — the documented
+        kill -9 durability level); anything a crash would not have
+        persisted stays unpersisted. The degraded latch makes any
+        straggler write raise JournalWriteError rather than silently
+        applying unjournaled — a dead process accepts no writes."""
+        with self._lock:
+            self._journal_fh = None
+            self.degraded = True
 
     def close(self) -> None:
         with self._lock:

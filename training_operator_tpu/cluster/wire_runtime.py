@@ -54,8 +54,12 @@ class SyncedClock(Clock):
         # inside the operator tick loop — inheriting the 30s CRUD timeout
         # would freeze ticks for up to 30s per resync attempt during a
         # blackholed-host partition, exactly when responsiveness matters.
+        # Full HA address list, not just the current base_url: after a host
+        # failover the CRUD client rotates, and clock resyncs must follow it
+        # to the promoted standby — probing only the dead primary would
+        # freeze the offset and let leases drift toward split-brain.
         self._probe = RemoteAPIServer(
-            remote.base_url, timeout=2.0, token=remote.token,
+            addresses=remote.addresses, timeout=2.0, token=remote.token,
             ca_file=remote.ca_file,
         )
         self._resync_interval = resync_interval

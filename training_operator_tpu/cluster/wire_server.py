@@ -21,6 +21,7 @@ from __future__ import annotations
 import base64
 import json
 import logging
+import socket as _socket
 import threading
 import time as _time
 import urllib.parse
@@ -103,13 +104,19 @@ class _ResumeRing:
     `epoch` scopes watermarks to one ring lifetime: seq counters restart
     with the serving process, so a watermark minted against a previous host
     incarnation must land in the too-old arm no matter how the numbers
-    happen to compare.
+    happen to compare. `epochs` is the ACCEPTED set — normally just the
+    ring's own epoch, but a promoted warm standby also accepts its
+    primary's chain (accept_epochs): WAL replication applies the primary's
+    events in lockstep seq order (APIServer.set_event_seq), so a surviving
+    client's primary-epoch watermark is directly comparable here and
+    failover answers delta instead of forcing a relist storm.
     """
 
     def __init__(self, api: APIServer, size: int = 8192):
         self.api = api
         self.size = size
         self.epoch = uuid.uuid4().hex
+        self.epochs = {self.epoch}
         self._feed = api.watch()  # all kinds, in _notify order
         self._rings: Dict[str, Any] = {}  # kind -> deque[WatchEvent]
         # Per-kind resume floor: the newest seq NOT available for replay —
@@ -118,7 +125,59 @@ class _ResumeRing:
         # would silently miss the gap, so it must relist.
         self._base_seq = api.event_seq()
         self._floor: Dict[str, int] = {}
+        # True once seed() imported a dead ancestor's per-kind floors: a
+        # kind with NO floor and NO ring then means "no events ever on the
+        # chain" (resumable) instead of "knowledge predates this ring"
+        # (too old). See seed()/_kind_floor().
+        self._seeded = False
         self._lock = threading.Lock()
+
+    def accept_epochs(self, ancestors) -> None:
+        """Extend the accepted-epoch chain (standby bootstrap: the
+        primary's own chain, learned from GET /replication/snapshot)."""
+        self.epochs.update(e for e in ancestors if e)
+
+    def seed(self, kind_seqs: Dict[str, int], epochs) -> None:
+        """Standby bootstrap: inherit the primary's resume knowledge.
+
+        `kind_seqs` is the primary's last event seq per kind at snapshot
+        time (its ring tails + inherited floors — see kind_seqs()). They
+        become this ring's per-kind floors, max-merged on re-bootstrap: a
+        chained watermark at or past kind k's floor provably missed no k
+        event this ring didn't witness (no k event exists between the
+        shipped floor and this ring's birth), so the delta answer is safe
+        — and a kind ABSENT here had no events since before the oldest
+        chained client's session base, so its absence means "complete",
+        not "unknown" (`_seeded` flips the no-knowledge default from
+        too-old to up-to-date). Clients of the dead primary always
+        subscribed after its ring was born, so their `base` covers
+        anything a chain ancestor never shipped."""
+        with self._lock:
+            for kind, seq in kind_seqs.items():
+                self._floor[kind] = max(self._floor.get(kind, 0), int(seq))
+            self._seeded = True
+        self.accept_epochs(epochs)
+
+    def kind_seqs(self) -> Dict[str, int]:
+        """Last known event seq per kind: ring tails where events are
+        retained, inherited floors for kinds whose events all predate this
+        ring — what a snapshot bootstrap ships a standby (see seed())."""
+        with self._lock:
+            out = dict(self._floor)
+            for kind, ring in self._rings.items():
+                if ring:
+                    out[kind] = max(out.get(kind, 0), ring[-1].seq)
+        return out
+
+    def _kind_floor(self, kind: str) -> int:
+        """The newest seq NOT attestable for `kind`: explicit floor if
+        recorded, else the ring's birth seq (events before it were never
+        seen) — unless seeded, where absence of a floor means the chain
+        never produced an event of this kind at all."""
+        f = self._floor.get(kind)
+        if f is not None:
+            return f
+        return 0 if self._seeded else self._base_seq
 
     def sync(self) -> None:
         """Move freshly notified events from the feed queue into the
@@ -167,20 +226,27 @@ class _ResumeRing:
                 if kset is not None and kind not in kset:
                     continue
                 wm = max(int(watermarks.get(kind, 0)), int(base))
-                if wm < self._floor.get(kind, self._base_seq):
+                if wm < self._kind_floor(kind):
                     return None
                 for ev in ring:
                     if ev.seq > wm:
                         out.append(ev)
             # Watched kinds the client has a watermark for but the ring has
-            # never seen events for: with a matching epoch that can only
-            # mean the ring state was lost relative to the client
-            # (shouldn't happen in one process lifetime) — treat as too
-            # old, never guess.
+            # never seen events for: a watermark at or past the kind's
+            # floor (the ring's birth seq, or a chained ancestor's shipped
+            # last-seq after seed()) just means nothing happened to that
+            # kind since — up to date, nothing to replay (the normal case
+            # on a freshly promoted standby for kinds that were quiet
+            # during its term). A watermark BELOW the floor with no ring
+            # means the client's knowledge predates everything this ring
+            # can attest to — treat as too old, never guess.
             for kind, wm in watermarks.items():
                 if kset is not None and kind not in kset:
                     continue
-                if int(wm) > 0 and kind not in self._rings:
+                if kind in self._rings:
+                    continue
+                wm_eff = max(int(wm), int(base))
+                if 0 < wm_eff < self._kind_floor(kind):
                     return None
             out.sort(key=lambda e: e.seq)
             return out
@@ -206,6 +272,7 @@ class ApiHTTPServer:
         tls: Optional[Tuple[str, str]] = None,
         chaos: Optional[object] = None,
         resume_ring_size: int = 8192,
+        read_only_fn: Optional[Callable[[], bool]] = None,
     ):
         """`token`: require `Authorization: Bearer <token>` on every route
         except /healthz and /readyz (probes stay open, like kubelet probes)
@@ -229,11 +296,26 @@ class ApiHTTPServer:
         (OperatorConfig.watch_ring_size / --watch-ring-size). A watermark
         older than the ring answers too-old and the client relists; sizing
         it above the burst event rate x the reconnect window keeps
-        reconnects O(delta)."""
+        reconnects O(delta).
+
+        `read_only_fn`: standby gate — while it returns True every mutating
+        route (objects/batch/events/logs/timelines writes) answers 503
+        NotLeader; reads, watches, and /promote stay open (bounded-
+        staleness serving is the warm standby's job). The failover client
+        maps NotLeader to ApiUnavailableError and rotates to the next
+        address."""
         self.api = api
         self.session_ttl = session_ttl
         self.token = token
         self.chaos = chaos
+        self.read_only_fn = read_only_fn
+        # Replication attach points (cluster/replication.py): the host role
+        # sets wal_source/snapshot_source when it has a durable store (WAL
+        # shipping); a standby role sets promote_hook so POST /promote can
+        # turn it into the primary.
+        self.wal_source: Optional[Callable[..., Dict[str, Any]]] = None
+        self.snapshot_source: Optional[Callable[[], Dict[str, Any]]] = None
+        self.promote_hook: Optional[Callable[[], Dict[str, Any]]] = None
         self.now_fn = now_fn or _time.time
         if token and tls is None and bind not in ("127.0.0.1", "::1", "localhost"):
             log.warning(
@@ -372,6 +454,39 @@ class ApiHTTPServer:
             request_queue_size = 64
             daemon_threads = True
 
+            def __init__(self, *a, **k):
+                super().__init__(*a, **k)
+                # Established-connection registry: shutdown() only stops
+                # the ACCEPT loop — keep-alive handler threads keep
+                # serving, which is exactly wrong for SIGKILL simulation
+                # (ApiHTTPServer.kill severs these too).
+                self._live_conns = set()
+                self._conn_lock = threading.Lock()
+
+            def process_request(self, request, client_address):
+                with self._conn_lock:
+                    self._live_conns.add(request)
+                super().process_request(request, client_address)
+
+            def shutdown_request(self, request):
+                with self._conn_lock:
+                    self._live_conns.discard(request)
+                super().shutdown_request(request)
+
+            def kill_connections(self):
+                with self._conn_lock:
+                    conns = list(self._live_conns)
+                    self._live_conns.clear()
+                for sock in conns:
+                    try:
+                        sock.shutdown(_socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+
             def handle_error(self, request, client_address):
                 # TLS handshake failures (plain-HTTP probe against the HTTPS
                 # port, cert rejected by a mis-pinned client) arrive here per
@@ -412,6 +527,17 @@ class ApiHTTPServer:
 
         self._gc_thread = threading.Thread(target=_gc_loop, daemon=True)
         self._gc_thread.start()
+
+    def kill(self) -> None:
+        """SIGKILL semantics (HostChaos): stop the listener AND sever every
+        established connection — a client mid-long-poll sees a reset, which
+        is what a dead process looks like from the wire. close() is the
+        graceful twin (it lets in-flight keep-alive handlers finish)."""
+        self._gc_stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd.kill_connections()
+        self.api.unwatch(self._ring._feed)
 
     def close(self) -> None:
         self._gc_stop.set()
@@ -492,6 +618,26 @@ class ApiHTTPServer:
             if len(self._route_cache) >= 4096:
                 self._route_cache.clear()
             self._route_cache[memo_key] = (parts, q)
+        if (
+            self.read_only_fn is not None
+            and method in ("POST", "PUT", "DELETE")
+            and head in ("objects", "batch", "events", "logs", "timelines")
+            and self.read_only_fn()
+        ):
+            # Standby: reads/watches serve at bounded staleness, writes
+            # belong to the primary. NOT a 409 (nothing about the object is
+            # stale) and NOT a 5xx bug: a role statement the failover
+            # client translates into "try the next address". Drain the
+            # request body first — answering mid-body would desynchronize
+            # the keep-alive stream, and a read-mostly client legitimately
+            # KEEPS talking to a standby on this same connection.
+            h._raw_body()
+            h._send(503, {
+                "error": "NotLeader",
+                "message": "standby host: not accepting writes "
+                           "(bounded-staleness reads only)",
+            })
+            return
         if head == "objects":
             self._objects(h, method, parts[1:], q)
         elif head == "batch" and method == "POST":
@@ -517,6 +663,12 @@ class ApiHTTPServer:
             )
         elif head == "fleet" and method == "GET":
             self._fleet(h)
+        elif head == "wal" and method == "GET":
+            self._wal(h, q)
+        elif head == "replication" and method == "GET" and parts[1:] == ["snapshot"]:
+            self._replication_snapshot(h)
+        elif head == "promote" and method == "POST":
+            self._promote(h)
         elif head == "timelines":
             self._timelines(h, method, parts[1:])
         elif head == "version" and len(parts) == 4:
@@ -524,6 +676,49 @@ class ApiHTTPServer:
             h._send(200, {"resourceVersion": rv})
         else:
             h._send(404, {"error": "NotFound", "message": f"no route {head}"})
+
+    # -- replication routes ------------------------------------------------
+
+    def _wal(self, h, q: Dict[str, str]) -> None:
+        """GET /wal?after=<seq>: one page of the primary's write-ahead log
+        for a tailing standby (HostStore.wal_page). 404 on hosts without a
+        durable store — replication requires --state-dir."""
+        if self.wal_source is None:
+            raise NotFoundError("no WAL here (host has no durable store)")
+        page = self.wal_source(
+            after=int(q.get("after", "0")),
+            limit=int(q.get("limit", "1024")),
+            # Clamp the long-poll well under the client CRUD timeout so a
+            # quiet primary never looks like a dead one.
+            timeout=min(float(q.get("timeout", "0")), 10.0),
+        )
+        h._send(200, page)
+
+    def _replication_snapshot(self, h) -> None:
+        """GET /replication/snapshot: the full-state bootstrap a standby
+        starts (or restarts, after a WAL-ring outrun) from — the encoded
+        snapshot plus the replication cursors captured atomically with it:
+        `seq` (watch-event counter, for resume-lockstep alignment), `wal` +
+        `wal_epoch` (the WAL cursor to tail from), and `ring_epochs` (this
+        server's accepted epoch chain, which the standby inherits)."""
+        if self.snapshot_source is None:
+            raise NotFoundError("no replication snapshot here")
+        h._send(200, self.snapshot_source())
+
+    def _promote(self, h) -> None:
+        """POST /promote: explicit standby promotion (the planned-failover
+        twin of lease-expiry auto-promotion). 404 on a host that is not a
+        standby."""
+        if self.promote_hook is None:
+            raise NotFoundError("not a standby (nothing to promote)")
+        h._send(200, self.promote_hook())
+
+    @property
+    def resume_ring(self) -> "_ResumeRing":
+        """The server's resume ring — the replication seam: a host role
+        hands it to make_snapshot_source (shipping per-kind floors + the
+        epoch chain to standbys), and a standby's bootstrap seeds it."""
+        return self._ring
 
     def _resume_ring_occupancy(self) -> Dict[str, Tuple[int, int]]:
         """kind -> (events retained, configured size) across the resume
@@ -812,7 +1007,11 @@ class ApiHTTPServer:
                 h._send(201, head)
                 return
             replay = None
-            if body.get("epoch") == self._ring.epoch:
+            # Membership in the epoch CHAIN, not equality: a promoted
+            # standby accepts watermarks minted against its dead primary
+            # (seq lockstep makes them comparable) — the epoch-chained
+            # resume that turns failover into O(delta) for survivors.
+            if body.get("epoch") in self._ring.epochs:
                 replay = self._ring.replay(
                     watermarks, int(body.get("base", 0)), kinds
                 )

@@ -35,6 +35,7 @@ from training_operator_tpu.cluster.apiserver import (
     graft_status_retry,
 )
 from training_operator_tpu.cluster.objects import Event
+from training_operator_tpu.utils import metrics
 
 log = logging.getLogger(__name__)
 
@@ -253,6 +254,7 @@ class _PipelinedChannel:
         envelope = b"".join(parts)
         headers = dict(self._remote._headers)
         headers["Content-Type"] = wire.BATCH_CONTENT_TYPE
+        gen = self._remote._addr_gen
         try:
             conn = self._remote._conn("main")
             conn.request("POST", "/batch", body=envelope, headers=headers)
@@ -265,7 +267,10 @@ class _PipelinedChannel:
                 raise PermissionError(
                     f"POST /batch: TLS verification failed: {e}"
                 ) from None
-            # No stale-keep-alive auto-retry here (see class docstring).
+            # No stale-keep-alive auto-retry here (see class docstring);
+            # the coalescer re-enqueues, and the retry flush rides the
+            # rotated address (HA failover).
+            self._remote._rotate_address(gen)
             raise ApiUnavailableError(f"POST /batch: {e}") from None
         if status >= 400:
             # Every pre-body error arm (the old host's 404, auth, injected
@@ -279,6 +284,20 @@ class _PipelinedChannel:
             raise _BatchUnsupported()
         if status == 401:
             raise PermissionError("POST /batch: bad or missing bearer token")
+        if status == 503:
+            try:
+                kind = json.loads(raw).get("error", "")
+            except ValueError:
+                kind = ""
+            if kind == "NotLeader":
+                # A standby declining the envelope: rotate and surface the
+                # same taxonomy the per-request path does, so the
+                # coalescer's re-enqueue arm replays these writes against
+                # the next address (per-op conflicts resolve at the flush).
+                self._remote._rotate_address(gen)
+                raise ApiUnavailableError(
+                    "POST /batch: standby host (NotLeader)"
+                )
         if status >= 400:
             raise ApiServerError(f"POST /batch: HTTP {status}")
         self.supported = True
@@ -520,7 +539,7 @@ class RemoteAPIServer:
 
     def __init__(
         self,
-        base_url: str,
+        base_url: Optional[str] = None,
         timeout: float = 30.0,
         token: Optional[str] = None,
         ca_file: Optional[str] = None,
@@ -529,11 +548,21 @@ class RemoteAPIServer:
         pipeline_depth: int = 64,
         coalesce_window_ms: float = 0.0,
         list_page_limit: int = 0,
+        addresses: Optional[List[str]] = None,
     ):
         """`ca_file`: PEM CA bundle to verify an https host against (the
         pin on the host-minted CA, certs.mint_ca). Without it an https URL
         is verified against the system trust store — which will reject a
         self-signed host CA, loudly, rather than silently not verifying.
+
+        `addresses`: the control-plane HA endpoint list — [primary,
+        standby, ...]. The client speaks to ONE address at a time
+        (base_url reports it) and rotates to the next on a transport
+        failure or a 503 NotLeader answer, so a host failover costs the
+        caller's ordinary retry arm (run_forever backoff, watch resume,
+        coalescer re-enqueue) and nothing else. A single `base_url` is the
+        one-address degenerate case; both hosts must share the CA when
+        pinning TLS (the standby adopts the primary's state dir layout).
 
         `resume`: present per-kind watermarks on watch resubscribe so the
         server can replay only the delta (wire_watch._SharedWatch); False
@@ -559,7 +588,26 @@ class RemoteAPIServer:
         `list_page_limit` sets the page size this client's full-relist arm
         uses for chunked LISTs (limit/continue); 0 = unpaginated v1 LISTs.
         """
-        self.base_url = base_url.rstrip("/")
+        urls = [u.rstrip("/") for u in (addresses or []) if u]
+        if base_url and base_url.rstrip("/") not in urls:
+            urls.insert(0, base_url.rstrip("/"))
+        if not urls:
+            raise ValueError("RemoteAPIServer needs base_url or addresses")
+        self._addresses = urls
+        # Active-address index + generation. The generation is how the
+        # per-thread keep-alive connections learn about a rotation: _conn
+        # compares its cached generation and rebuilds against the current
+        # address when stale (a client thread cannot close another
+        # thread's sockets directly).
+        self._addr_idx = 0
+        self._addr_gen = 0
+        self._addr_lock = threading.Lock()
+        # Request-path trims: the URLs are parsed once and the header dict
+        # is built once — a reconcile makes ~8 wire calls and a 1k-job
+        # burst makes tens of thousands, so per-request urlsplit + dict
+        # rebuilds are measurable. http.client copies headers into its send
+        # buffer and never mutates the dict, so sharing one instance is safe.
+        self._parsed = [urllib.parse.urlsplit(u) for u in urls]
         self.timeout = timeout
         self.token = token
         self.ca_file = ca_file
@@ -575,25 +623,38 @@ class RemoteAPIServer:
         self._shared_watch = None  # lazily built wire_watch._SharedWatch
         self._local = threading.local()
         self._ssl_context = None
-        # Request-path trims: the URL is parsed once and the header dict is
-        # built once — a reconcile makes ~8 wire calls and a 1k-job burst
-        # makes tens of thousands, so per-request urlsplit + dict rebuilds
-        # are measurable. http.client copies headers into its send buffer
-        # and never mutates the dict, so sharing one instance is safe.
-        parsed = urllib.parse.urlsplit(self.base_url)
-        self._host = parsed.hostname
-        self._port = parsed.port
-        self._scheme = parsed.scheme
         self._headers: Dict[str, str] = {"Content-Type": "application/json"}
         if token is not None:
             self._headers["Authorization"] = f"Bearer {token}"
-        if self._scheme == "https":
+        if any(p.scheme == "https" for p in self._parsed):
             from training_operator_tpu.cluster import certs as _certs
 
             self._ssl_context = (
                 _certs.client_context(ca_file) if ca_file
                 else _ssl.create_default_context()
             )
+
+    @property
+    def base_url(self) -> str:
+        """The address currently spoken to (rotates on failover)."""
+        return self._addresses[self._addr_idx]
+
+    @property
+    def addresses(self) -> List[str]:
+        return list(self._addresses)
+
+    def _rotate_address(self, seen_gen: int) -> None:
+        """Advance to the next address after a transport failure. Gen-
+        guarded so N threads failing on the same dead host rotate ONCE,
+        not N times (which could skip right past the live standby)."""
+        with self._addr_lock:
+            if len(self._addresses) > 1 and seen_gen == self._addr_gen:
+                self._addr_idx = (self._addr_idx + 1) % len(self._addresses)
+                self._addr_gen += 1
+                metrics.wire_failovers.inc()
+                log.warning(
+                    "wire transport failing over to %s", self.base_url
+                )
 
     # -- transport ---------------------------------------------------------
 
@@ -613,29 +674,47 @@ class RemoteAPIServer:
         poll timeout, and CRUD calls queued behind it would eat that wait on
         every reconcile. Watch traffic therefore rides its own connection,
         and connections stay warm for the client's lifetime — they are only
-        dropped on a transport error (and then rebuilt on the next call).
+        dropped on a transport error or an address rotation (and then
+        rebuilt against the CURRENT address on the next call).
         """
-        conn = getattr(self._local, "conn_" + channel, None)
-        if conn is None:
-            if self._scheme == "https":
-                conn = http.client.HTTPSConnection(
-                    self._host, self._port, timeout=self.timeout,
-                    context=self._ssl_context,
-                )
+        cached = getattr(self._local, "conn_" + channel, None)
+        gen = self._addr_gen
+        if cached is not None:
+            if isinstance(cached, tuple):
+                conn, conn_gen = cached
             else:
-                conn = http.client.HTTPConnection(
-                    self._host, self._port, timeout=self.timeout
-                )
-            conn.connect()
-            # Same delayed-ACK tax in the other direction: the request line/
-            # headers and the JSON body are separate send()s too.
-            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            setattr(self._local, "conn_" + channel, conn)
+                # A bare connection object: the white-box test idiom
+                # (tests inject fakes without the address generation).
+                conn, conn_gen = cached, gen
+            if conn_gen == gen:
+                return conn
+            # Address rotated since this thread's connection was built:
+            # it points at the dead (or demoted) host.
+            try:
+                conn.close()
+            except OSError:
+                pass
+        parsed = self._parsed[self._addr_idx]
+        if parsed.scheme == "https":
+            conn = http.client.HTTPSConnection(
+                parsed.hostname, parsed.port, timeout=self.timeout,
+                context=self._ssl_context,
+            )
+        else:
+            conn = http.client.HTTPConnection(
+                parsed.hostname, parsed.port, timeout=self.timeout
+            )
+        conn.connect()
+        # Same delayed-ACK tax in the other direction: the request line/
+        # headers and the JSON body are separate send()s too.
+        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        setattr(self._local, "conn_" + channel, (conn, gen))
         return conn
 
     def _drop_conn(self, channel: str = "main") -> None:
-        conn = getattr(self._local, "conn_" + channel, None)
-        if conn is not None:
+        cached = getattr(self._local, "conn_" + channel, None)
+        if cached is not None:
+            conn = cached[0] if isinstance(cached, tuple) else cached
             try:
                 conn.close()
             except OSError:
@@ -663,6 +742,7 @@ class RemoteAPIServer:
             target += "?" + urllib.parse.urlencode(query)
         data = json.dumps(body).encode() if body is not None else None
         headers = self._headers
+        gen = self._addr_gen
 
         for attempt in (0, 1):
             try:
@@ -704,6 +784,10 @@ class RemoteAPIServer:
                     # the caller's retry arm (reconcile requeue, watch
                     # resume/relist) absorbs it.
                     continue
+                # HA failover: point the NEXT request (from any thread) at
+                # the next address; this one still fails — the caller's
+                # retry arm re-drives it against the rotated target.
+                self._rotate_address(gen)
                 raise ApiUnavailableError(f"{method} {path}: {e}") from None
 
         if status < 400:
@@ -726,6 +810,12 @@ class RemoteAPIServer:
             # Auth failures are config errors, not transients — the
             # operator loop must NOT retry these silently forever.
             raise PermissionError(msg)
+        if status == 503 and kind == "NotLeader":
+            # A standby declining a write is "this address can't serve
+            # you", not a server bug: same taxonomy as a dead socket, so
+            # the failover rotation and every existing retry arm apply.
+            self._rotate_address(gen)
+            raise ApiUnavailableError(f"{method} {path}: {msg}")
         raise ApiServerError(f"{method} {path}: {status} {msg}")
 
     # -- CRUD --------------------------------------------------------------
@@ -876,6 +966,33 @@ class RemoteAPIServer:
         the standing auditor's live violations. Cheap to poll — the server
         rebuilds it only when the store version or audit generation moved."""
         return self._request("GET", "/fleet")
+
+    # -- replication -------------------------------------------------------
+
+    def get_wal(self, after: int = 0, limit: int = 1024,
+                timeout: float = 0.0) -> Dict[str, Any]:
+        """One page of the host's replication WAL tail (GET /wal): records
+        with seq > `after`, long-polling up to `timeout` seconds when the
+        tail is dry. Rides the watch channel so a long-poll never queues
+        CRUD calls behind it (the standby's tailer path)."""
+        return self._request(
+            "GET", "/wal",
+            query={"after": str(int(after)), "limit": str(int(limit)),
+                   "timeout": str(float(timeout))},
+            channel="watch",
+        )
+
+    def get_replication_snapshot(self) -> Dict[str, Any]:
+        """The full-state bootstrap payload (GET /replication/snapshot):
+        encoded snapshot + the WAL/watch-seq cursors captured atomically
+        with it (see wire_server._replication_snapshot)."""
+        return self._request("GET", "/replication/snapshot")
+
+    def promote(self) -> Dict[str, Any]:
+        """POST /promote: flip a standby host to primary — the planned
+        failover twin of lease-expiry auto-promotion. NotFound on a host
+        that is not a standby."""
+        return self._request("POST", "/promote")
 
     # -- timelines ---------------------------------------------------------
 

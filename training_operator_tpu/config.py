@@ -92,6 +92,27 @@ class OperatorConfig:
     # (survives power loss, at the cost of gating every control-plane write
     # on disk latency; etcd batches fsyncs for the same reason).
     journal_fsync: bool = False
+    # Control-plane replication (cluster/replication.py; --state-dir hosts):
+    #   replication_wal_ring — journaled records retained in memory for
+    #       GET /wal tailing. A standby that falls further behind than this
+    #       re-bootstraps from a full snapshot (the etcd snapshot+WAL
+    #       shape); size it above the peak write rate times the longest
+    #       expected standby outage.
+    #   replication_lease_seconds — the host-primacy lease duration: how
+    #       long the primary may go silent before a standby whose WAL tail
+    #       is ALSO disconnected auto-promotes. Short = fast failover,
+    #       long = more tolerance for GC/IO pauses (split-brain guard:
+    #       both conditions must hold — see replication.py).
+    #   replication_poll_timeout — the standby's /wal long-poll window;
+    #       bounds steady-state replication lag on a quiet primary.
+    #   replication_max_lag_seconds — INV008 threshold: a standby lagging
+    #       longer than this (records it has not applied aging past the
+    #       bound) is a standing violation — failover from it would lose
+    #       that much acknowledged history.
+    replication_wal_ring: int = 65536
+    replication_lease_seconds: float = 5.0
+    replication_poll_timeout: float = 2.0
+    replication_max_lag_seconds: float = 30.0
     # Node lifecycle (controllers/nodelifecycle.py + SimKubelet heartbeats):
     #   node_heartbeat_interval — kubelet Lease renewal period per node.
     #   node_grace_period — heartbeat silence before a node flips NotReady
@@ -181,6 +202,18 @@ class OperatorConfig:
             raise ValueError("max_drain_fraction must be in [0, 1]")
         if self.aging_seconds < 0:
             raise ValueError("aging_seconds must be >= 0")
+        if self.replication_wal_ring < 1:
+            # A zero ring would force a full snapshot re-bootstrap on every
+            # poll — replication that is all outage, no tail.
+            raise ValueError("replication_wal_ring must be >= 1")
+        if self.replication_lease_seconds <= 0:
+            # A non-positive lease is permanently expired: any blip in the
+            # WAL tail would promote the standby into a split brain.
+            raise ValueError("replication_lease_seconds must be > 0")
+        if self.replication_poll_timeout <= 0:
+            raise ValueError("replication_poll_timeout must be > 0")
+        if self.replication_max_lag_seconds < 0:
+            raise ValueError("replication_max_lag_seconds must be >= 0")
         if self.node_heartbeat_interval <= 0:
             raise ValueError("node_heartbeat_interval must be > 0")
         if self.node_grace_period <= self.node_heartbeat_interval:

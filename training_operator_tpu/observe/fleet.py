@@ -188,6 +188,11 @@ def collect_fleet(api, now: float,
     expectations = 0
     if sources.expectations is not None:
         expectations = len(sources.expectations())
+    # Replication lag (standby hosts): the INV008 feed verbatim, so `top`
+    # against a standby shows how warm it actually is.
+    replication = None
+    if sources.replication_lag is not None:
+        replication = dict(sources.replication_lag())
 
     return {
         "t": now,
@@ -214,6 +219,7 @@ def collect_fleet(api, now: float,
         "jobs": jobs,
         "objects": api.object_counts(),
         "store": store,
+        **({"replication": replication} if replication is not None else {}),
     }
 
 
@@ -433,6 +439,18 @@ def render_top(fleet: Dict[str, Any]) -> str:
         if parts:
             lines.append("")
             lines.append("store:   " + "  ".join(parts))
+
+    repl = fleet.get("replication")
+    if repl:
+        lines.append("")
+        lines.append(
+            f"replication: role={repl.get('role')}  "
+            f"lag {repl.get('records', 0)} records / "
+            f"{repl.get('seconds', 0.0):.1f}s  "
+            f"connected={repl.get('connected')}  "
+            f"applied={repl.get('applied', 0)}  "
+            f"bootstraps={repl.get('bootstraps', 0)}"
+        )
 
     violations = fleet.get("violations") or []
     lines.append("")

@@ -35,6 +35,11 @@ reference table cannot drift against scattered registrations):
                                  borrowing allows (the arbiter's admission
                                  accounting broke, or a quota was shrunk
                                  below live usage and never reclaimed)
+  INV008 replication-lag         a standby host whose WAL tail has fallen
+                                 further behind the primary than
+                                 replication_max_lag_seconds — failover
+                                 from it would lose that much acknowledged
+                                 history (the warm standby is cold)
 
 Mechanics: every rule returns *candidates*; the auditor tracks first-seen
 times and reports a violation only once it has persisted past the rule's
@@ -109,6 +114,9 @@ class FleetSources:
     resume_ring: Optional[Callable[[], Dict[str, Tuple[int, int]]]] = None
     # unfulfilled expectation key -> age in cluster-clock seconds
     expectations: Optional[Callable[[], Dict[str, float]]] = None
+    # StandbyController.lag(): {"role", "records", "seconds", "connected",
+    # ...} — present only on a standby (or promoted ex-standby) host.
+    replication_lag: Optional[Callable[[], Dict[str, Any]]] = None
 
 
 class AuditContext:
@@ -403,6 +411,38 @@ register_invariant(InvariantRule(
 register_invariant(InvariantRule(
     "INV007", "queue admitted usage exceeds quota + borrowing",
     _check_quota_over_admission,
+))
+
+
+def _check_replication_lag(ctx: AuditContext) -> List[Violation]:
+    from training_operator_tpu import config
+
+    src = ctx.sources.replication_lag
+    if src is None:
+        return []
+    lag = src()
+    if lag.get("role") != "standby":
+        return []  # a promoted ex-standby is the primary: nothing to lag
+    bound = config.current().replication_max_lag_seconds
+    seconds = float(lag.get("seconds", 0.0))
+    if bound > 0 and seconds > bound:
+        return [Violation(
+            "INV008", "Replication", "", "wal-tail",
+            f"standby replication lag {seconds:.1f}s > "
+            f"replication_max_lag_seconds {bound:.1f}s "
+            f"({int(lag.get('records', 0))} records behind, "
+            f"connected={bool(lag.get('connected'))}) — failover from this "
+            f"standby would lose that much acknowledged history",
+        )]
+    return []
+
+
+register_invariant(InvariantRule(
+    "INV008", "standby replication lag over replication_max_lag_seconds",
+    # replication_max_lag_seconds IS the grace (the INV004 TTL pattern):
+    # the candidate only exists once lag has already persisted past the
+    # configured bound, so a second grace window would double-count it.
+    _check_replication_lag, grace=0.0,
 ))
 
 

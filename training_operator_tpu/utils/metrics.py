@@ -466,6 +466,51 @@ wire_list_pages = registry.counter(
     "training_wire_list_pages_total",
     "paginated LIST pages served (limit/continue chunked responses)", (),
 )
+# Control-plane replication (cluster/replication.py): the WAL-shipping warm
+# standby's view of how far behind the primary it is. Gauges are set by the
+# standby's tailer; lag_seconds is host-clock time since the oldest record
+# the standby has not yet applied (0 when fully caught up). INV008 fires
+# when lag_seconds stays over replication_max_lag_seconds.
+replication_lag_records = registry.gauge(
+    "training_replication_lag_records",
+    "WAL records the primary has appended that the standby has not applied", (),
+)
+replication_lag_seconds = registry.gauge(
+    "training_replication_lag_seconds",
+    "Host-clock age of the oldest WAL record not yet applied by the standby", (),
+)
+replication_records_applied = registry.counter(
+    "training_replication_records_applied_total",
+    "WAL records applied into the standby's store", (),
+)
+replication_bootstraps = registry.counter(
+    "training_replication_bootstraps_total",
+    "full snapshot bootstraps the standby performed (first contact, WAL ring "
+    "outrun, or a new primary incarnation)", (),
+)
+replication_promotions = registry.counter(
+    "training_replication_promotions_total",
+    "standby promotions to primary (lease expiry or explicit promote verb)", (),
+)
+replication_snapshots_served = registry.counter(
+    "training_replication_snapshots_served_total",
+    "full bootstrap snapshots served to standbys (GET /replication/snapshot)",
+    (),
+)
+wire_failovers = registry.counter(
+    "training_wire_failovers_total",
+    "client address rotations (transport failure or NotLeader on the active "
+    "control-plane address)", (),
+)
+# Torn-tail recovery (HostStore._replay_file): a crash mid-append leaves a
+# truncated final journal record; replay stops at the last whole record and
+# the tail is physically truncated on the next append. Nonzero here is
+# normal after a kill -9 with journal_fsync off — it is the crash evidence,
+# not an error.
+journal_torn_tail = registry.counter(
+    "training_journal_torn_tail_total",
+    "torn trailing journal records detected (and truncated) during replay", (),
+)
 # Projected bodies get their OWN family: folding them into the full-body
 # counters would let a projection-heavy workload mask a full-body hit-rate
 # regression in the wire_cache bench block.
