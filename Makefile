@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast test-wire test-chaos test-fleet test-tenancy test-failover lint bench bench-quick bench-wire bench-wire-v2 bench-wire-resume bench-observe bench-audit bench-node-chaos bench-tenancy bench-failover dryrun operator-demo ha-demo native clean
+.PHONY: test test-fast test-wire test-chaos test-fleet test-tenancy test-failover lint bench bench-quick bench-solver bench-wire bench-wire-v2 bench-wire-resume bench-observe bench-audit bench-node-chaos bench-tenancy bench-failover dryrun operator-demo ha-demo native clean
 
 test:            ## full suite (no hardware needed; ~10 min)
 	$(PY) -m pytest tests/ -q
@@ -48,6 +48,18 @@ bench:           ## headline benchmark (runs the trainer block on TPU if present
 
 bench-quick:     ## 100-job smoke benchmark
 	$(PY) bench.py --quick
+
+# Incremental gang solver A/B: the SAME 1k-job burst through the
+# pinned-legacy compat arm (solver_incremental=False + jax kernel), the
+# incremental arm (per-group dirty tracking + delta snapshot + numpy
+# kernel), AND the true pre-PR code from a worktree (interleaved, the
+# bench-wire-v2 method), plus one cold 10k-node/2k-gang solve against the
+# <2s budget. Headline = solver_wall/job speedup (target 10x vs pre-PR).
+bench-solver:    ## incremental-solver A/B -> BENCH_SELF_SOLVER_r13.json
+	git worktree add --force .bench-before $(BEFORE_REF)
+	cp bench.py .bench-before/bench.py
+	JAX_PLATFORMS=cpu $(PY) bench.py --solver-only --before-repo .bench-before; \
+	rc=$$?; git worktree remove --force .bench-before; exit $$rc
 
 dryrun:          ## multi-chip sharding gates on 8 virtual CPU devices
 	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; fn, a = g.entry(); \

@@ -384,16 +384,25 @@ AUDIT_INTERVAL_S = 15.0
 
 
 def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nodes=CPU_NODES,
-              return_latencies=False, chrome_trace=None, audit=None):
+              return_latencies=False, chrome_trace=None, audit=None,
+              incremental=True):
     cluster = Cluster(VirtualClock())
     cluster.add_nodes(make_tpu_pool(tpu_slices, slice_topology=SLICE_TOPOLOGY))
     cluster.add_nodes(make_gpu_pool(gpu_nodes, gpus_per_node=GPUS_PER_NODE, nodes_per_nvlink_domain=4))
     cluster.add_nodes(make_cpu_pool(cpu_nodes, cpu_per_node=CPU_PER_NODE))
     DefaultScheduler(cluster)
     SimKubelet(cluster)
-    sched = GangScheduler(
-        cluster, placer, charge_solve_time=True, prewarm=True, min_solve_interval=0.25
+    import inspect
+
+    sched_kwargs = dict(
+        charge_solve_time=True, prewarm=True, min_solve_interval=0.25,
+        incremental=incremental,
     )
+    # This harness also runs inside pre-PR worktrees (the bench-wire-v2
+    # method): drop kwargs that code version does not know.
+    known = inspect.signature(GangScheduler.__init__).parameters
+    sched_kwargs = {k: v for k, v in sched_kwargs.items() if k in known}
+    sched = GangScheduler(cluster, placer, **sched_kwargs)
     mgr = OperatorManager(cluster, gang_enabled=True, reconciles_per_tick=4096)
     register_all(mgr)
     auditor = None
@@ -524,6 +533,10 @@ def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nod
         else 0.0,
         "solver_wall_s": round(sched.solve_walltime_total, 3),
         "solver_cycles": sched.cycles,
+        "solver_incremental_cycles": sum(
+            1 for r in sched.trace if r.get("mode") == "incremental"
+        ),
+        "solver_groups_solved": sum(r.get("pending", 0) for r in sched.trace),
         "bench_wall_s": round(wall, 1),
         "jobs_measured": len(latencies),
     }
@@ -1913,6 +1926,254 @@ def run_tenancy_contention(
     }
 
 
+# ---------------------------------------------------------------------------
+# Incremental gang solver (PR 10): the O(changed) solve cycle vs the pinned
+# legacy path, plus the 10k-node/2k-gang single-solve scale block.
+# ---------------------------------------------------------------------------
+
+
+def _solver_subprocess_leg(repo_dir: str, leg: str, n_jobs: int, seed: int):
+    """One solver burst leg in a SUBPROCESS from `repo_dir` (a worktree of
+    the pre-PR ref carrying this harness — the bench-wire-v2 method), so
+    the true pre-change code is measured, not the in-tree compat arm."""
+    import os as _os
+    import subprocess
+
+    env = dict(_os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--solver-leg", leg,
+         "--solver-jobs", str(n_jobs), "--seed", str(seed)],
+        cwd=repo_dir, env=env, capture_output=True, text=True, timeout=1800,
+    )
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")]
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"solver leg in {repo_dir} failed (rc={proc.returncode}): "
+            f"{proc.stderr[-2000:]}"
+        )
+    return json.loads(lines[-1])
+
+
+def run_solver_bench(n_jobs: int = 1000, pairs: int = 3, seed: int = 42,
+                     out: str = "BENCH_SELF_SOLVER_r13.json",
+                     before_repo: str = None):
+    """The `solver` bench block: the SAME 1k-job burst through two arms —
+
+      legacy       solver_incremental=False + solver_kernel=jax (exactly the
+                   pre-PR configuration: global dirty bit, per-cycle full
+                   snapshot walk, jit kernel)
+      incremental  solver_incremental=True + solver_kernel=numpy (the new
+                   defaults: per-group dirty tracking, delta-maintained
+                   snapshot, numpy kernel)
+
+    run as interleaved pairs (machine-load drift hits both sides), headline
+    = solver_wall/job ratio (target >= 10x), with scheduling-quality parity
+    reported alongside: p50/p99 of both arms against each other and against
+    the zero-cost granular oracle — the speedup must not buy worse packing.
+    """
+    import statistics
+
+    specs = build_workload(n_jobs, seed)
+    goracle = granular_oracle(specs)
+
+    def leg(incremental):
+        placer = TPUPacker(kernel="numpy" if incremental else "jax")
+        run = run_burst(specs, placer, incremental=incremental)
+        return run
+
+    leg(True)  # warmup: codec + jit compiles land outside the measurement
+
+    runs = {"legacy": [], "incremental": []}
+    pre_pr = []
+    for i in range(max(1, pairs)):
+        order = (
+            [("legacy", False), ("incremental", True)]
+            if i % 2 == 0
+            else [("incremental", True), ("legacy", False)]
+        )
+        for name, inc in order:
+            runs[name].append(leg(inc))
+        if before_repo:
+            # Interleaved with the in-tree arms so machine drift hits all
+            # three: the TRUE pre-PR code from its own worktree.
+            pre_pr.append(_solver_subprocess_leg(
+                before_repo, "legacy", n_jobs, seed,
+            ))
+        print(
+            f"solver pair {i + 1}/{pairs}: "
+            f"legacy {runs['legacy'][-1]['solver_wall_s']}s vs "
+            f"incremental {runs['incremental'][-1]['solver_wall_s']}s"
+            + (f" (pre-PR {pre_pr[-1]['solver_wall_s']}s)" if pre_pr else ""),
+            file=sys.stderr,
+        )
+
+    def med(arm, key):
+        return round(statistics.median(r[key] for r in runs[arm]), 4)
+
+    legacy_wall = med("legacy", "solver_wall_s")
+    inc_wall = med("incremental", "solver_wall_s")
+    speedup = round(legacy_wall / inc_wall, 2) if inc_wall > 0 else None
+    pre_pr_block = None
+    if pre_pr:
+        import statistics as _st
+
+        pre_wall = round(_st.median(r["solver_wall_s"] for r in pre_pr), 3)
+        pre_pr_block = {
+            "arm": "true pre-PR code (worktree of the pre-change ref, this "
+                   "harness copied in — bench-wire-v2 method)",
+            "solver_wall_s": pre_wall,
+            "solver_wall_per_job_ms": round(1000.0 * pre_wall / n_jobs, 4),
+            "speedup_vs_incremental": (
+                round(pre_wall / inc_wall, 2) if inc_wall > 0 else None
+            ),
+            "runs": pre_pr,
+        }
+    scale = run_solver_scale()
+    block = {
+        "jobs": n_jobs,
+        "pairs": pairs,
+        "arms": {
+            "legacy": "solver_incremental=False, solver_kernel=jax "
+                      "(pinned pre-PR behavior)",
+            "incremental": "solver_incremental=True, solver_kernel=numpy "
+                           "(the new defaults)",
+        },
+        "solver_wall_s": {"legacy": legacy_wall, "incremental": inc_wall},
+        "solver_wall_per_job_ms": {
+            "legacy": round(1000.0 * legacy_wall / n_jobs, 4),
+            "incremental": round(1000.0 * inc_wall / n_jobs, 4),
+        },
+        "speedup": speedup,
+        "target": ">= 10x solver_wall/job vs the pinned-legacy arm",
+        "cycles": {
+            "legacy": med("legacy", "solver_cycles"),
+            "incremental": med("incremental", "solver_cycles"),
+        },
+        "incremental_cycle_share": round(
+            med("incremental", "solver_incremental_cycles")
+            / max(1.0, med("incremental", "solver_cycles")), 3
+        ),
+        "groups_solved": {
+            # The O(changed) evidence: gangs handed to the placer across
+            # the whole burst (legacy re-solves every pending gang every
+            # dirty cycle; incremental only the dirty subset).
+            "legacy": med("legacy", "solver_groups_solved"),
+            "incremental": med("incremental", "solver_groups_solved"),
+        },
+        "quality": {
+            "p50_s": {"legacy": med("legacy", "p50_s"),
+                      "incremental": med("incremental", "p50_s")},
+            "p99_s": {"legacy": med("legacy", "p99_s"),
+                      "incremental": med("incremental", "p99_s")},
+            "tpu_utilization": {
+                "legacy": med("legacy", "tpu_utilization"),
+                "incremental": med("incremental", "tpu_utilization"),
+            },
+            "granular_oracle": goracle,
+            "p99_vs_oracle": {
+                arm: round(med(arm, "p99_s") / goracle["p99_s"], 4)
+                if goracle["p99_s"] else None
+                for arm in ("legacy", "incremental")
+            },
+        },
+        "runs": runs,
+        **({"pre_pr_reference": pre_pr_block} if pre_pr_block else {}),
+        "scale_10k": scale,
+        "caps": (
+            f"{pairs} interleaved pairs (median quoted); trace ring caps "
+            "per-run cycle stats at 2048 cycles (not hit at this scale)"
+        ),
+    }
+    doc = {
+        "bench": "solver",
+        "method": (
+            "identical 1k-job burst (virtual clock, solve wall charged into "
+            "sim time) through the pinned-legacy arm "
+            "(solver_incremental=False + jax kernel) and the incremental arm "
+            "(per-group dirty tracking + delta-maintained snapshot + numpy "
+            "kernel), interleaved pairs; plus one cold 10k-node/2k-gang "
+            "single solve against the bench budget"
+        ),
+        **block,
+    }
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    return block
+
+
+def run_solver_scale(n_slices: int = 2500, n_gangs: int = 2000,
+                     budget_s: float = 2.0):
+    """First 10k-node / 2k-gang block: ONE cold solve of the whole pending
+    set against a 2500-slice (4 hosts each) inventory — the ROADMAP item 3
+    scale. Reports snapshot-build and solve wall separately; the acceptance
+    budget is solve wall < 2 s."""
+    from training_operator_tpu.cluster.objects import PodGroup
+    from training_operator_tpu.cluster.runtime import Cluster as Cl
+    from training_operator_tpu.scheduler.snapshot import (
+        ClusterSnapshot,
+        GangRequest,
+        PodRequest,
+        SnapshotMaintainer,
+    )
+
+    rng = random.Random(7)
+    cluster = Cl(VirtualClock())
+    cluster.add_nodes(make_tpu_pool(n_slices, slice_topology=SLICE_TOPOLOGY))
+
+    shapes = [("1x4", 1), ("1x4", 1), ("2x4", 2), ("4x4", 4)]
+    requests = []
+    for i in range(n_gangs):
+        topo, hosts = rng.choice(shapes)
+        pg = PodGroup(
+            metadata=ObjectMeta(name=f"scale-{i}", namespace="default"),
+            min_member=hosts,
+            topology_request=topo,
+        )
+        pg.metadata.creation_time = float(i) * 0.001
+        pods = [
+            PodRequest(
+                name=f"scale-{i}-w-{j}", replica_type="Worker", index=j,
+                resources={"cpu": 1.0, TPU_RESOURCE: 4.0},
+            )
+            for j in range(hosts)
+        ]
+        requests.append(GangRequest(
+            group=pg, pods=pods, topology=topo, num_slices=1, tpu_type="v5e",
+        ))
+
+    t0 = time.perf_counter()
+    snapshot = ClusterSnapshot(cluster.api)
+    cold_snapshot_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    maintainer = SnapshotMaintainer(cluster.api)
+    maintainer.rebuild()
+    maintainer_prime_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    inc_snapshot = maintainer.snapshot()
+    inc_snapshot_s = time.perf_counter() - t0
+
+    packer = TPUPacker(kernel="numpy")
+    t0 = time.perf_counter()
+    placements = packer.place(requests, inc_snapshot, now=10_000.0)
+    solve_s = time.perf_counter() - t0
+    admitted = sum(1 for p in placements.values() if p is not None)
+    return {
+        "nodes": n_slices * HOSTS_PER_SLICE,
+        "slices": n_slices,
+        "gangs": n_gangs,
+        "admitted": admitted,
+        "cold_snapshot_walk_s": round(cold_snapshot_s, 4),
+        "maintainer_prime_s": round(maintainer_prime_s, 4),
+        "incremental_snapshot_serve_s": round(inc_snapshot_s, 6),
+        "solve_wall_s": round(solve_s, 4),
+        "budget_s": budget_s,
+        "within_budget": solve_s < budget_s,
+        "solver_stats": dict(packer.last_solve_stats),
+    }
+
+
 def _accelerator_reachable(timeout_s: float = 150.0) -> bool:
     """Probe the default JAX backend in a SUBPROCESS with a hard timeout.
 
@@ -2017,6 +2278,23 @@ def main():
                     help="jobs per team in the contention block")
     ap.add_argument("--tenancy-out", default="BENCH_SELF_TENANCY_r11.json",
                     help="artifact path for --tenancy-only")
+    ap.add_argument("--solver-leg", default=None,
+                    choices=("legacy", "incremental"),
+                    help="run ONE solver-bench burst leg and print its "
+                         "stats as JSON — used to measure the true pre-PR "
+                         "code from a worktree carrying this harness "
+                         "(bench-wire-v2 method)")
+    ap.add_argument("--solver-only", action="store_true",
+                    help="run only the incremental-solver A/B block "
+                         "(pinned-legacy vs incremental arms, interleaved "
+                         "pairs, + the 10k-node/2k-gang single-solve scale "
+                         "block) and write --solver-out")
+    ap.add_argument("--solver-pairs", type=int, default=3,
+                    help="interleaved pairs for the solver block")
+    ap.add_argument("--solver-jobs", type=int, default=1000,
+                    help="burst size for the solver block")
+    ap.add_argument("--solver-out", default="BENCH_SELF_SOLVER_r13.json",
+                    help="artifact path for --solver-only")
     ap.add_argument("--audit", action="store_true",
                     help="run every burst under the standing invariant "
                          "auditor in fail-fast mode (observe/invariants.py): "
@@ -2049,6 +2327,38 @@ def main():
     if args.audit:
         global AUDIT_BURSTS
         AUDIT_BURSTS = True
+
+    if args.solver_leg:
+        import inspect as _inspect
+
+        specs = build_workload(args.solver_jobs, args.seed)
+        inc = args.solver_leg == "incremental"
+        packer_kwargs = {"kernel": "numpy" if inc else "jax"}
+        if "kernel" not in _inspect.signature(TPUPacker.__init__).parameters:
+            packer_kwargs = {}  # pre-PR packer: one (jit) kernel
+        run = run_burst(specs, TPUPacker(**packer_kwargs), incremental=inc)
+        print(json.dumps({"leg": args.solver_leg, **{
+            k: run[k] for k in (
+                "solver_wall_s", "solver_cycles", "p50_s", "p99_s",
+                "tpu_utilization",
+            )
+        }}))
+        return
+
+    if args.solver_only:
+        block = run_solver_bench(args.solver_jobs, pairs=args.solver_pairs,
+                                 seed=args.seed, out=args.solver_out,
+                                 before_repo=args.before_repo)
+        print(json.dumps({
+            "metric": "solver_wall_per_job_speedup",
+            "value": block["speedup"],
+            "unit": "x (pinned-legacy solver_wall/job over incremental, "
+                    "median of interleaved pairs; scale_10k carries the "
+                    "10k-node single-solve budget check)",
+            "vs_baseline": block["solver_wall_s"]["legacy"],
+            "solver": {k: v for k, v in block.items() if k != "runs"},
+        }))
+        return
 
     if args.audit_only:
         block = run_audit_overhead(args.audit_jobs)

@@ -613,6 +613,30 @@ class TestCodelint:
                "metrics.reconcile_seconds.observe(0.1)\n")
         assert not check_source("x.py", use, package_rel="controllers/x.py")
 
+    def test_cl007_full_store_walk_in_scheduler(self):
+        # Unfiltered Pod/Node walks in scheduler/ are the O(cluster)
+        # regression CL007 fences off...
+        src = ("def solve(api):\n"
+               "    pods = api.list('Pod')\n"
+               "    nodes = api.list_refs('Node')\n")
+        found = check_source("x.py", src, package_rel="scheduler/gang.py")
+        assert [f.rule_id for f in found] == ["CL007", "CL007"], found
+        # ...but snapshot.py owns the prime/rebuild walks...
+        assert not check_source(
+            "snapshot.py", src, package_rel="scheduler/snapshot.py"
+        )
+        # ...and outside scheduler/ the rule does not apply.
+        assert not check_source("x.py", src, package_rel="observe/x.py")
+
+    def test_cl007_filtered_and_small_kinds_exempt(self):
+        # A namespace/label-filtered list is an index read, not a walk; the
+        # tiny control-plane kinds stay legal anywhere in scheduler/.
+        src = ("def f(api, ns):\n"
+               "    a = api.list('Pod', ns, {'label': 'x'})\n"
+               "    b = api.list('PodGroup')\n"
+               "    c = api.list_refs('ClusterQueue')\n")
+        assert not check_source("x.py", src, package_rel="scheduler/elastic.py")
+
     def test_cl003_daemon_or_join_ok(self):
         daemon = ("import threading\n"
                   "def f():\n    threading.Thread(target=f, daemon=True).start()\n")

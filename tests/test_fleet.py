@@ -407,6 +407,25 @@ class TestFleetSnapshot:
         assert fleet["objects"]["Node"] == 10
         assert fleet["free_tpu_hosts"] == 7
 
+    def test_solver_stats_in_fleet_and_top(self):
+        """PR 10 satellite: /fleet (and therefore `top`) carries the gang
+        solver's cycle stats from the training_solver_* families."""
+        from training_operator_tpu.utils import metrics as M
+
+        cluster = make_cluster(tpu_slices=1)
+        before = int(M.solver_cycles.total())
+        M.solver_cycles.inc()
+        M.solver_incremental_cycles.inc()
+        M.solver_groups_resolved.inc(amount=3)
+        fleet = observe.collect_fleet(cluster.api, cluster.clock.now())
+        solver = fleet["solver"]
+        assert solver["cycles"] == before + 1
+        assert solver["incremental_cycles"] >= 1
+        assert solver["groups_resolved"] >= 3
+        assert "snapshot_rebuilds" in solver and "wall_mean_s" in solver
+        rendered = observe.render_top(fleet)
+        assert "solver:" in rendered and "incremental" in rendered
+
     def test_job_states_by_kind(self):
         cluster = make_cluster(tpu_slices=0)
         tmpl = PodTemplateSpec(containers=[Container(name="jax")])

@@ -22,7 +22,12 @@ from __future__ import annotations
 import numpy as np
 import pytest
 
-from training_operator_tpu.scheduler.packer import _NEG, _solve_batch
+from training_operator_tpu.scheduler.packer import (
+    _NEG,
+    _solve_batch,
+    _solve_batch_numpy,
+    _solve_batch_python,
+)
 
 
 def solve(free, cand_mask, cand_slice, cand_valid, origin_rank, item_class, item_active):
@@ -257,3 +262,52 @@ class TestSolveBatch:
         chosen = solve(free, cand_mask, cand_slice, cand_valid, origin_rank, item_class, item_active)
         ref = greedy_reference(free, cand_mask, cand_slice, cand_valid, origin_rank, item_class, item_active)
         assert (chosen == ref).all(), f"kernel {chosen} != greedy {ref}"
+
+
+class TestKernelEquivalence:
+    """The solver_kernel knob's contract: all three kernels (jit, numpy,
+    pure-python) implement the SAME parallel-rounds algorithm and must
+    return bit-identical placements on any instance — the property that
+    makes the knob a perf choice, never a scheduling-quality one."""
+
+    @staticmethod
+    def _args(rng):
+        s, h = int(rng.integers(1, 4)), 4
+        k = int(rng.integers(1, 4))
+        c = int(rng.integers(1, 8))
+        g = int(rng.integers(1, 14))
+        return (
+            rng.random((s, h)) < 0.7,
+            rng.random((k, c, h)) < 0.4,
+            rng.integers(0, s, size=(k, c)).astype(np.int32),
+            (rng.random((k, c)) < 0.9),
+            rng.integers(0, h, size=(k, c)).astype(np.int32),
+            rng.integers(0, k, size=g).astype(np.int32),
+            rng.random(g) < 0.9,
+        )
+
+    @pytest.mark.parametrize("seed", range(16))
+    def test_three_kernels_identical(self, seed):
+        rng = np.random.default_rng(1000 + seed)
+        free, cand_mask, cand_slice, cand_valid, origin_rank, item_class, item_active = self._args(rng)
+        cand_valid = cand_valid & cand_mask.any(axis=-1)
+        via_jax = solve(free, cand_mask, cand_slice, cand_valid, origin_rank,
+                        item_class, item_active)
+        via_np = _solve_batch_numpy(
+            np.asarray(free, dtype=bool), np.asarray(cand_mask, dtype=bool),
+            np.asarray(cand_slice, dtype=np.int32),
+            np.asarray(cand_valid, dtype=bool),
+            np.asarray(origin_rank, dtype=np.int32),
+            np.asarray(item_class, dtype=np.int32),
+            np.asarray(item_active, dtype=bool),
+        )
+        via_py = _solve_batch_python(
+            np.asarray(free, dtype=bool), np.asarray(cand_mask, dtype=bool),
+            np.asarray(cand_slice, dtype=np.int32),
+            np.asarray(cand_valid, dtype=bool),
+            np.asarray(origin_rank, dtype=np.int32),
+            np.asarray(item_class, dtype=np.int32),
+            np.asarray(item_active, dtype=bool),
+        )
+        assert (via_jax == via_np).all(), f"jax {via_jax} != numpy {via_np}"
+        assert (via_jax == via_py).all(), f"jax {via_jax} != python {via_py}"

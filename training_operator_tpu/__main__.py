@@ -219,6 +219,27 @@ def parse_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--aging-seconds", type=float, default=None,
                     help="tpu-packer starvation bound: gangs waiting longer "
                          "are promoted to FIFO front (default 300)")
+    ap.add_argument("--solver-incremental", dest="solver_incremental",
+                    action="store_true", default=None,
+                    help="incremental gang solving (default on): per-group "
+                         "dirty tracking + delta-maintained snapshot; "
+                         "cycles triggered by demand events re-solve only "
+                         "the dirty gangs")
+    ap.add_argument("--no-solver-incremental", dest="solver_incremental",
+                    action="store_false",
+                    help="pin the legacy solve path: global dirty bit + "
+                         "full snapshot walk every cycle (the compat arm)")
+    ap.add_argument("--solver-kernel", default=None,
+                    choices=("python", "numpy", "jax"),
+                    help="candidate-scoring kernel: numpy (default fast "
+                         "path), jax (XLA-compiled opt-in; pin "
+                         "JAX_PLATFORMS=cpu on the control plane), python "
+                         "(reference arm) — all three place identically")
+    ap.add_argument("--snapshot-selfcheck-every", type=int, default=None,
+                    help="every N solve cycles diff the incremental "
+                         "snapshot against a cold full-walk rebuild and "
+                         "adopt the rebuild on mismatch (0 disables; "
+                         "default 0)")
     ap.add_argument("--disable-tenancy", dest="tenancy_enabled",
                     action="store_false", default=None,
                     help="run the gang solver strictly first-come: no quota "
@@ -290,6 +311,12 @@ def build_config(args: argparse.Namespace) -> OperatorConfig:
         cfg.max_drain_fraction = args.max_drain_fraction
     if args.aging_seconds is not None:
         cfg.aging_seconds = args.aging_seconds
+    if args.solver_incremental is not None:
+        cfg.solver_incremental = args.solver_incremental
+    if args.solver_kernel is not None:
+        cfg.solver_kernel = args.solver_kernel
+    if args.snapshot_selfcheck_every is not None:
+        cfg.snapshot_selfcheck_every = args.snapshot_selfcheck_every
     if args.tenancy_enabled is not None:
         cfg.tenancy_enabled = args.tenancy_enabled
     if args.default_priority_class is not None:
@@ -411,6 +438,7 @@ def wire_cluster_services(cluster: Cluster, cfg: OperatorConfig) -> None:
                 drain_reserve_seconds=cfg.drain_reserve_seconds,
                 max_drain_fraction=cfg.max_drain_fraction,
                 aging_seconds=cfg.aging_seconds,
+                kernel=cfg.solver_kernel,
             ),
             "baseline": lambda: BaselinePlacer(whole_slice=True),
             "baseline-firstfit": lambda: BaselinePlacer(whole_slice=False),
@@ -426,10 +454,15 @@ def wire_cluster_services(cluster: Cluster, cfg: OperatorConfig) -> None:
         GangScheduler(
             cluster,
             placer,
-            prewarm=cfg.gang_scheduler_name == "tpu-packer",
+            prewarm=(
+                cfg.gang_scheduler_name == "tpu-packer"
+                and cfg.solver_kernel == "jax"
+            ),
             resolve_period=cfg.resolve_period,
             min_solve_interval=cfg.min_solve_interval,
             arbiter=arbiter,
+            incremental=cfg.solver_incremental,
+            snapshot_selfcheck_every=cfg.snapshot_selfcheck_every,
         )
 
 

@@ -31,6 +31,15 @@ Rules (all ERROR; the tree must stay green — `make lint` runs this):
         CL005 pattern applied to the fleet auditor's rule catalog): the
         INV001-INV006 reference table in the README holds only if every
         rule the auditor can evaluate is declared in that one module.
+  CL007 full-store-walk-in-scheduler    an unfiltered `.list("Pod")` /
+        `.list("Node")` / `.list_refs(...)` over the Pod or Node kinds
+        anywhere in scheduler/ outside snapshot.py. The incremental solver
+        is O(changed) only while the solve path reads the delta-maintained
+        snapshot and the informer caches; a full-store walk creeping back
+        into the cycle silently regresses it to O(cluster). snapshot.py
+        owns the two legal walks (the informer prime and the selfcheck/
+        rebuild arm); filtered lists (namespace/label selectors) and other
+        kinds are exempt.
 
 Run: `python -m training_operator_tpu.analysis.codelint [paths...]`
 (defaults to the `training_operator_tpu` package). Exit 1 on findings.
@@ -131,6 +140,26 @@ def _is_invariant_registration(call: ast.Call) -> bool:
     return isinstance(f, ast.Attribute) and f.attr == INVARIANT_REGISTRAR
 
 
+# The store kinds whose unfiltered walk in scheduler/ is a CL007 finding:
+# these are the O(cluster) populations (pods, nodes); the tiny control-plane
+# kinds (PodGroup, ClusterQueue, ...) stay legal.
+FULL_WALK_KINDS = ("Pod", "Node")
+
+
+def _is_full_store_walk(call: ast.Call) -> bool:
+    """An unfiltered `<recv>.list("Pod"|"Node")` or `.list_refs(...)` call:
+    exactly one positional argument, a string literal naming a bulk kind,
+    and no namespace/label-selector arguments (a filtered list is an index
+    read, not a walk)."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr in ("list", "list_refs")):
+        return False
+    if len(call.args) != 1 or call.keywords:
+        return False
+    arg = call.args[0]
+    return isinstance(arg, ast.Constant) and arg.value in FULL_WALK_KINDS
+
+
 def _is_thread_ctor(call: ast.Call) -> bool:
     f = call.func
     if isinstance(f, ast.Attribute) and f.attr == "Thread":
@@ -173,6 +202,9 @@ def check_source(path: str, source: str, package_rel: Optional[str] = None) -> L
 
     in_control_pkg = any(f"{pkg}/" in rel for pkg in CONTROL_LOOP_PACKAGES)
     in_scheduler = "scheduler/" in rel
+    # The one scheduler file allowed to walk the store (CL007): the
+    # snapshot's informer-prime + selfcheck/rebuild arms.
+    in_snapshot_module = rel.endswith("scheduler/snapshot.py")
     # The one file allowed to register metric families (CL005).
     in_metrics_module = rel.endswith("utils/metrics.py")
     # The one file allowed to register invariant rules (CL006).
@@ -221,6 +253,19 @@ def check_source(path: str, source: str, package_rel: Optional[str] = None) -> L
                 "invariant rule registration (register_invariant) outside "
                 "observe/invariants.py; declare the rule there so the "
                 "INV rule catalog stays one greppable list",
+            ))
+        if (
+            isinstance(node, ast.Call)
+            and in_scheduler
+            and not in_snapshot_module
+            and _is_full_store_walk(node)
+        ):
+            findings.append(Finding(
+                path, node.lineno, "CL007",
+                f"unfiltered {node.func.attr}({node.args[0].value!r}) "
+                f"full-store walk inside scheduler/; the solve cycle is "
+                f"O(changed) only while walks stay in snapshot.py's "
+                f"prime/rebuild path",
             ))
         if isinstance(node, ast.Call) and _is_time_sleep(node) and in_control_pkg:
             findings.append(Finding(

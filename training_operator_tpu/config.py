@@ -21,6 +21,7 @@ from typing import Dict, List, Optional
 
 ALL_SCHEMES = ("jax", "pytorch", "tensorflow", "xgboost", "paddle", "mpi")
 GANG_SCHEDULERS = ("none", "tpu-packer", "baseline", "baseline-firstfit")
+SOLVER_KERNELS = ("python", "numpy", "jax")
 
 
 @dataclass
@@ -40,6 +41,25 @@ class OperatorConfig:
     # Gang solve cadence (GangScheduler knobs).
     resolve_period: float = 15.0
     min_solve_interval: float = 0.0
+    # Incremental gang solver (scheduler/gang.py + snapshot.py, PR 10):
+    #   solver_incremental — per-group dirty tracking + the long-lived
+    #       delta-maintained ClusterSnapshot. A cycle triggered only by
+    #       demand-side events re-solves just the dirty gangs; capacity/
+    #       tenancy events and the periodic resolve force the full set.
+    #       False pins the pre-incremental behavior (global dirty bit +
+    #       per-cycle snapshot construction) as the compat arm.
+    #   solver_kernel — candidate-scoring kernel: "numpy" (default fast
+    #       path, no per-cycle dispatch cost), "jax" (XLA-compiled opt-in,
+    #       prewarmed + pow2-padded; run under JAX_PLATFORMS=cpu on the
+    #       control plane), "python" (auditable reference arm). All three
+    #       return identical placements (property-tested).
+    #   snapshot_selfcheck_every — every N solve cycles diff the
+    #       incremental snapshot against a cold full-walk rebuild and adopt
+    #       the rebuild on mismatch (SnapshotDrift event +
+    #       training_solver_snapshot_rebuilds_total). 0 disables.
+    solver_incremental: bool = True
+    solver_kernel: str = "numpy"
+    snapshot_selfcheck_every: int = 0
     # Tail-latency SLO knobs (TPUPacker; see scheduler/packer.py:158-199
     # and the README tail-latency sweep for the measured trade-offs):
     #   drain_reserve_seconds — a whole-slice gang waiting longer than this
@@ -182,6 +202,15 @@ class OperatorConfig:
             )
         if self.controller_threads < 1:
             raise ValueError("controller_threads must be >= 1")
+        if self.solver_kernel not in SOLVER_KERNELS:
+            raise ValueError(
+                f"unknown solver kernel {self.solver_kernel!r}; "
+                f"choose from {SOLVER_KERNELS}"
+            )
+        if self.snapshot_selfcheck_every < 0:
+            raise ValueError(
+                "snapshot_selfcheck_every must be >= 0 (0 disables)"
+            )
         if self.watch_ring_size < 1:
             # A zero-size ring would answer EVERY resume too-old: clients
             # still converge (relist arm) but every reconnect goes back to

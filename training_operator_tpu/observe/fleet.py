@@ -194,6 +194,19 @@ def collect_fleet(api, now: float,
     if sources.replication_lag is not None:
         replication = dict(sources.replication_lag())
 
+    # Gang-solver cycle stats (the training_solver_* counter families +
+    # the solve-wall histogram), so `top` and the /fleet consumers see the
+    # O(changed) plane without scraping /metrics separately.
+    solve_hist = metrics.scheduler_solve_seconds
+    solver = {
+        "cycles": int(metrics.solver_cycles.total()),
+        "incremental_cycles": int(metrics.solver_incremental_cycles.total()),
+        "groups_resolved": int(metrics.solver_groups_resolved.total()),
+        "snapshot_rebuilds": int(metrics.solver_snapshot_rebuilds.total()),
+        "wall_total_s": round(solve_hist.sum, 4),
+        "wall_mean_s": round(solve_hist.mean(), 6),
+    }
+
     return {
         "t": now,
         "nodes": {
@@ -209,6 +222,7 @@ def collect_fleet(api, now: float,
             1 for s in slices.values() if s["free_hosts"] == s["hosts"]
         ),
         "podgroups": podgroups,
+        "solver": solver,
         "queues": queue_rows,
         "queue": {
             "pending_gangs": podgroups.get("Pending", 0)
@@ -410,6 +424,19 @@ def render_top(fleet: Dict[str, Any]) -> str:
         f"{q['workqueue_depth']:.0f}  expectations "
         f"{q['unfulfilled_expectations']}"
     )
+
+    solver = fleet.get("solver")
+    if solver and solver.get("cycles"):
+        inc = solver.get("incremental_cycles", 0)
+        cycles = solver["cycles"]
+        lines.append(
+            "solver:  "
+            f"cycles {cycles} ({inc} incremental, "
+            f"{100.0 * inc / cycles:.0f}%)  groups solved "
+            f"{solver.get('groups_resolved', 0)}  wall mean "
+            f"{1000.0 * solver.get('wall_mean_s', 0.0):.2f}ms  "
+            f"snapshot rebuilds {solver.get('snapshot_rebuilds', 0)}"
+        )
 
     if fleet.get("queues"):
         lines.append("")

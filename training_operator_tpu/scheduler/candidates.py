@@ -151,6 +151,7 @@ class CandidateCache:
 
     def __init__(self) -> None:
         self._cache: Dict[Tuple[str, int, str], Optional[CandidateSet]] = {}
+        self._arrays: Dict[Tuple[str, int, str, int], Tuple] = {}
 
     def get(
         self, slice_topology: str, chips_per_host: int, request_topology: str
@@ -159,6 +160,30 @@ class CandidateCache:
         if key not in self._cache:
             self._cache[key] = enumerate_candidates(*key)
         return self._cache[key]
+
+    def get_arrays(self, slice_topology: str, chips_per_host: int,
+                   request_topology: str, h_pad: int):
+        """The enumeration as padded ndarrays: (masks (C, h_pad) bool,
+        origin ranks (C,) int32), memoized per geometry + pad width so the
+        packer's per-slice candidate assembly is array slicing, not a
+        Python loop over mask tuples. Returns (None, None) when no
+        contiguous placement exists."""
+        key = (slice_topology, chips_per_host, request_topology, h_pad)
+        hit = self._arrays.get(key)
+        if hit is not None:
+            return hit
+        import numpy as np
+
+        cset = self.get(slice_topology, chips_per_host, request_topology)
+        if cset is None:
+            out = (None, None)
+        else:
+            masks = np.zeros((cset.num_candidates, h_pad), dtype=bool)
+            for c, mask in enumerate(cset.masks):
+                masks[c, : len(mask)] = mask
+            out = (masks, np.asarray(cset.origin_rank, dtype=np.int32))
+        self._arrays[key] = out
+        return out
 
     def feasible(
         self, slice_topology: str, chips_per_host: int, request_topology: str

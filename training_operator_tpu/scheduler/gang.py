@@ -45,7 +45,9 @@ GANG_REPLACEMENT_REASON = "gang re-placement"
 _GANG_EVICT_MESSAGE_PREFIX = f"{NODE_LOST_MESSAGE_PREFIX}: {GANG_REPLACEMENT_REASON}"
 from training_operator_tpu.scheduler.snapshot import (
     ClusterSnapshot,
+    SnapshotMaintainer,
     build_gang_request,
+    prime_scheduler_caches,
 )
 from training_operator_tpu.utils import metrics
 
@@ -62,6 +64,8 @@ class GangScheduler:
         resolve_period: float = 15.0,
         min_solve_interval: float = 0.0,
         arbiter=None,
+        incremental: bool = True,
+        snapshot_selfcheck_every: int = 0,
     ):
         self.cluster = cluster
         self.api = cluster.api
@@ -95,6 +99,22 @@ class GangScheduler:
         self.min_solve_interval = min_solve_interval
         self._wakeup_armed = False
         self._watch = cluster.api.watch()
+        # Incremental solving (the solver_incremental knob): per-group +
+        # per-node dirty tracking instead of the one global bit. A cycle
+        # triggered only by demand-side events (gang created / reset /
+        # resized) re-solves just those groups — placements and verdicts of
+        # untouched gangs are invariant while free capacity can only have
+        # shrunk. Any capacity-freeing or tenancy event, a write conflict,
+        # or the periodic resolve falls back to a full solve, so a freed
+        # window still re-opens every tier in arbiter order.
+        self.incremental = incremental
+        self._dirty_groups: set = set()
+        self._solve_all = True  # first solve is always a full one
+        # Full-rebuild parity probe for the incremental snapshot: every N
+        # solve cycles, diff the delta-maintained view against a cold walk
+        # and adopt the rebuild on mismatch. 0 disables.
+        self.snapshot_selfcheck_every = snapshot_selfcheck_every
+        self._solves_since_selfcheck = 0
         self._solve_dirty = True
         self._bind_dirty = True
         self._advance_dirty = True
@@ -128,22 +148,34 @@ class GangScheduler:
         from collections import deque
 
         self.trace = deque(maxlen=2048)
-        for pod in self.api.list("Pod"):
-            self._observe_pod("Added", pod)
-        for pg in self.api.list("PodGroup"):
-            self._groups[f"{pg.namespace}/{pg.name}"] = pg
-        for node in self.api.list("Node"):
-            self._nodes[node.name] = node
         # Cross-cycle memos: expanded GangRequests keyed by PodGroup uid and
         # the snapshot's per-gang pod-request cache (both invalidated by the
         # owning job's resourceVersion).
         self._req_cache: Dict[str, tuple] = {}
         self._pod_req_cache: Dict[str, tuple] = {}
+        # Informer prime (the one legal full walk, served from snapshot.py —
+        # codelint CL007 keeps store walks out of the solve path).
+        pods, pgs, nodes = prime_scheduler_caches(self.api)
+        for pod in pods:
+            self._observe_pod("Added", pod)
+        for pg in pgs:
+            self._groups[f"{pg.namespace}/{pg.name}"] = pg
+        for node in nodes:
+            self._nodes[node.name] = node
+        # The long-lived incremental snapshot view, fed from the same watch
+        # stream the informer caches consume. Compat mode (incremental=False)
+        # keeps the per-cycle construction from the informer caches.
+        self._maintainer: Optional[SnapshotMaintainer] = None
+        if incremental:
+            self._maintainer = SnapshotMaintainer(self.api, self._pod_req_cache)
+            self._maintainer.rebuild()
         cluster.add_ticker(self.tick)
 
     # ------------------------------------------------------------------
 
     def _snapshot(self) -> ClusterSnapshot:
+        if self._maintainer is not None:
+            return self._maintainer.snapshot()
         return ClusterSnapshot(
             self.api,
             self._pod_req_cache,
@@ -189,16 +221,25 @@ class GangScheduler:
     def _drain_events(self) -> None:
         for ev in self._watch.drain():
             kind, obj = ev.kind, ev.obj
+            if self._maintainer is not None and kind in ("Pod", "PodGroup", "Node"):
+                self._maintainer.observe(ev)
             if kind == "Pod":
                 self._observe_pod(ev.type, obj)
                 # Capacity is freed when a pod terminates or disappears.
                 if ev.type == "Deleted" or obj.is_terminal():
                     self._solve_dirty = True
+                    self._solve_all = True
                     self._capacity_freed = True
             elif kind == "PodGroup":
                 gkey = f"{obj.namespace}/{obj.name}"
-                if ev.type in ("Added", "Deleted") or obj.phase == PodGroupPhase.PENDING:
+                if ev.type == "Added" or (
+                    ev.type != "Deleted" and obj.phase == PodGroupPhase.PENDING
+                ):
+                    # Demand-side event: only THIS gang's verdict changed —
+                    # the incremental cycle re-solves it alone (capacity can
+                    # only have shrunk for everyone else).
                     self._solve_dirty = True
+                    self._dirty_groups.add(gkey)
                 self._bind_dirty = True
                 self._advance_dirty = True
                 if ev.type == "Deleted":
@@ -208,6 +249,7 @@ class GangScheduler:
                     self._pod_req_cache.pop(obj.metadata.uid, None)
                     self._attempts.pop(obj.metadata.uid, None)
                     self._solve_dirty = True  # reservations released
+                    self._solve_all = True
                     self._capacity_freed = True
                 else:
                     self._groups[gkey] = obj
@@ -228,12 +270,15 @@ class GangScheduler:
                 else:
                     self._nodes[name] = obj
                 self._solve_dirty = True
+                self._solve_all = True
                 self._bind_dirty = True
                 self._capacity_freed = True
             elif kind in ("ClusterQueue", "PriorityClass"):
                 # A tenancy edit (quota raised, class re-valued) can free a
-                # quota-blocked gang or reorder the queue — re-arbitrate.
+                # quota-blocked gang or reorder the queue — re-arbitrate
+                # everything (quota effects cross gang boundaries).
                 self._solve_dirty = True
+                self._solve_all = True
             elif (
                 ev.type == "Modified"
                 and not ev.status_only
@@ -241,15 +286,30 @@ class GangScheduler:
             ):
                 # A job spec change (elastic resize) can grow an admitted
                 # gang (re-pack) or resize a still-pending one (re-solve).
+                # PodGroup name == owning job name (PodGroupControl).
                 self._repack_dirty = True
                 self._solve_dirty = True
+                self._dirty_groups.add(
+                    f"{obj.metadata.namespace}/{obj.metadata.name}"
+                )
+            elif ev.type == "Deleted" and hasattr(obj, "replica_specs"):
+                # Owner gone: the memoized request must not be trusted past
+                # this instant (the group itself is cascade-GC'd shortly).
+                self._dirty_groups.add(
+                    f"{obj.metadata.namespace}/{obj.metadata.name}"
+                )
 
     def tick(self) -> None:
         if self._needs_prewarm:
             self._needs_prewarm = False
             self.placer.prewarm(self._snapshot())
         self._drain_events()
-        self._process_invalidations()
+        if self._process_invalidations():
+            # The invalidation just wrote evictions + placement clears;
+            # absorb their watch echoes NOW so this tick's solve (and the
+            # incremental snapshot) sees the post-invalidation state rather
+            # than lagging it by one tick.
+            self._drain_events()
         self._admit_pending()
         # Repack runs on job-spec resizes AND retries unsatisfied deltas
         # whenever capacity frees — a grown gang whose delta didn't fit must
@@ -275,28 +335,62 @@ class GangScheduler:
 
     # ------------------------------------------------------------------
 
-    def _record_trace(self, now, wall, requests, placements, snapshot) -> None:
+    def _maybe_selfcheck(self) -> None:
+        """Every snapshot_selfcheck_every solve cycles, diff the incremental
+        snapshot against a cold rebuild (SnapshotMaintainer.selfcheck). A
+        mismatch adopts the rebuild and surfaces as an Event — a missed
+        delta must not silently compound into wrong placements."""
+        if self._maintainer is None or self.snapshot_selfcheck_every <= 0:
+            return
+        self._solves_since_selfcheck += 1
+        if self._solves_since_selfcheck < self.snapshot_selfcheck_every:
+            return
+        self._solves_since_selfcheck = 0
+        problems = self._maintainer.selfcheck()
+        if problems:
+            self.api.record_event(Event(
+                object_kind="Node", object_name="*", namespace="",
+                event_type="Warning", reason="SnapshotDrift",
+                message=f"incremental snapshot diverged ({len(problems)} "
+                        f"mismatch(es)); rebuilt: {problems[0]}",
+                timestamp=self.cluster.clock.now(),
+            ))
+
+    def _record_trace(self, now, wall, requests, placements, snapshot,
+                      mode: str = "full") -> None:
         """One structured record per solve cycle: queue shape, solver work,
         admissions, and free-capacity/fragmentation state (post-admission:
         place() commits into the snapshot) — enough to replay WHY a gang
         waited (queue depth? no candidates? fragmented pool?) without
         re-running the solve. O(requests) bookkeeping per cycle."""
+        from training_operator_tpu.cluster.inventory import TPU_RESOURCE
+
         admitted = sum(1 for p in placements.values() if p is not None)
         tpu_reqs = sum(1 for r in requests if r.is_tpu())
-        free_hosts = 0
-        whole_free_slices = 0
-        for sl in snapshot.slices.values():
-            free = sum(
-                1
-                for n in sl.host_nodes
-                if snapshot.host_free(n, sl.chips_per_host)
+        if self._maintainer is not None and hasattr(snapshot, "_overlay"):
+            # O(committed): maintained tallies + this cycle's COW overlay.
+            free_hosts, whole_free_slices = self._maintainer.free_host_stats(
+                snapshot._overlay
             )
-            free_hosts += free
-            if free == sl.num_hosts:
-                whole_free_slices += 1
+        else:
+            free_hosts = 0
+            whole_free_slices = 0
+            free_map = snapshot.free
+            for sl in snapshot.slices.values():
+                chips = sl.chips_per_host
+                free = sum(
+                    1
+                    for n in sl.host_nodes
+                    if (a := free_map.get(n)) is not None
+                    and a.get(TPU_RESOURCE, 0.0) >= chips
+                )
+                free_hosts += free
+                if free == sl.num_hosts:
+                    whole_free_slices += 1
         record = {
             "t": round(now, 3),
             "solve_wall_s": round(wall, 6),
+            "mode": mode,
             "pending": len(requests),
             "pending_tpu": tpu_reqs,
             "pending_generic": len(requests) - tpu_reqs,
@@ -319,11 +413,22 @@ class GangScheduler:
         # stop at the deferred-solve instant; the tick that follows solves.
         self._wakeup_armed = False
 
-    def _gang_request(self, pg: PodGroup):
+    def _gang_request(self, pg: PodGroup, trust_cache: bool = False):
         """build_gang_request with a (job rv, group shape)-keyed memo — the
         replica expansion is pure given those inputs. The version probe
         avoids cloning the owning job on every cycle (copy-on-read makes
-        get() allocate); the job is only fetched on a cache miss."""
+        get() allocate); the job is only fetched on a cache miss.
+
+        `trust_cache` (incremental mode, non-dirty groups): skip even the
+        version probe — every spec change that could invalidate the memo
+        arrives as a watch event that marks the group dirty, so an
+        untouched group's memo is current by construction."""
+        if trust_cache:
+            hit = self._req_cache.get(pg.metadata.uid)
+            if hit is not None:
+                req = hit[1]
+                req.group = pg  # rebind to the current object
+                return req
         kind = pg.metadata.labels.get("job-kind")
         if not kind:
             return None
@@ -363,16 +468,73 @@ class GangScheduler:
             return
         t0 = time.perf_counter()
         solve_at = now  # cluster-clock solve start, for the timeline spans
+        # Incremental cycle: a solve triggered purely by demand-side dirt
+        # re-solves only the dirty gangs. Capacity/tenancy events, write
+        # conflicts, and the periodic staleness bound (resolve_period, which
+        # reaches here with _solve_dirty False) all force the full set.
+        incremental_cycle = (
+            self.incremental
+            and self._solve_dirty
+            and not self._solve_all
+        )
+        if incremental_cycle:
+            # Starvation controls (drain reservations, aging promotion) are
+            # computed WITHIN a solve from the gangs it sees: once any
+            # pending gang has aged past those thresholds, a subset solve
+            # could hand a newly-arrived gang capacity the full solve
+            # withholds for the starved one. Escalate to the full set.
+            bound = min(
+                (t for t in (
+                    getattr(self.placer, "drain_reserve_seconds", 0.0),
+                    getattr(self.placer, "aging_seconds", 0.0),
+                ) if t and t > 0),
+                default=0.0,
+            )
+            if bound > 0:
+                threshold = now - bound
+                if any(
+                    (pg.metadata.creation_time or 0.0) <= threshold
+                    for pg in groups
+                ):
+                    incremental_cycle = False
+        dirty = self._dirty_groups
+        if incremental_cycle:
+            solve_groups = [
+                pg for pg in groups if f"{pg.namespace}/{pg.name}" in dirty
+            ]
+        else:
+            solve_groups = groups
+        self._solve_dirty = False
+        self._solve_all = False
+        self._dirty_groups = set()
+        self._last_solve_at = now
+        self._maybe_selfcheck()
         snapshot = self._snapshot()
         requests = []
-        for pg in groups:
+        req_cache = self._req_cache
+        trust = self.incremental
+        no_dirty = not dirty
+        for pg in solve_groups:
+            # Inlined trust-cache fast path (see _gang_request): with a few
+            # hundred pending gangs re-listed every cycle, even one probe
+            # per gang is measurable solve wall. Capacity-triggered cycles
+            # usually carry an empty dirty set, skipping even the key build.
+            if trust and (no_dirty or f"{pg.namespace}/{pg.name}" not in dirty):
+                hit = req_cache.get(pg.metadata.uid)
+                if hit is not None:
+                    req = hit[1]
+                    req.group = pg
+                    requests.append(req)
+                    continue
             req = self._gang_request(pg)
             if req is not None:
                 requests.append(req)
-        self._solve_dirty = False
-        self._last_solve_at = now
         if not requests:
             return
+        metrics.solver_cycles.inc()
+        if incremental_cycle:
+            metrics.solver_incremental_cycles.inc()
+        metrics.solver_groups_resolved.inc(amount=len(requests))
         blocked = []
         priorities: Dict[str, int] = {}
         starved_keys: set = set()
@@ -397,8 +559,9 @@ class GangScheduler:
         wall = time.perf_counter() - t0
         self.solve_walltime_total += wall
         self.cycles += 1
+        mode = "incremental" if incremental_cycle else "full"
         metrics.scheduler_solve_seconds.observe(wall)
-        self._record_trace(now, wall, solved, placements, snapshot)
+        self._record_trace(now, wall, solved, placements, snapshot, mode)
         if self.charge_solve_time and isinstance(self.cluster.clock, VirtualClock):
             self.cluster.clock.advance(wall)
 
@@ -466,6 +629,7 @@ class GangScheduler:
                         "gang_solve", start=solve_at, end=now, wall=wall,
                         pending=len(requests),
                         nodes=len(set(placement.assignments.values())),
+                        mode=mode, dirty_groups=len(requests),
                     )
             else:
                 # Track attempts scheduler-side without an API write per
@@ -536,15 +700,17 @@ class GangScheduler:
                 checkpointed_s=round(progress, 3),
             )
         self._solve_dirty = True
+        self._solve_all = True  # evictions freed capacity for every tier
         self._bind_dirty = True
         return persisted
 
-    def _process_invalidations(self) -> None:
+    def _process_invalidations(self) -> bool:
         if not self._lost_groups:
-            return
+            return False
         lost, self._lost_groups = self._lost_groups, {}
         for gkey, reason in lost.items():
             self._invalidate_group(gkey, reason)
+        return True
 
     def _invalidate_group(self, gkey: str, reason: str) -> None:
         """Gang re-admission after node loss: evict the surviving members
@@ -578,6 +744,9 @@ class GangScheduler:
             self._event(live, "Warning", "PlacementInvalidated",
                         f"{reason}; re-solving gang")
         self._solve_dirty = True
+        self._dirty_groups.add(gkey)
+        # The released reservation freed capacity others may want too.
+        self._solve_all = True
         self._bind_dirty = True
 
     def _fresh_for_write(self, pg: PodGroup) -> Optional[PodGroup]:
@@ -613,6 +782,7 @@ class GangScheduler:
             else:
                 self._groups.pop(key, None)
             self._solve_dirty = True
+            self._solve_all = True
             self._bind_dirty = True
             self._advance_dirty = True
             return False

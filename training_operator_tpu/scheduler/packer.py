@@ -7,15 +7,23 @@ solve per scheduling cycle:
   1. Snapshot pending gangs + host inventory.
   2. TPU gangs: every valid contiguous ICI sub-mesh placement of every gang on
      every compatible slice is materialized as a (class, candidate, host)
-     boolean tensor; a jit-compiled parallel-rounds kernel admits the whole
-     FIFO batch at once, scoring all candidates of each gang (best-fit slice
-     packing + corner-origin tiebreak) and resolving host conflicts in
-     priority order on device.
+     boolean tensor; a parallel-rounds kernel admits the whole FIFO batch at
+     once, scoring all candidates of each gang (best-fit slice packing +
+     corner-origin tiebreak) and resolving host conflicts in priority order.
   3. GPU/CPU gangs: vectorized best-fit with NVLink-domain locality bonus.
 
-Static shapes throughout (candidate/batch axes padded to power-of-two
-buckets) so XLA compiles each bucket once; 1k pending gangs are admitted in a
-single device program instead of 1k Python round-trips. Scoring axes:
+The kernel is a knob (`solver_kernel`): "numpy" (default) runs the algorithm
+as C-level array ops with no per-cycle dispatch cost; "jax" is the original
+XLA-jit form (static shapes, candidate/batch axes padded to power-of-two
+buckets so XLA compiles each bucket once, prewarmed at startup); "python" is
+the plain-loop reference arm. All three return bit-identical placements
+(property-tested in tests/test_solve_batch.py). Around the kernel, the
+steady-state cycle is O(changed): candidate tensors are cached per-slice and
+keyed by the SnapshotMaintainer's inventory generation (taint deltas repair
+rows in place), requests carry warm class hints, one (K, C) feasibility pass
+drops every gang of a saturated class before any per-gang Python runs, and a
+per-class admission cap (provably output-identical) bounds kernel + stitch
+work by admissible capacity rather than queue depth. Scoring axes:
 
   - best-fit: prefer slices with the fewest free hosts, keeping whole slices
     intact for full-slice gangs (the fragmentation killer in first-fit);
@@ -28,6 +36,7 @@ single device program instead of 1k Python round-trips. Scoring axes:
 
 from __future__ import annotations
 
+import itertools
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -148,6 +157,142 @@ def _solve_batch(free, cand_mask, cand_slice, cand_valid, origin_rank, item_clas
     return chosen  # packed: candidate index, or -1 = not admitted
 
 
+def _solve_batch_numpy(free, cand_mask, cand_slice, cand_valid, origin_rank,
+                       item_class, item_active):
+    """The numpy fast path: the SAME parallel-rounds algorithm as the jit
+    kernel above, op for op (stable argsort, exclusive prefix ranks,
+    cumulative-OR conflict detection), so the two kernels return identical
+    placements. At control-plane batch sizes (tens to low thousands of
+    items) the numpy form wins: no dispatch/transfer overhead per cycle,
+    and every op is a single C-level pass over small arrays."""
+    free = free.copy()
+    g = item_class.shape[0]
+    s, h = free.shape
+    k, c = cand_valid.shape
+    item_idx = np.arange(g)
+    chosen = np.full(g, -1, dtype=np.int32)
+    while True:
+        free_sel = free[cand_slice]  # (K, C, H)
+        feas = cand_valid & ~np.any(cand_mask & ~free_sel, axis=-1)  # (K, C)
+        free_cnt = free.sum(axis=-1, dtype=np.int32)[cand_slice]  # (K, C)
+        free_after = free_sel & ~cand_mask
+        pairs = np.sum(
+            free_after[..., :-1] & free_after[..., 1:], axis=-1, dtype=np.int32
+        )
+        score_val = (free_cnt * h + (h - pairs)) * h + origin_rank
+        score = np.where(feas, -score_val, _NEG)
+        order = np.argsort(-score, axis=-1, kind="stable")  # best-first
+        n_feas = feas.sum(axis=-1)
+
+        active_now = (chosen < 0) & item_active
+        onehot = np.zeros((g, k), dtype=np.int32)
+        onehot[item_idx, item_class] = active_now.astype(np.int32)
+        rank = (np.cumsum(onehot, axis=0) - onehot)[item_idx, item_class]
+        best = order[item_class, np.minimum(rank, c - 1)]
+        ok = active_now & (rank < n_feas[item_class])
+
+        # Conflict resolution: same exclusive-prefix semantics as the jit
+        # kernel's cumulative-OR, but walked over just the ok items — the
+        # (G, S, H) usage tensor + cumsum the XLA form materializes would
+        # dominate the whole solve at 10k-node scale.
+        bm = cand_mask[item_class, best]  # (G, H)
+        bs = cand_slice[item_class, best]
+        ok_idx = np.nonzero(ok)[0]
+        seen = np.zeros((s, h), dtype=bool)
+        committed = False
+        for gi in ok_idx:
+            row = bm[gi]
+            sl = bs[gi]
+            if (seen[sl] & row).any():
+                seen[sl] |= row  # a loser's cells still block later items
+                continue
+            seen[sl] |= row
+            chosen[gi] = best[gi]
+            free[sl] &= ~row
+            committed = True
+        if not committed:
+            return chosen
+
+
+def _solve_batch_python(free, cand_mask, cand_slice, cand_valid, origin_rank,
+                        item_class, item_active):
+    """Pure-Python reference arm of the same algorithm: plain loops, no
+    vectorization — the auditable oracle the kernel-equivalence property
+    tests compare both fast paths against, and the `solver_kernel=python`
+    escape hatch."""
+    s, h = free.shape
+    free = [[bool(v) for v in row] for row in free]
+    g = len(item_class)
+    k, c = cand_valid.shape
+    chosen = [-1] * g
+    while True:
+        order, n_feas, scores = [], [], []
+        for kk in range(k):
+            scored = []
+            feas_count = 0
+            for cc in range(c):
+                score = int(_NEG)
+                if cand_valid[kk, cc]:
+                    sl = int(cand_slice[kk, cc])
+                    mask = cand_mask[kk, cc]
+                    if not any(mask[hh] and not free[sl][hh] for hh in range(h)):
+                        free_cnt = sum(free[sl])
+                        after = [free[sl][hh] and not mask[hh] for hh in range(h)]
+                        pairs = sum(
+                            1 for hh in range(h - 1) if after[hh] and after[hh + 1]
+                        )
+                        score = -(
+                            (free_cnt * h + (h - pairs)) * h
+                            + int(origin_rank[kk, cc])
+                        )
+                        feas_count += 1
+                scored.append(score)
+            order.append(sorted(range(c), key=lambda i: (-scored[i], i)))
+            n_feas.append(feas_count)
+            scores.append(scored)
+
+        seen_class: Dict[int, int] = {}
+        picks = []  # (gi, best, ok)
+        for gi in range(g):
+            if chosen[gi] >= 0 or not item_active[gi]:
+                picks.append((gi, -1, False))
+                continue
+            kk = int(item_class[gi])
+            rank = seen_class.get(kk, 0)
+            seen_class[kk] = rank + 1
+            best = order[kk][min(rank, c - 1)]
+            picks.append((gi, best, rank < n_feas[kk]))
+
+        seen_cells: set = set()
+        committed = []
+        for gi, best, ok in picks:
+            if not ok:
+                continue
+            kk = int(item_class[gi])
+            sl = int(cand_slice[kk, best])
+            cells = {
+                (sl, hh) for hh in range(h) if cand_mask[kk, best][hh]
+            }
+            conflict = bool(cells & seen_cells)
+            seen_cells |= cells
+            if not conflict:
+                committed.append((gi, best, cells))
+        if not committed:
+            return np.array(chosen, dtype=np.int32)
+        for gi, best, cells in committed:
+            chosen[gi] = int(best)
+            for sl, hh in cells:
+                free[sl][hh] = False
+
+
+SOLVER_KERNELS = ("python", "numpy", "jax")
+
+# Process-wide epoch source for candidate-cache generations: requests (and
+# their _class_hint memos) can be handed to more than one packer (tests, A/B
+# benches), so epochs must never collide across instances.
+_cand_epoch_source = itertools.count(1)
+
+
 class TPUPacker:
     name = "tpu-packer"
 
@@ -159,9 +304,22 @@ class TPUPacker:
         default_expected_duration: float = 600.0,
         drain_reserve_seconds: float = 300.0,
         max_drain_fraction: float = 0.08,
+        kernel: str = "numpy",
     ) -> None:
         self.candidates = CandidateCache()
         self.last_solve_stats: Dict[str, float] = {}
+        # Scoring kernel (the solver_kernel knob). All three return
+        # identical placements (same algorithm; the equivalence is
+        # property-tested): "numpy" is the default fast path — no per-cycle
+        # dispatch/transfer cost at control-plane batch sizes; "jax" is the
+        # XLA-compiled opt-in (prewarmed, pow2-padded — wins when batches
+        # are huge or a device is pinned); "python" is the auditable
+        # reference arm.
+        if kernel not in SOLVER_KERNELS:
+            raise ValueError(
+                f"unknown solver kernel {kernel!r}; choose from {SOLVER_KERNELS}"
+            )
+        self.kernel = kernel
         # Queue discipline. The batch order is the kernel's conflict-
         # resolution priority (NOT a head-of-line gate: every item is
         # considered each round, order only decides who wins contested
@@ -202,8 +360,19 @@ class TPUPacker:
         # Candidate tensors cached across cycles: they depend only on the
         # slice inventory and the set of request classes, both of which are
         # stable between solves — rebuilding them in Python every cycle
-        # dominated solve wall time before the kernel even ran.
+        # dominated solve wall time before the kernel even ran. `_cand_epoch`
+        # versions the cache for the per-request class hints
+        # (GangRequest._class_hint): it moves only on a cache reset or a
+        # taint repair, so steady-state class resolution is one int compare.
         self._tensor_cache: Optional[Dict[str, object]] = None
+        self._cand_epoch = next(_cand_epoch_source)
+        # Generic (GPU/CPU) pool indexes cached by the same inventory
+        # generation: node list, resource-key layout, NVLink domains, taint
+        # columns. The drain path's slice-geometry index rides its own
+        # generation-keyed memo.
+        self._generic_cache: Optional[Dict[str, object]] = None
+        self._drain_geo_cache: Optional[Tuple] = None
+        self._host_pos_cache: Optional[Tuple] = None
         # The solver runs on the control plane's own device — host CPU by
         # default (the operator is a sidecar; the TPU fleet belongs to the
         # workloads, and remote-attached accelerators add per-call latency
@@ -232,8 +401,11 @@ class TPUPacker:
         XLA compiles the round loop once per shape signature; at burst time
         that compile would otherwise land inside the first scheduling cycle.
         Pins the padded-axis high-water marks to production scale and runs one
-        throwaway solve so every later cycle hits the jit cache.
+        throwaway solve so every later cycle hits the jit cache. The numpy
+        and python kernels have nothing to compile — prewarm is a no-op.
         """
+        if self.kernel != "jax":
+            return
         slices = list(snapshot.slices.values())
         if not slices:
             return
@@ -265,16 +437,23 @@ class TPUPacker:
         now: Optional[float] = None,
     ) -> Dict[str, Optional[Placement]]:
         out: Dict[str, Optional[Placement]] = {}
-        tpu_reqs = [r for r in requests if r.is_tpu()]
-        generic = [r for r in requests if not r.is_tpu()]
+        tpu_reqs = [r for r in requests if r.topology is not None]
+        generic = [r for r in requests if r.topology is None]
         if tpu_reqs:
             out.update(self._place_tpu_batch(tpu_reqs, snapshot, now))
         if generic:
             out.update(self._place_generic_batch(generic, snapshot, now))
         return out
 
-    def _order(self, requests: List[GangRequest], now: Optional[float], demand) -> List[GangRequest]:
-        """Batch priority order (= kernel conflict-resolution priority)."""
+    def _order(self, requests: List[GangRequest], now: Optional[float], demand,
+               charge_base: Optional[List[GangRequest]] = None) -> List[GangRequest]:
+        """Batch priority order (= kernel conflict-resolution priority).
+
+        `charge_base` (defaults to `requests`): the population the WSJF
+        missing-estimate median is computed over. The vectorized arms order
+        only the feasible subset but must charge estimate-less gangs from
+        the FULL batch, or the subset composition would shift tie-breaks
+        and the kernels would stop being placement-identical."""
         if self.discipline not in ("sjf-aging", "wsjf-aging") or now is None:
             return sorted(
                 requests, key=lambda r: r.group.metadata.creation_time or 0.0
@@ -288,7 +467,9 @@ class TPUPacker:
         missing_charge = self.default_expected_duration
         if weigh:
             declared = sorted(
-                r.expected_duration for r in requests if r.expected_duration
+                r.expected_duration
+                for r in (charge_base if charge_base is not None else requests)
+                if r.expected_duration
             )
             if declared:
                 missing_charge = declared[len(declared) // 2]
@@ -317,35 +498,130 @@ class TPUPacker:
             return ()
         return tuple(sorted(toleration_key(t) for t in node.taints))
 
-    def _cand_tensors(self, slices: List[SliceInfo], h_max: int, snapshot: ClusterSnapshot):
-        """Cached (class_ids, class_cands, device tensors) for this inventory.
+    @staticmethod
+    def _hosts_for(topology: Optional[str], chips_per_host: int) -> int:
+        """request_hosts_per_slice from the bare topology string (the class
+        key carries no GangRequest)."""
+        if topology is None:
+            return 0
+        from training_operator_tpu.cluster.inventory import parse_topology
 
-        Invalidated when the slice set OR any host's taints change; extended
-        in place when a new request class first appears. The packed/device
-        tensors are only rebuilt on those events — steady-state cycles reuse
-        them untouched. (Taints are part of the signature because class
-        candidates bake in taint feasibility — see _class_of.)
+        chips = 1
+        for d in parse_topology(topology):
+            chips *= d
+        if chips % chips_per_host:
+            return -1
+        return chips // chips_per_host
+
+    def _slice_candidates(
+        self,
+        sl: SliceInfo,
+        sidx: int,
+        h_max: int,
+        tpu_type: str,
+        topology: str,
+        pods_per_slice: int,
+        tolerations,
+        snapshot: ClusterSnapshot,
+    ) -> List[Tuple[int, np.ndarray, int]]:
+        """One slice's legal candidates for one request class (the unit the
+        in-place cache repair rebuilds when a node delta touches a slice)."""
+        if tpu_type and sl.tpu_type != tpu_type:
+            return []
+        need = self._hosts_for(topology, sl.chips_per_host)
+        if need <= 0 or need != pods_per_slice:
+            return []
+        masks, ranks = self.candidates.get_arrays(
+            sl.topology, sl.chips_per_host, topology, h_max
+        )
+        if masks is None or masks.shape[0] == 0:
+            return []
+        cset = self.candidates.get(sl.topology, sl.chips_per_host, topology)
+        if cset is None or cset.hosts_per_slice != sl.num_hosts:
+            return []
+        host_ok = np.ones(h_max, dtype=bool)
+        for h, n in enumerate(sl.host_nodes):
+            host_ok[h] = snapshot.tolerated(n, tolerations)
+        legal = ~np.any(masks & ~host_ok, axis=-1)
+        return [
+            (sidx, masks[c], int(ranks[c]))
+            for c in range(masks.shape[0])
+            if legal[c]
+        ]
+
+    def _cand_tensors(self, slices: List[SliceInfo], h_max: int, snapshot: ClusterSnapshot):
+        """Cached (class_ids, class_cands, packed tensors) for this inventory,
+        keyed by a PER-SLICE signature.
+
+        A taint delta on an existing slice set repairs the cache IN PLACE:
+        only the changed slices' candidate rows are re-enumerated (classes
+        reassembled in canonical slice-major order, so a repaired cache is
+        bit-identical to a fresh build), and negatively-cached classes are
+        re-opened. Only a slice-set or geometry change resets everything —
+        steady-state cycles reuse the packed tensors untouched. (Taints are
+        part of the signature because class candidates bake in taint
+        feasibility — see _class_of.)
         """
-        sig = tuple(
-            (
-                sl.slice_id,
-                sl.tpu_type,
-                sl.topology,
-                sl.chips_per_host,
-                tuple(sl.host_nodes),
-                tuple(self._node_taint_sig(snapshot, n) for n in sl.host_nodes),
-            )
+        cache = self._tensor_cache
+        # Inventory-generation fast path: an IncrementalSnapshot carries the
+        # maintainer's structural-change counter; when it hasn't moved, the
+        # cached tensors are current BY CONSTRUCTION and the per-slice
+        # signature walk below (O(hosts)) is skipped entirely.
+        gen = getattr(snapshot, "inventory_gen", None)
+        if cache is not None and gen is not None and cache.get("inv_gen") == gen:
+            return cache
+        ident = tuple(
+            (sl.slice_id, sl.tpu_type, sl.topology, sl.chips_per_host,
+             tuple(sl.host_nodes))
             for sl in slices
         )
-        cache = self._tensor_cache
-        if cache is None or cache["sig"] != sig:
+        taints = tuple(
+            tuple(self._node_taint_sig(snapshot, n) for n in sl.host_nodes)
+            for sl in slices
+        )
+        if cache is None or cache["ident"] != ident or cache["h_max"] != h_max:
+            self._cand_epoch = next(_cand_epoch_source)
             cache = self._tensor_cache = {
-                "sig": sig,
+                "ident": ident,
+                "taints": taints,
+                "h_max": h_max,
+                "inv_gen": gen,
+                "epoch": self._cand_epoch,
                 "class_ids": {},
+                "class_meta": [],  # per class: (tpu_type, topology, pps, tolerations)
                 "class_cands": [],
                 "dev": None,
                 "shape": None,
             }
+            return cache
+        cache["inv_gen"] = gen
+        if cache["taints"] != taints:
+            self._cand_epoch = next(_cand_epoch_source)
+            cache["epoch"] = self._cand_epoch
+            changed = {
+                i for i in range(len(slices))
+                if cache["taints"][i] != taints[i]
+            }
+            cache["taints"] = taints
+            # Negative results may have been taint-caused: re-open them.
+            cache["class_ids"] = {
+                key: idx for key, idx in cache["class_ids"].items()
+                if idx is not None
+            }
+            for idx, meta in enumerate(cache["class_meta"]):
+                tpu_type, topology, pps, tolerations = meta
+                by_slice: Dict[int, List[Tuple[int, np.ndarray, int]]] = {}
+                for sidx, m, rank in cache["class_cands"][idx]:
+                    by_slice.setdefault(sidx, []).append((sidx, m, rank))
+                for i in changed:
+                    by_slice[i] = self._slice_candidates(
+                        slices[i], i, h_max, tpu_type, topology, pps,
+                        tolerations, snapshot,
+                    )
+                cache["class_cands"][idx] = [
+                    c for i in range(len(slices)) for c in by_slice.get(i, [])
+                ]
+            cache["dev"] = None
         return cache
 
     def _class_of(
@@ -362,35 +638,26 @@ class TPUPacker:
         across ALL compatible slices, so one argmax ranges over every legal
         placement at once. Candidates touching hosts whose taints the class
         does not tolerate are dropped at build time (the cache signature
-        includes taints, so a taint change rebuilds)."""
+        includes taints, so a taint delta repairs the affected rows)."""
         class_ids: Dict[Tuple, Optional[int]] = cache["class_ids"]
         key = (req.tpu_type, req.topology, pods_per_slice, req.toleration_sig())
         if key in class_ids:
             return class_ids[key]
         cands: List[Tuple[int, np.ndarray, int]] = []
         for i, sl in enumerate(slices):
-            if req.tpu_type and sl.tpu_type != req.tpu_type:
-                continue
-            need = request_hosts_per_slice(req, sl.chips_per_host)
-            if need <= 0 or need != pods_per_slice:
-                continue
-            cset = self.candidates.get(sl.topology, sl.chips_per_host, req.topology)
-            if cset is None or cset.hosts_per_slice != sl.num_hosts:
-                continue
-            host_ok = [
-                snapshot.tolerated(n, req.tolerations) for n in sl.host_nodes
-            ]
-            for mask, rank in zip(cset.masks, cset.origin_rank):
-                if not all(ok for ok, used in zip(host_ok, mask) if used):
-                    continue  # intolerable host inside the sub-mesh
-                m = np.zeros(h_max, dtype=bool)
-                m[: len(mask)] = mask
-                cands.append((i, m, rank))
+            cands.extend(self._slice_candidates(
+                sl, i, h_max, req.tpu_type, req.topology, pods_per_slice,
+                req.tolerations, snapshot,
+            ))
         if not cands:
             class_ids[key] = None  # negative result cached too: a gang with
             return None  # no legal placement stays pending for many cycles
         class_ids[key] = len(cache["class_cands"])
         cache["class_cands"].append(cands)
+        cache["class_meta"].append(
+            (req.tpu_type, req.topology, pods_per_slice,
+             [dict(t) for t in req.tolerations])
+        )
         cache["dev"] = None  # packed tensors must pick up the new class
         return class_ids[key]
 
@@ -402,6 +669,7 @@ class TPUPacker:
         snapshot: ClusterSnapshot,
         now: Optional[float],
         out: Dict[str, Optional[Placement]],
+        hosts_counts: Optional[np.ndarray] = None,
     ) -> Tuple[np.ndarray, frozenset]:
         """Tail-latency mechanism for whole-slice gangs (see __init__).
         Returns (masked free copy, reserved slice indices); writes direct
@@ -425,25 +693,52 @@ class TPUPacker:
         """
         if now is None or self.drain_reserve_seconds <= 0:
             return free, frozenset()
+        # Slices share a handful of geometry classes: compute each starved
+        # gang's whole-slice compatibility ONCE per geometry, not once per
+        # slice (a 2500-slice pool made the per-slice form the dominant
+        # cost of the entire solve), and memoize both the geometry index
+        # and each gang's compat list by inventory generation.
+        gen = getattr(snapshot, "inventory_gen", None)
+        gc = self._drain_geo_cache
+        if gc is None or gen is None or gc[0] != gen:
+            geo_members: Dict[Tuple, List[int]] = {}
+            for i, sl in enumerate(slices):
+                geo_members.setdefault(
+                    (sl.tpu_type, sl.chips_per_host, sl.num_hosts), []
+                ).append(i)
+            gc = (gen, geo_members)
+            if gen is not None:
+                self._drain_geo_cache = gc
+        geo_members = gc[1]
         starved: List[Tuple[float, GangRequest, List[int]]] = []
+        threshold = now - self.drain_reserve_seconds
         for req in requests:
             created = req.group.metadata.creation_time or 0.0
-            if now - created < self.drain_reserve_seconds:
+            if created > threshold:
                 continue
-            if req.num_slices <= 0 or len(req.pods) % req.num_slices:
-                continue  # malformed gang: the kernel path skips it too
-            pps = len(req.pods) // req.num_slices
-            # Slices this gang could legally occupy WHOLE: tpu_type match,
-            # per-slice host need equal to the slice's host count, AND one
-            # pod per host (the same checks the kernel candidates apply —
-            # _class_of rejects need != pods_per_slice; without it the
-            # zip(pods, host_nodes) below would silently truncate).
-            compat = [
-                i for i, sl in enumerate(slices)
-                if (not req.tpu_type or sl.tpu_type == req.tpu_type)
-                and request_hosts_per_slice(req, sl.chips_per_host) == sl.num_hosts
-                and pps == sl.num_hosts
-            ]
+            hint = req.__dict__.get("_drain_hint")
+            if hint is not None and gen is not None and hint[0] == gen:
+                compat = hint[1]
+            else:
+                compat = None
+                if req.num_slices > 0 and not len(req.pods) % req.num_slices:
+                    pps = len(req.pods) // req.num_slices
+                    # Slices this gang could legally occupy WHOLE: tpu_type
+                    # match, per-slice host need equal to the slice's host
+                    # count, AND one pod per host (the same checks the
+                    # kernel candidates apply — _class_of rejects need !=
+                    # pods_per_slice; without it the zip(pods, host_nodes)
+                    # below would silently truncate).
+                    compat = []
+                    for (gtype, gchips, ghosts), members in geo_members.items():
+                        if req.tpu_type and gtype != req.tpu_type:
+                            continue
+                        if request_hosts_per_slice(req, gchips) == ghosts == pps:
+                            compat.extend(members)
+                    compat.sort()
+                    if not compat:
+                        compat = None
+                req.__dict__["_drain_hint"] = (gen, compat)
             if compat:
                 starved.append((created, req, compat))
         if not starved:
@@ -452,24 +747,45 @@ class TPUPacker:
             return free, frozenset()
         starved.sort(key=lambda t: t[0])
         free = free.copy()
-        avail = [
-            i for i, sl in enumerate(slices)
-            if bool(free[i, : sl.num_hosts].all())
+        if hosts_counts is not None:
+            # One vectorized pass instead of a small numpy call per slice.
+            avail = np.nonzero(
+                free.sum(axis=1) == hosts_counts
+            )[0].tolist()
+        else:
+            avail = [
+                i for i, sl in enumerate(slices)
+                if bool(free[i, : sl.num_hosts].all())
+            ]
+        # Taints are rare: precompute which slices carry any at all, so the
+        # per-(gang x slice) toleration walk only runs where one exists.
+        tainted_slice = [
+            any(
+                (n_obj := snapshot.nodes.get(n)) is not None and n_obj.taints
+                for n in sl.host_nodes
+            )
+            for sl in slices
         ]
         preassigned = 0
         accum_reserved: List[int] = []
         remaining: List[Tuple[GangRequest, List[int], int]] = []
         for _, req, compat in starved:
             k = req.num_slices
-            compat_set = set(compat)
-            usable = [
-                i for i in avail
-                if i in compat_set
-                and all(
-                    snapshot.tolerated(n, req.tolerations)
-                    for n in slices[i].host_nodes
-                )
-            ]
+            if avail:
+                compat_set = set(compat)
+                usable = [
+                    i for i in avail
+                    if i in compat_set
+                    and (
+                        not tainted_slice[i]
+                        or all(
+                            snapshot.tolerated(n, req.tolerations)
+                            for n in slices[i].host_nodes
+                        )
+                    )
+                ]
+            else:
+                usable = []  # nothing fully free: straight to reserve math
             if len(usable) < k:
                 # ACCUMULATE: reserve this gang's already-free compatible
                 # slices so the small-gang backfill can't re-fragment them
@@ -565,7 +881,11 @@ class TPUPacker:
         snapshot: ClusterSnapshot,
         now: Optional[float] = None,
     ) -> Dict[str, Optional[Placement]]:
-        slices = list(snapshot.slices.values())
+        # Canonical slice order (by id): candidate enumeration — and with it
+        # every score tie-break — must not depend on snapshot dict insertion
+        # order, or the incremental and cold-walk snapshots could disagree
+        # about otherwise-equal placements.
+        slices = sorted(snapshot.slices.values(), key=lambda sl: sl.slice_id)
         out: Dict[str, Optional[Placement]] = {r.key: None for r in requests}
         if not slices:
             return out
@@ -575,42 +895,96 @@ class TPUPacker:
         assert h_max <= 512, f"slice host count {h_max} overflows the solver score packing"
         cache = self._cand_tensors(slices, h_max, snapshot)
         class_cands: List[List[Tuple[int, np.ndarray, int]]] = cache["class_cands"]
-        class_ids: Dict[Tuple, Optional[int]] = cache["class_ids"]
 
         free = np.zeros((len(slices), h_max), dtype=bool)
-        for i, sl in enumerate(slices):
-            for h, node in enumerate(sl.host_nodes):
-                free[i, h] = snapshot.host_free(node, sl.chips_per_host)
+        flags = getattr(snapshot, "host_full_free", None)
+        hosts_counts = None
+        if flags is not None:
+            # Incremental snapshot: the maintainer already tracks each TPU
+            # host's full-block-free flag — the matrix fill is one dict read
+            # per host plus a single fancy-index store (position layout
+            # cached by inventory generation). The flags reflect the BASE
+            # state, so this cycle's own commits (earlier arbiter tiers,
+            # drain preassigns) are re-applied from the snapshot's
+            # copy-on-write overlay — O(committed).
+            gen = getattr(snapshot, "inventory_gen", None)
+            pos = self._host_pos_cache
+            if pos is None or pos[0] != (gen, h_max):
+                posmap: Dict[str, Tuple[int, int, int]] = {}
+                flat_nodes: List[str] = []
+                flat_idx: List[int] = []
+                for i, sl in enumerate(slices):
+                    for h, node in enumerate(sl.host_nodes):
+                        posmap[node] = (i, h, sl.chips_per_host)
+                        flat_nodes.append(node)
+                        flat_idx.append(i * h_max + h)
+                pos = (
+                    (gen, h_max), posmap, flat_nodes,
+                    np.asarray(flat_idx, dtype=np.int64),
+                    np.asarray([sl.num_hosts for sl in slices], dtype=np.int64),
+                )
+                self._host_pos_cache = pos
+            _, posmap, flat_nodes, flat_idx, hosts_counts = pos
+            free.reshape(-1)[flat_idx] = [
+                flags.get(n, False) for n in flat_nodes
+            ]
+            overlay = getattr(snapshot, "_overlay", None)
+            if overlay:
+                for node, avail in overlay.items():
+                    at = posmap.get(node)
+                    if at is not None:
+                        free[at[0], at[1]] = (
+                            avail.get(TPU_RESOURCE, 0.0) >= at[2]
+                        )
+        else:
+            free_map = snapshot.free
+            for i, sl in enumerate(slices):
+                chips = sl.chips_per_host
+                for h, node in enumerate(sl.host_nodes):
+                    avail = free_map.get(node)
+                    free[i, h] = (
+                        avail is not None
+                        and avail.get(TPU_RESOURCE, 0.0) >= chips
+                    )
         free, drain_reserved = self._drain_and_preassign(
-            requests, slices, free, snapshot, now, out
+            requests, slices, free, snapshot, now, out,
+            hosts_counts=hosts_counts,
         )
 
-        # Expand to per-slice sub-items in priority order (see _order; the
-        # order is conflict-resolution priority, not a gate — small gangs
-        # backfill around larger ones either way). NOT first-fit-decreasing:
-        # under saturation every cycle's free capacity would go to the
-        # biggest pending gangs, re-ordering the whole queue by size and
-        # inflating median schedule latency (measured: +70% p50 on the 1k
-        # burst). Fragmentation control comes from the best-fit scoring.
-        ordered = self._order(requests, now, lambda r: r.total_chips())
-        items: List[Tuple[GangRequest, int, int]] = []  # (req, sub_index, class)
-        for req in ordered:
+        # Class resolution with warm hints: a memoized request carries its
+        # (cache epoch, class id) from the last cycle, so steady-state
+        # resolution is one tuple compare per gang — no key building, no
+        # toleration signatures.
+        epoch = cache["epoch"]
+        classed: List[GangRequest] = []
+        for req in requests:
             if out.get(req.key) is not None:
                 continue  # pre-assigned by the drain path above
-            pods = req.sorted_pods()
-            if req.num_slices <= 0 or len(pods) % req.num_slices:
-                continue
-            pods_per_slice = len(pods) // req.num_slices
-            k = self._class_of(cache, slices, h_max, req, pods_per_slice, snapshot)
-            if k is None:
-                continue
-            for sub in range(req.num_slices):
-                items.append((req, sub, k))
-        if not items:
+            hint = req._class_hint
+            if hint is not None and hint[0] == epoch:
+                k = hint[1]
+            else:
+                if req.num_slices <= 0 or len(req.pods) % req.num_slices:
+                    req._class_hint = (epoch, None)
+                    continue
+                pods_per_slice = len(req.pods) // req.num_slices
+                k = self._class_of(
+                    cache, slices, h_max, req, pods_per_slice, snapshot
+                )
+                req._class_hint = (epoch, k)
+            if k is not None:
+                classed.append(req)
+        if not classed:
             return out
 
-        k_count = self._pad("K", len(class_cands))
-        c_max = self._pad("C", max(len(c) for c in class_cands))
+        if self.kernel == "jax":
+            # pow2 padding so XLA compiles once per high-water shape.
+            k_count = self._pad("K", len(class_cands))
+            c_max = self._pad("C", max(len(c) for c in class_cands))
+        else:
+            # numpy/python recompile nothing: exact shapes, no padding.
+            k_count = len(class_cands)
+            c_max = max(1, max((len(c) for c in class_cands), default=1))
         if cache["dev"] is None or cache["shape"] != (k_count, c_max, h_max):
             cand_mask = np.zeros((k_count, c_max, h_max), dtype=bool)
             cand_slice = np.zeros((k_count, c_max), dtype=np.int32)
@@ -623,31 +997,108 @@ class TPUPacker:
                     cand_valid[k, c] = True
                     origin_rank[k, c] = rank
             dev = (cand_mask, cand_slice, cand_valid, origin_rank)
-            if self.solver_device is not None:
+            if self.kernel == "jax" and self.solver_device is not None:
                 dev = tuple(jax.device_put(a, self.solver_device) for a in dev)
             cache["dev"] = dev
             cache["shape"] = (k_count, c_max, h_max)
 
-        g_max = self._pad("G", len(items))
+        # Saturation fast path (the vectorized arms): one (K, C) feasibility
+        # pass against this cycle's free state — a class with ZERO feasible
+        # candidates cannot admit anything this cycle (round 1 of the kernel
+        # would prove the same, after paying per-gang batch prep), so its
+        # gangs keep their None verdict for the cost of an array lookup.
+        # Under saturation this is most of the pending queue, which is what
+        # makes the steady-state cycle O(changed), not O(pending).
+        n_feas = None
+        if self.kernel != "jax":
+            cm, cs, cv, _ = cache["dev"]
+            feas_cls = cv & ~np.any(cm & ~free[cs], axis=-1)
+            n_feas = feas_cls.sum(axis=-1).tolist()
+            classed = [r for r in classed if n_feas[r._class_hint[1]] > 0]
+            if not classed:
+                self.last_solve_stats = {
+                    "batch_items": 0.0,
+                    "classes": float(k_count),
+                    "candidates": float(c_max),
+                    "kernel": self.kernel,
+                }
+                return out
+
+        # Expand to per-slice sub-items in priority order (see _order; the
+        # order is conflict-resolution priority, not a gate — small gangs
+        # backfill around larger ones either way). NOT first-fit-decreasing:
+        # under saturation every cycle's free capacity would go to the
+        # biggest pending gangs, re-ordering the whole queue by size and
+        # inflating median schedule latency (measured: +70% p50 on the 1k
+        # burst). Fragmentation control comes from the best-fit scoring.
+        # The jax arm orders the FULL request list (the pinned pre-PR
+        # behavior); the vectorized arms order the feasible subset but
+        # charge the WSJF median from the full list, so kernel choice can
+        # never change a tie-break.
+        ordered = self._order(
+            requests if self.kernel == "jax" else classed,
+            now, lambda r: r.total_chips(), charge_base=requests,
+        )
+        # Per-class admission cap (vectorized arms): the kernel can commit
+        # at most n_feas_initial[k] items of class k — an item whose batch
+        # position within its class is already past that bound can NEVER
+        # commit (each same-class commit consumes >= 1 feasible candidate),
+        # so gangs entirely past the bound are dropped with IDENTICAL
+        # output. A gang straddling the bound stays whole (its trailing
+        # subs are harmless), preserving exact batch parity. This bounds
+        # kernel + stitch work by admissible capacity, not queue depth.
+        budget = dict(enumerate(n_feas)) if n_feas is not None else None
+        items: List[Tuple[GangRequest, int, int]] = []  # (req, sub_index, class)
+        for req in ordered:
+            hint = req._class_hint
+            if (
+                out.get(req.key) is not None
+                or hint is None or hint[0] != epoch or hint[1] is None
+            ):
+                continue
+            k = hint[1]
+            if budget is not None:
+                left = budget[k]
+                if left <= 0:
+                    continue
+                budget[k] = left - req.num_slices
+            for sub in range(req.num_slices):
+                items.append((req, sub, k))
+        if not items:
+            return out
+
+        g_max = self._pad("G", len(items)) if self.kernel == "jax" else len(items)
         item_class = np.zeros(g_max, dtype=np.int32)
         item_active = np.zeros(g_max, dtype=bool)
         for g, (_, _, k) in enumerate(items):
             item_class[g] = k
             item_active[g] = True
 
-        per_cycle = (free, item_class, item_active)
-        if self.solver_device is not None:
-            per_cycle = tuple(jax.device_put(a, self.solver_device) for a in per_cycle)
-        free_d, item_class_d, item_active_d = per_cycle
-        chosen = np.asarray(
-            _solve_batch(free_d, *cache["dev"], item_class_d, item_active_d)
-        )
+        if self.kernel == "jax":
+            per_cycle = (free, item_class, item_active)
+            if self.solver_device is not None:
+                per_cycle = tuple(
+                    jax.device_put(a, self.solver_device) for a in per_cycle
+                )
+            free_d, item_class_d, item_active_d = per_cycle
+            chosen = np.asarray(
+                _solve_batch(free_d, *cache["dev"], item_class_d, item_active_d)
+            )
+        elif self.kernel == "numpy":
+            chosen = _solve_batch_numpy(
+                free, *cache["dev"], item_class, item_active
+            )
+        else:
+            chosen = _solve_batch_python(
+                free, *cache["dev"], item_class, item_active
+            )
         ok = chosen >= 0
         choice = np.maximum(chosen, 0)
         self.last_solve_stats = {
             "batch_items": float(len(items)),
             "classes": float(k_count),
             "candidates": float(c_max),
+            "kernel": self.kernel,
         }
 
         # Stitch sub-item results back into whole-gang placements.
@@ -675,7 +1126,7 @@ class TPUPacker:
             subs = sorted(partial[req.key])
             pods = req.sorted_pods()
             pods_per_slice = len(pods) // req.num_slices
-            k = class_ids[(req.tpu_type, req.topology, pods_per_slice, req.toleration_sig())]
+            k = req._class_hint[1]
 
             # Distinct-slice constraint: each sub-request owns its own
             # physical slice (inter-slice traffic rides DCN; two sub-meshes
@@ -759,46 +1210,140 @@ class TPUPacker:
         now: Optional[float] = None,
     ) -> Dict[str, Optional[Placement]]:
         out: Dict[str, Optional[Placement]] = {}
-        node_names = [
-            n for n in snapshot.free
-            if snapshot.nodes[n].accelerator.kind != "tpu"
-        ]
+        # Pool indexes (node list, resource layout, NVLink domains, taint
+        # columns) depend only on the structural inventory: reuse them by
+        # generation when the snapshot carries one (see SnapshotMaintainer).
+        gen = getattr(snapshot, "inventory_gen", None)
+        gc = self._generic_cache
+        if gc is None or gen is None or gc["gen"] != gen:
+            node_names = [
+                n for n in snapshot.free
+                if snapshot.nodes[n].accelerator.kind != "tpu"
+            ]
+            res_keys = sorted({k for n in node_names for k in snapshot.free[n]})
+            ridx = {k: i for i, k in enumerate(res_keys)}
+            domains = np.array(
+                [
+                    hash(snapshot.nodes[n].accelerator.nvlink_domain or n) % (2**31)
+                    for n in node_names
+                ],
+                dtype=np.int64,
+            )
+            tainted_cols = [
+                i for i, n in enumerate(node_names) if snapshot.nodes[n].taints
+            ]
+            gc = {
+                "gen": gen, "node_names": node_names, "res_keys": res_keys,
+                "ridx": ridx, "domains": domains, "tainted_cols": tainted_cols,
+            }
+            if gen is not None:
+                self._generic_cache = gc
+        node_names = gc["node_names"]
+        res_keys, ridx = gc["res_keys"], gc["ridx"]
+        domains, tainted_cols = gc["domains"], gc["tainted_cols"]
         if not node_names:
             # No non-TPU node exists: generic gangs stay pending rather than
             # invisibly consuming TPU-host capacity out from under the TPU
             # gang solve.
             return {r.key: None for r in requests}
-        res_keys = sorted({k for n in node_names for k in snapshot.free[n]})
-        ridx = {k: i for i, k in enumerate(res_keys)}
-        free = np.zeros((len(node_names), len(res_keys)))
-        for i, n in enumerate(node_names):
-            for k, v in snapshot.free[n].items():
-                free[i, ridx[k]] = v
-        domains = np.array(
-            [
-                hash(snapshot.nodes[n].accelerator.nvlink_domain or n) % (2**31)
-                for n in node_names
-            ],
-            dtype=np.int64,
-        )
+        # One pass over the pool builds the saturation filters (per-resource
+        # best-node and aggregate free) WITHOUT materializing the node
+        # matrix; the matrix and the placement loop below only run for
+        # gangs that pass — in a saturated pool that is usually nobody.
+        nres = len(res_keys)
+        free_max = [0.0] * nres
+        free_tot = [0.0] * nres
+        free_src = snapshot.free
+        for n in node_names:
+            avail = free_src.get(n)
+            if avail is None:
+                continue
+            for k, v in avail.items():
+                idx = ridx.get(k)
+                if idx is not None:
+                    free_tot[idx] += v
+                    if v > free_max[idx]:
+                        free_max[idx] = v
 
         from training_operator_tpu.cluster.inventory import GPU_RESOURCE
 
         def demand(r: GangRequest) -> float:
             # GPUs are the contended generic resource; CPU demand breaks ties
             # at a ~node granularity so pure-CPU gangs still order sensibly.
-            return sum(
-                p.resources.get(GPU_RESOURCE, 0.0) + p.resources.get("cpu", 0.0) / 64.0
-                for p in r.pods
-            )
+            # Memoized on the (long-lived) request: re-summed once, not once
+            # per cycle.
+            d = r.__dict__.get("_generic_demand")
+            if d is None:
+                d = sum(
+                    p.resources.get(GPU_RESOURCE, 0.0)
+                    + p.resources.get("cpu", 0.0) / 64.0
+                    for p in r.pods
+                )
+                r.__dict__["_generic_demand"] = d
+            return d
 
-        # Taints are rare; only tainted node columns pay per-pod matching.
-        tainted_cols = [
-            i for i, n in enumerate(node_names) if snapshot.nodes[n].taints
-        ]
+        # Two necessary conditions per gang, a handful of float compares
+        # each (memoized per pool layout): the largest single-pod ask must
+        # fit SOME node, and the gang's total ask must fit the pool's
+        # aggregate free. In a saturated pool this answers "no" for almost
+        # every pending gang without ordering, matrix building, or the
+        # placement loop.
+        layout_key = tuple(res_keys)
+        survivors: List[GangRequest] = []
+        for req in requests:
+            hint = req._generic_hint
+            if hint is None or hint[0] != layout_key:
+                vec: Optional[List[float]] = [0.0] * nres
+                tot: Optional[List[float]] = [0.0] * nres
+                for pod in req.pods:
+                    for k, v in pod.resources.items():
+                        idx = ridx.get(k)
+                        if idx is None:
+                            if v > 0:
+                                vec = tot = None  # unsatisfiable resource
+                                break
+                        else:
+                            tot[idx] += v
+                            if v > vec[idx]:
+                                vec[idx] = v
+                    if vec is None:
+                        break
+                req._generic_hint = hint = (layout_key, vec, tot)
+            maxvec, totvec = hint[1], hint[2]
+            if maxvec is None or any(
+                m > fm + 1e-9 or t > ft + 1e-9
+                for m, fm, t, ft in zip(maxvec, free_max, totvec, free_tot)
+            ):
+                out[req.key] = None
+            else:
+                survivors.append(req)
+        if not survivors:
+            return out
 
-        ordered = self._order(requests, now, demand)
+        free = np.zeros((len(node_names), nres))
+        for i, n in enumerate(node_names):
+            avail = free_src.get(n)
+            if avail is None:
+                continue
+            for k, v in avail.items():
+                idx = ridx.get(k)
+                if idx is not None:
+                    free[i, idx] = v
+
+        # Taints are rare; only tainted node columns pay per-pod matching
+        # (the column list rides the generation-keyed pool cache above).
+        ordered = self._order(survivors, now, demand, charge_base=requests)
         for req in ordered:
+            # Re-check the two necessary conditions against the free state
+            # as EARLIER admissions in this same cycle consumed it — a
+            # survivor that no longer fits skips the placement loop.
+            maxvec, totvec = req._generic_hint[1], req._generic_hint[2]
+            if any(
+                m > fm + 1e-9 or tv > ft + 1e-9
+                for m, fm, tv, ft in zip(maxvec, free_max, totvec, free_tot)
+            ):
+                out[req.key] = None
+                continue
             # Pods with identical (resources, tolerations) — the common case:
             # a gang of k equal workers — are placed as ONE vectorized group:
             # per-node fit counts, then greedy take in best-fit score order.
@@ -866,6 +1411,10 @@ class TPUPacker:
                 for pod in req.pods:
                     snapshot.commit(pod.resources, assignments[pod.name])
                 out[req.key] = Placement(assignments=assignments)
+                # The admission consumed capacity: refresh the filter
+                # vectors so later survivors are screened against reality.
+                free_max = free.max(axis=0).tolist()
+                free_tot = free.sum(axis=0).tolist()
             else:
                 for rv, i, cnt in committed:
                     free[i] += rv * cnt

@@ -185,6 +185,13 @@ class TenancyArbiter:
         self.now = now_fn
         self.starvation_seconds = starvation_seconds
         self.max_preemptions = max_preemptions
+        # One tenancy load per solve cycle: arbitrate() refreshes it and the
+        # preemption planner (which runs later in the SAME single-threaded
+        # cycle) reuses it, so admission and victim selection can never read
+        # two different quota/class catalogs within one cycle.
+        self._cycle_load: Optional[
+            Tuple[Dict[str, ClusterQueue], Dict[str, PriorityClass]]
+        ] = None
 
     # -- store views ---------------------------------------------------
 
@@ -201,8 +208,16 @@ class TenancyArbiter:
         """Order + quota-filter one cycle's pending GangRequests. `groups`
         is the gang scheduler's full PodGroup view (admitted usage is
         derived from it); requests not in the result's tiers are in
-        `blocked` and stay Pending."""
+        `blocked` and stay Pending.
+
+        Incremental solving hands a DIRTY SUBSET as `requests` — the quota
+        gate still admits against the full admitted usage (from `groups`),
+        and tiers with no dirty members simply produce no placer call; a
+        capacity-freeing event always escalates the scheduler back to the
+        full pending set, so a freed window re-opens lower tiers in the
+        same arbiter order as before."""
         queues, classes = self._load()
+        self._cycle_load = (queues, classes)
         usage = admitted_usage(groups, queues)
         out = Arbitration()
 
@@ -480,7 +495,9 @@ class TenancyArbiter:
         any lower tier can backfill it."""
         if not unplaced:
             return []
-        queues, classes = self._load()
+        # Same-cycle catalog: set by this cycle's arbitrate(). Fresh load
+        # only when the planner is driven standalone (tests, tools).
+        queues, classes = self._cycle_load or self._load()
         groups = list(groups)
         usage = admitted_usage(groups, queues)
         admitted = [pg for pg in groups if pg.phase in ADMITTED_PHASES]

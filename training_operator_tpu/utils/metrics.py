@@ -682,6 +682,32 @@ queue_borrowed_chips = registry.gauge(
     "Admitted chips beyond the queue's nominal quota (borrowed from idle capacity)",
     ("queue",),
 )
+# Incremental gang solver (scheduler/gang.py + scheduler/snapshot.py
+# SnapshotMaintainer): the O(changed) solve-cycle plane. cycles_total counts
+# every solver invocation; incremental_cycles_total the subset that solved
+# only dirty groups (the ratio is the warm-start hit rate);
+# groups_resolved_total the gangs actually handed to the placer (vs
+# pending x cycles under the legacy full re-solve); snapshot_rebuilds_total
+# the full walks the incremental snapshot performed (initial prime +
+# selfcheck-mismatch adoptions — steady state is the prime alone). The
+# solver wall histogram is training_operator_scheduler_solve_seconds above.
+solver_cycles = registry.counter(
+    "training_solver_cycles_total",
+    "Gang solve cycles executed (any mode)", (),
+)
+solver_incremental_cycles = registry.counter(
+    "training_solver_incremental_cycles_total",
+    "Gang solve cycles that re-solved only the dirty-group subset", (),
+)
+solver_groups_resolved = registry.counter(
+    "training_solver_groups_resolved_total",
+    "GangRequests handed to the placer across all solve cycles", (),
+)
+solver_snapshot_rebuilds = registry.counter(
+    "training_solver_snapshot_rebuilds_total",
+    "Full from-scratch rebuilds of the incremental cluster snapshot "
+    "(initial prime + selfcheck-mismatch adoptions)", (),
+)
 gang_preemptions = registry.counter(
     "training_preemptions_total",
     "Gangs preempted (checkpointed + evicted + requeued) by the fair-share arbiter, "
