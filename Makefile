@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast test-wire test-chaos test-fleet test-tenancy test-failover lint bench bench-quick bench-solver bench-wire bench-wire-v2 bench-wire-resume bench-observe bench-audit bench-node-chaos bench-tenancy bench-failover dryrun operator-demo ha-demo native clean
+.PHONY: test test-fast test-wire test-chaos test-fleet test-tenancy test-failover soak-smoke lint bench bench-quick bench-solver bench-wire bench-wire-v2 bench-wire-resume bench-observe bench-audit bench-node-chaos bench-tenancy bench-failover bench-soak dryrun operator-demo ha-demo native clean
 
 test:            ## full suite (no hardware needed; ~10 min)
 	$(PY) -m pytest tests/ -q
@@ -34,6 +34,14 @@ test-tenancy:    ## the multi-tenancy lane: quotas, priority, fair share, preemp
 # crash-window store tests — no OS-process spawning, kept out of `slow`.
 test-failover:   ## control-plane failover lane (WAL standby, HostChaos, crash-safe store)
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_failover.py tests/test_store.py -q
+
+# The soak smoke tier: a compressed hour of fleet life with ALL FIVE chaos
+# tiers live at once + one host failover, under the fail-fast INV001-INV009
+# auditor, plus the single-seed replay pin and the bounded-growth/INV009
+# unit tests. Part of the default `test`/`test-fast` flow (tests/test_soak.py
+# is collected there); this lane runs it standalone.
+soak-smoke:      ## compressed-hour five-tier soak smoke (~90s, `not slow`)
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_soak.py -q -m "not slow"
 
 lint:            ## project code lint: AST discipline rules + ruff (if present)
 	$(PY) -m training_operator_tpu.analysis.codelint training_operator_tpu
@@ -135,6 +143,13 @@ bench-node-chaos:  ## node-loss MTTR block (one JSON line)
 # nonzero step, restart budget untouched).
 bench-tenancy:   ## contention fairness A/B block -> BENCH_SELF_TENANCY artifact
 	JAX_PLATFORMS=cpu $(PY) bench.py --tenancy-only
+
+# The full soak artifact: a simulated WEEK at 10k nodes (compression 4x ->
+# 42 sim-hours of virtual clock), sustained heavy-tailed arrivals into
+# oversubscribed queues, five chaos tiers + rolling maintenance + one
+# mid-soak host failover, fail-fast auditing. Expect ~20-40 min of wall.
+bench-soak:      ## simulated-week fleet soak -> BENCH_SELF_SOAK_r14.json
+	JAX_PLATFORMS=cpu $(PY) bench.py --soak-only
 
 native:          ## force-rebuild the C++ data-path core (drops the hash cache)
 	$(PY) -c "from training_operator_tpu import native; import glob, os; \

@@ -305,3 +305,87 @@ class TestReplicationKnobs:
             "http://127.0.0.1:1001", "http://127.0.0.1:1002"
         ]
         assert remote.base_url == "http://127.0.0.1:1001"
+
+
+class TestSoakKnobs:
+    """PR 14 satellite: the soak_* knobs ride the same flag ->
+    OperatorConfig -> SoakConfig.from_operator_config path the harness
+    consumes (bench.py --soak-only and the soak test tiers)."""
+
+    def test_cli_flags_reach_soak_config(self):
+        from training_operator_tpu.soak import SoakConfig
+
+        args = parse_args([
+            "--soak-hours", "48",
+            "--soak-arrival-per-minute", "3.5",
+            "--soak-compression", "8",
+            "--soak-chaos", "pod=2,api=0.5,wire=0,node=1.5,host=0",
+            "--soak-seed", "99",
+        ])
+        cfg = build_config(args)
+        assert cfg.soak_hours == 48.0
+        assert cfg.soak_arrival_per_minute == 3.5
+        assert cfg.soak_compression == 8.0
+        assert cfg.soak_seed == 99
+        sc = SoakConfig.from_operator_config(cfg)
+        assert sc.sim_hours == 48.0
+        assert sc.arrival_per_minute == 3.5
+        assert sc.compression == 8.0
+        assert sc.seed == 99
+        assert sc.chaos == {
+            "pod": 2.0, "api": 0.5, "wire": 0.0, "node": 1.5, "host": 0.0,
+        }
+        # Compression maps fleet seconds onto sim seconds and back.
+        assert sc.sim(3600.0) == 450.0
+        assert sc.fleet(450.0) == 3600.0
+        assert sc.sim_seconds == 48 * 3600.0 / 8.0
+
+    def test_config_file_round_trip(self, tmp_path):
+        path = tmp_path / "op.json"
+        path.write_text(json.dumps({
+            "soak_hours": 12.0,
+            "soak_arrival_per_minute": 1.25,
+            "soak_compression": 2.0,
+            "soak_chaos": "pod=0,api=0,wire=0,node=0,host=0",
+            "soak_seed": 7,
+        }))
+        cfg = build_config(parse_args(["--config", str(path)]))
+        assert cfg.soak_hours == 12.0
+        assert cfg.soak_arrival_per_minute == 1.25
+        assert cfg.soak_compression == 2.0
+        assert cfg.soak_seed == 7
+        # CLI overrides the file (the standard precedence).
+        cfg = build_config(parse_args(
+            ["--config", str(path), "--soak-hours", "24"]))
+        assert cfg.soak_hours == 24.0
+
+    def test_chaos_spec_parsing(self):
+        from training_operator_tpu.config import parse_chaos_intensity
+
+        # Unnamed tiers default to 1.0; named ones scale.
+        assert parse_chaos_intensity("pod=2")["pod"] == 2.0
+        assert parse_chaos_intensity("pod=2")["node"] == 1.0
+        assert parse_chaos_intensity("")["host"] == 1.0
+        with pytest.raises(ValueError):
+            parse_chaos_intensity("warp=1")
+        with pytest.raises(ValueError):
+            parse_chaos_intensity("pod=-0.5")
+
+    def test_validation_bounds(self):
+        with pytest.raises(ValueError):
+            OperatorConfig(soak_hours=0.0).validate()
+        with pytest.raises(ValueError):
+            OperatorConfig(soak_arrival_per_minute=0.0).validate()
+        with pytest.raises(ValueError):
+            OperatorConfig(soak_compression=0.0).validate()
+        with pytest.raises(ValueError):
+            OperatorConfig(soak_chaos="bogus=1").validate()
+
+    def test_defaults_are_the_week_shape(self):
+        from training_operator_tpu.soak import SoakConfig
+
+        cfg = OperatorConfig()
+        assert cfg.soak_hours == 168.0
+        sc = SoakConfig.from_operator_config(cfg)
+        assert sc.sim_hours == 168.0
+        assert all(v == 1.0 for v in sc.chaos.values())

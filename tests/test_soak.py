@@ -1,0 +1,315 @@
+"""Time-compressed fleet soak (PR 14): the smoke tier (a compressed hour
+with all five chaos tiers live + one host failover, under the fail-fast
+auditor), the single-seed replay pin, the bounded-growth/INV009 plane
+(event-store cap, accumulator rule, expired-expectation cleanup, orphan
+sweep), and the `slow`-marked compressed-day run at the 10k-node scale."""
+
+from __future__ import annotations
+
+import pytest
+
+from training_operator_tpu.api.jobs import JAXJob, ObjectMeta
+from training_operator_tpu.api.common import (
+    Container,
+    JOB_KIND_LABEL,
+    JOB_NAME_LABEL,
+    PodTemplateSpec,
+    ReplicaSpec,
+)
+from training_operator_tpu.cluster.apiserver import APIServer
+from training_operator_tpu.cluster.objects import Event, Pod
+from training_operator_tpu.cluster.runtime import Cluster, VirtualClock
+from training_operator_tpu.observe.invariants import (
+    FleetSources,
+    InvariantAuditor,
+)
+from training_operator_tpu.soak import SoakConfig, SoakHarness, derive_seed
+
+# Chaos intensities cranked so every tier provably fires inside a
+# compressed hour (base cadences are sized for a week): pod kills every
+# ~10 sim-min, node tier (kills + slice kills + maintenance) every ~20.
+SMOKE_CHAOS = {"pod": 12.0, "api": 1.5, "wire": 1.0, "node": 18.0, "host": 1.0}
+
+
+def smoke_config(**overrides) -> SoakConfig:
+    base = dict(
+        sim_hours=1.0,
+        arrival_per_minute=6.0,
+        compression=1.0,
+        chaos=dict(SMOKE_CHAOS),
+        seed=14,
+        tpu_slices=8,
+        cpu_nodes=4,
+        cpu_per_node=16.0,
+        epoch_seconds=600.0,
+        heartbeat_seconds=60.0,
+        grace_seconds=150.0,
+        toleration_seconds=60.0,
+        recover_seconds=400.0,
+        audit_seconds=120.0,
+        resync_seconds=300.0,
+        resolve_seconds=60.0,
+        min_solve_seconds=5.0,
+        job_ttl_seconds=600.0,
+        compact_check_seconds=60.0,
+        drain_hours=2.0,
+        team_quota_chips=24.0,
+        prod_quota_chips=32.0,
+        slo_p50_ttr_s=1800.0,
+        slo_high_p99_ttr_s=3600.0,
+        max_wall_seconds=180.0,
+    )
+    base.update(overrides)
+    return SoakConfig(**base)
+
+
+class TestSoakSmoke:
+    def test_compressed_hour_all_five_tiers(self, tmp_path):
+        """The smoke soak: a compressed hour of fleet life with every
+        chaos tier live at once and a mid-soak host failover, under the
+        fail-fast INV001-INV009 auditor. Any invariant violation raises
+        out of the run and fails this test with the replayable seed in
+        the config."""
+        h = SoakHarness(smoke_config(), str(tmp_path))
+        report = h.run()
+
+        jobs = report["jobs"]
+        assert jobs["completed"] == jobs["submitted"] > 100
+        assert jobs["failed"] == 0, report["jobs"]
+        # No vacuous pass: every tier actually struck.
+        counts = report["chaos"]
+        assert counts.get("pod:kill", 0) > 0, counts
+        assert counts.get("node:kill", 0) > 0, counts
+        assert counts.get("node:maintenance_begin", 0) > 0, counts
+        assert counts.get("host:failover", 0) == 1, counts
+        assert sum(report["wire"]["injected"].values()) > 0
+        assert report["api_chaos_conflicts"] > 0
+        # The auditor lived through the storm and stayed green.
+        assert report["auditor"]["audits"] > 10
+        assert report["auditor"]["violations"] == 0
+        # The failover recovered with byte-level replication parity.
+        fo = report["failover"]
+        assert fo is not None and fo["replication_parity"]
+        assert fo["wal_records_replicated"] > 0
+        # Bounded growth held over the whole run.
+        for name, g in report["growth"].items():
+            if isinstance(g, dict):
+                assert g["within"], (name, g)
+        # The mix exercised every workload kind, including v2.
+        assert set(jobs["by_kind"]) >= {
+            "jax-sub", "jax-host", "jax-full", "mpi", "cpu", "v2",
+        }
+
+    def test_disruptions_recover(self, tmp_path):
+        """Node/pod kills and maintenance drains open MTTR records and the
+        records close: nothing disrupted is left dangling un-recovered."""
+        h = SoakHarness(smoke_config(), str(tmp_path))
+        report = h.run()
+        outcomes = report["mttr"]["disruptions"]
+        assert sum(outcomes.values()) > 0, report["chaos"]
+        assert outcomes.get("", 0) == 0, "open disruption records at end"
+        assert outcomes.get("failed", 0) == 0
+
+
+class TestReplayPin:
+    """Satellite: one soak_seed deterministically derives all five tiers'
+    schedules plus the arrival trace — two runs from the same seed produce
+    identical kill/arrival logs."""
+
+    def _run(self, tmp_path, tag):
+        cfg = smoke_config(
+            sim_hours=0.5, arrival_per_minute=4.0, tpu_slices=6,
+            max_wall_seconds=120.0,
+        )
+        h = SoakHarness(cfg, str(tmp_path / tag))
+        h.run()
+        terminal = {
+            name: (rec.succeeded, rec.finished is not None)
+            for name, rec in h.tracker.jobs.items()
+        }
+        return (
+            h.trace.log(),
+            h.orch.replay_log(),
+            dict(h.orch.wire.injected),
+            terminal,
+        )
+
+    def test_same_seed_identical_logs(self, tmp_path):
+        a = self._run(tmp_path, "a")
+        b = self._run(tmp_path, "b")
+        assert a[0] == b[0], "arrival traces diverged"
+        assert a[1] == b[1], "chaos action logs diverged"
+        assert a[2] == b[2], "wire fault decisions diverged"
+        assert a[3] == b[3], "terminal job states diverged"
+        assert any(
+            action in ("kill", "kill_slice") for _, _, action, _ in a[1]
+        ), "replay pin is vacuous: no kills in the log"
+
+    def test_different_seed_diverges(self, tmp_path):
+        a = self._run(tmp_path, "a2")
+        cfg = smoke_config(
+            sim_hours=0.5, arrival_per_minute=4.0, tpu_slices=6,
+            max_wall_seconds=120.0, seed=77,
+        )
+        h = SoakHarness(cfg, str(tmp_path / "c"))
+        h.run()
+        assert h.trace.log() != a[0]
+
+    def test_derive_seed_stable(self):
+        assert derive_seed(14, "sched-pod") == derive_seed(14, "sched-pod")
+        assert derive_seed(14, "sched-pod") != derive_seed(14, "sched-node")
+        assert derive_seed(14, "wire") != derive_seed(15, "wire")
+
+
+class TestInv009:
+    """The unbounded-accumulator rule, fed by FleetSources.accumulators."""
+
+    def _auditor(self, cluster, feed):
+        return InvariantAuditor(
+            cluster.api, cluster.clock.now,
+            sources=FleetSources(accumulators=feed),
+            interval=10.0,
+        )
+
+    def test_over_bound_fires_after_grace(self):
+        cluster = Cluster(VirtualClock())
+        state = {"size": 100}
+        auditor = self._auditor(
+            cluster, lambda: {"events": (state["size"], 50)})
+        assert auditor.audit() == []  # grace absorbs a sampling transient
+        cluster.clock.advance(31.0)
+        violations = auditor.audit()
+        assert [v.rule for v in violations] == ["INV009"]
+        assert violations[0].name == "events"
+        # Healing (trim caught up) clears the incident.
+        state["size"] = 10
+        assert auditor.audit() == []
+
+    def test_within_bound_clean(self):
+        cluster = Cluster(VirtualClock())
+        auditor = self._auditor(
+            cluster, lambda: {"events": (50, 50), "ring": (0, 8)})
+        auditor.audit()
+        cluster.clock.advance(31.0)
+        assert auditor.audit() == []
+
+    def test_zero_bound_disables(self):
+        cluster = Cluster(VirtualClock())
+        auditor = self._auditor(cluster, lambda: {"unbounded": (10**9, 0)})
+        auditor.audit()
+        cluster.clock.advance(31.0)
+        assert auditor.audit() == []
+
+
+class TestEventCap:
+    """The accumulator fix INV009 guards: the store's Event list is
+    bounded (k8s events-TTL analogue), trimmed oldest-first with the
+    aggregation index rebuilt."""
+
+    def test_trim_keeps_cap_and_aggregation(self):
+        api = APIServer()
+        api.set_event_cap(100)
+        for i in range(300):
+            api.record_event(Event(
+                object_kind="Pod", object_name=f"p-{i}", event_type="Normal",
+                reason="Touched", message=f"m{i}", timestamp=float(i),
+            ))
+        assert api.event_count() <= 100
+        # Newest events retained, oldest dropped.
+        assert api.events(object_name="p-299")
+        assert not api.events(object_name="p-0")
+        # Aggregation on a RETAINED event still bumps its count in place.
+        before = api.event_count()
+        api.record_event(Event(
+            object_kind="Pod", object_name="p-299", event_type="Normal",
+            reason="Touched", message="m299", timestamp=400.0,
+        ))
+        assert api.event_count() == before
+        assert api.events(object_name="p-299")[0].count == 2
+        # A repeat of a DROPPED event starts a fresh record (count 1),
+        # like an expired k8s Event recurring.
+        api.record_event(Event(
+            object_kind="Pod", object_name="p-0", event_type="Normal",
+            reason="Touched", message="m0", timestamp=401.0,
+        ))
+        assert api.events(object_name="p-0")[0].count == 1
+
+    def test_default_cap_is_generous(self):
+        assert APIServer().event_cap() == 16384
+
+
+class TestSustainedLoadHealing:
+    """The two manager self-healing passes the soak surfaced: expired
+    expectations dropped at resync, and the cascade-GC orphan sweep."""
+
+    def test_forget_expired_drops_only_expired(self):
+        from training_operator_tpu.engine.expectations import (
+            ControllerExpectations,
+        )
+
+        clock = VirtualClock()
+        exp = ControllerExpectations(clock.now)
+        exp.expect_creations("old/worker/pods", 2)
+        clock.advance(301.0)
+        exp.expect_creations("new/worker/pods", 1)
+        assert exp.forget_expired() == 1
+        assert "old/worker/pods" not in exp.unfulfilled()
+        assert "new/worker/pods" in exp.unfulfilled()
+        # Fulfilled entries are not "leaks" regardless of age.
+        exp.creation_observed("new/worker/pods")
+        clock.advance(301.0)
+        assert exp.forget_expired() == 0
+
+    def test_resync_sweeps_cascade_orphans(self):
+        from training_operator_tpu.controllers import (
+            JAXController,
+            OperatorManager,
+        )
+
+        cluster = Cluster(VirtualClock())
+        mgr = OperatorManager(cluster, resync_period=50.0)
+        mgr.register(JAXController(cluster.api))
+        live = cluster.api.create(JAXJob(
+            metadata=ObjectMeta(name="alive"),
+            replica_specs={"Worker": ReplicaSpec(
+                replicas=1,
+                template=PodTemplateSpec(containers=[Container(
+                    name="jax", image="trainer", resources={"cpu": 1.0},
+                )]),
+            )},
+        ))
+        cluster.run_until(
+            lambda: cluster.api.list("Pod", "default"), timeout=30)
+        # An orphan whose recorded owner uid resolves to nothing (its job
+        # was deleted but the cascade delete was lost to a wire fault).
+        orphan = Pod(metadata=ObjectMeta(
+            name="orphan", namespace="default",
+            labels={JOB_KIND_LABEL: "JAXJob", JOB_NAME_LABEL: "ghost"},
+            owner_uid="jaxjob-default-ghost-dead",
+        ))
+        cluster.api.create(orphan)
+        cluster.run_for(60.0)  # one resync period
+        assert cluster.api.try_get("Pod", "default", "orphan") is None
+        owned = cluster.api.list("Pod", "default")
+        assert owned and all(p.metadata.owner_uid == live.uid for p in owned)
+        mgr.stop()
+
+
+@pytest.mark.slow
+class TestSoakCompressedDay:
+    def test_compressed_day_at_fleet_scale(self, tmp_path):
+        """A simulated day at the full 10k-node topology with the
+        bench-soak defaults: all five tiers, one failover, fail-fast
+        auditing, bounded growth. (The simulated WEEK is the bench-soak
+        artifact; this is its CI-sized proof.)"""
+        cfg = SoakConfig(sim_hours=24.0, max_wall_seconds=900.0)
+        h = SoakHarness(cfg, str(tmp_path))
+        report = h.run()
+        jobs = report["jobs"]
+        assert jobs["completed"] == jobs["submitted"] > 2000
+        assert report["auditor"]["violations"] == 0
+        assert report["failover"]["replication_parity"]
+        assert report["nodes"] == 10064
+        for name, g in report["growth"].items():
+            if isinstance(g, dict):
+                assert g["within"], (name, g)

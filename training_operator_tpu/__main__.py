@@ -268,6 +268,22 @@ def parse_args(argv=None) -> argparse.Namespace:
                          "gauge cadence in cluster seconds (default 30; "
                          "0 disables the auditor — GET /fleet still serves "
                          "the snapshot, without live violations)")
+    ap.add_argument("--soak-hours", type=float, default=None,
+                    help="simulated fleet hours a soak run covers "
+                         "(default 168 = one week)")
+    ap.add_argument("--soak-arrival-per-minute", type=float, default=None,
+                    help="mean job arrival rate of the soak's Poisson "
+                         "arrival process (default 2)")
+    ap.add_argument("--soak-compression", type=float, default=None,
+                    help="duration compression factor: job durations and "
+                         "soak control cadences divided by this (default 1)")
+    ap.add_argument("--soak-chaos", default=None, metavar="SPEC",
+                    help='per-tier soak chaos intensity, e.g. '
+                         '"pod=1,api=1,wire=0.5,node=1,host=1" '
+                         "(0 disables a tier)")
+    ap.add_argument("--soak-seed", type=int, default=None,
+                    help="single seed deriving every soak schedule: chaos "
+                         "tiers, arrival trace, victim picks (default 14)")
     ap.add_argument("--namespace", default=None, help="namespace scope (default: all)")
     ap.add_argument("--controller-threads", type=int, default=None,
                     help="reconciles drained per manager tick")
@@ -335,6 +351,16 @@ def build_config(args: argparse.Namespace) -> OperatorConfig:
         cfg.node_toleration_seconds = args.node_toleration_seconds
     if args.audit_interval is not None:
         cfg.fleet_audit_interval = args.audit_interval
+    if args.soak_hours is not None:
+        cfg.soak_hours = args.soak_hours
+    if args.soak_arrival_per_minute is not None:
+        cfg.soak_arrival_per_minute = args.soak_arrival_per_minute
+    if args.soak_compression is not None:
+        cfg.soak_compression = args.soak_compression
+    if args.soak_chaos is not None:
+        cfg.soak_chaos = args.soak_chaos
+    if args.soak_seed is not None:
+        cfg.soak_seed = args.soak_seed
     if args.controller_threads is not None:
         cfg.controller_threads = args.controller_threads
     if args.replication_wal_ring is not None:

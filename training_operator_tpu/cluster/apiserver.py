@@ -38,6 +38,11 @@ from training_operator_tpu.cluster.objects import Event
 from training_operator_tpu.observe.timeline import TimelineStore
 from training_operator_tpu.utils import metrics
 
+# Default event-retention cap (see APIServer._event_cap). Sized to hold
+# every event of a 1k-job burst several times over; long-lived hosts and
+# soak runs may lower it via set_event_cap.
+DEFAULT_EVENT_CAP = 16384
+
 
 def _is_job_like(obj: Any) -> bool:
     """Objects the lifecycle tracer follows: v1 jobs (replica_specs) and v2
@@ -176,6 +181,17 @@ class APIServer:
         # Event aggregation index (k8s parity): aggregation_key -> index in
         # _events, so identical repeats bump a count instead of appending.
         self._event_index: Dict[tuple, int] = {}
+        # Event retention bound (the k8s events-TTL analogue, count-shaped
+        # for a virtual-clock store): the event list was the last unbounded
+        # accumulator in the control plane — a week-long soak grows it
+        # linearly with fleet life while everything else (timelines, resume
+        # rings, WAL ring, pod logs) is ring-bounded. Past the cap the
+        # OLDEST quarter is dropped (hysteresis: trimming exactly to cap
+        # would rebuild the aggregation index on every append once full).
+        # Aggregated repeats keep bumping retained records; a repeat of a
+        # dropped record starts a fresh count, exactly like an expired k8s
+        # Event recurring.
+        self._event_cap = DEFAULT_EVENT_CAP
         self._lock = threading.RLock()
         # Signalled on every watch push; wait_and_drain blocks on it so a
         # cross-thread watch consumer (the HTTP long-poll handler) parks on
@@ -777,12 +793,34 @@ class APIServer:
         event.count = max(1, event.count)
         self._event_index[key] = len(self._events)
         self._events.append(event)
+        if len(self._events) > self._event_cap:
+            drop = len(self._events) - (self._event_cap * 3) // 4
+            self._events = self._events[drop:]
+            self._event_index = {
+                e.aggregation_key(): i for i, e in enumerate(self._events)
+            }
+            metrics.events_trimmed.inc(amount=drop)
 
     def record_event(self, event: Event) -> None:
         with self._lock:
             if self._journal is not None:  # write-ahead, see create()
                 self._journal("event", event)
             self._merge_event_locked(event)
+
+    def set_event_cap(self, cap: int) -> None:
+        """Override the event-retention bound (>=1). A replication pair
+        must agree on the cap — trimming is deterministic local state, so
+        identical caps keep a standby's retained event list identical."""
+        with self._lock:
+            self._event_cap = max(1, int(cap))
+
+    def event_cap(self) -> int:
+        return self._event_cap
+
+    def event_count(self) -> int:
+        """Retained event records — the INV009 accumulator feed."""
+        with self._lock:
+            return len(self._events)
 
     def events(
         self, object_name: Optional[str] = None, reason: Optional[str] = None

@@ -71,10 +71,18 @@ class ChaosMonkey:
         if self._armed and len(self.kills) < self.budget:
             self.cluster.schedule_after(self.interval, self._strike)
 
-    def _strike(self) -> None:
-        if not self._armed or len(self.kills) >= self.budget:
-            return
-        pods = self.cluster.api.list("Pod", self.namespace, self.selector)
+    def strike_once(self) -> Optional[str]:
+        """One kill attempt NOW: pick a seeded random RUNNING victim and
+        kill it through the kubelet; returns the victim pod name (None if
+        nothing was killable). Public so an external schedule — the soak
+        orchestrator interleaving every tier on one virtual clock — can
+        drive strikes without owning this monkey's self-arming timer; the
+        budget/empty-strike bookkeeping stays in the timer path."""
+        return self._strike_once(
+            self.cluster.api.list("Pod", self.namespace, self.selector)
+        )
+
+    def _strike_once(self, pods) -> Optional[str]:
         victims = sorted(
             (p for p in pods if p.status.phase == PodPhase.RUNNING),
             key=lambda p: (p.namespace, p.name),
@@ -87,10 +95,19 @@ class ChaosMonkey:
                 log=f"chaos: killed at t={now:.1f}",
             ):
                 self.kills.append((now, pod.name))
-                self.empty_strikes = 0
-            else:
-                self.empty_strikes += 1
-        elif any(not p.is_terminal() for p in pods):
+                return pod.name
+        return None
+
+    def _strike(self) -> None:
+        if not self._armed or len(self.kills) >= self.budget:
+            return
+        pods = self.cluster.api.list("Pod", self.namespace, self.selector)
+        if self._strike_once(pods) is not None:
+            self.empty_strikes = 0
+        elif any(
+            not p.is_terminal() and p.status.phase != PodPhase.RUNNING
+            for p in pods
+        ):
             # Matching pods exist but none are RUNNING yet (scheduling /
             # backoff delay): keep the monkey armed — disarming here would
             # silently strip chaos from a workload that is merely slow to
@@ -212,10 +229,14 @@ class NodeChaos:
 
     # -- random strikes ------------------------------------------------
 
-    def _strike(self) -> None:
-        if not self._armed or len(self.kills) >= self.budget:
-            return
-        pods = self.cluster.api.list("Pod")
+    def strike_once(self) -> Optional[str]:
+        """One node kill NOW: a seeded random host currently running a pod
+        goes dark (recover_after schedules its reboot); returns the victim
+        node name (None when no busy live node exists). Public for external
+        schedules — see ChaosMonkey.strike_once."""
+        return self._strike_once(self.cluster.api.list("Pod"))
+
+    def _strike_once(self, pods) -> Optional[str]:
         busy = sorted({
             p.node_name
             for p in pods
@@ -223,14 +244,22 @@ class NodeChaos:
             and p.status.phase == PodPhase.RUNNING
             and self.kubelet.node_alive(p.node_name)
         })
-        if busy:
-            victim = self.rng.choice(busy)
-            self.kill_node(victim)
+        if not busy:
+            return None
+        victim = self.rng.choice(busy)
+        self.kill_node(victim)
+        if self.recover_after is not None:
+            self.schedule_recover(
+                victim, self.cluster.clock.now() + self.recover_after
+            )
+        return victim
+
+    def _strike(self) -> None:
+        if not self._armed or len(self.kills) >= self.budget:
+            return
+        pods = self.cluster.api.list("Pod")
+        if self._strike_once(pods) is not None:
             self.empty_strikes = 0
-            if self.recover_after is not None:
-                self.schedule_recover(
-                    victim, self.cluster.clock.now() + self.recover_after
-                )
         elif any(not p.is_terminal() for p in pods):
             # Pods exist but none RUNNING yet (scheduling/recovery lag):
             # stay armed, like ChaosMonkey — disarming would quietly strip

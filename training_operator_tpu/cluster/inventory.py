@@ -41,15 +41,32 @@ def accel_family(accelerator: str) -> str:
     return accelerator.rsplit("-", 1)[0] if "-" in accelerator else accelerator
 
 
+# Memo for try_parse_topology: the admission analyzer parses each node's
+# topology label, so a 10k-node inventory re-parses the same handful of
+# strings millions of times over a sustained run. Values are tuples (or
+# None); callers get a fresh list so the memo can never be mutated through
+# a returned value. Bounded: label data is untrusted input.
+_TOPOLOGY_MEMO: dict = {}
+_TOPOLOGY_MEMO_MAX = 1024
+
+
 def try_parse_topology(topology: str) -> Optional[List[int]]:
     """parse_topology for untrusted input (lint/admission paths): None on
     malformed or non-positive dims instead of ValueError."""
+    # Non-str input must fall through to the hardened parse, not hash-fail
+    # at the memo probe (the contract is None-on-anything-malformed).
+    memoizable = isinstance(topology, str)
+    if memoizable and topology in _TOPOLOGY_MEMO:
+        hit = _TOPOLOGY_MEMO[topology]
+        return None if hit is None else list(hit)
     try:
         dims = parse_topology(topology)
-    except (ValueError, AttributeError):
-        return None
-    if not dims or any(d < 1 for d in dims):
-        return None
+    except (ValueError, AttributeError, TypeError):
+        dims = None
+    if dims is not None and (not dims or any(d < 1 for d in dims)):
+        dims = None
+    if memoizable and len(_TOPOLOGY_MEMO) < _TOPOLOGY_MEMO_MAX:
+        _TOPOLOGY_MEMO[topology] = None if dims is None else tuple(dims)
     return dims
 
 

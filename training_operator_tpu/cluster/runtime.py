@@ -420,6 +420,14 @@ class SimKubelet:
 
     # -- node liveness -----------------------------------------------------
 
+    def dead_nodes(self) -> set:
+        """Nodes this kubelet currently holds dead (heartbeats silenced) —
+        worker-host death is EXTERNAL state: a control-plane host failover
+        builds a fresh kubelet, and the promotion path must re-silence
+        these nodes on it or the new incarnation's first heartbeat would
+        resurrect every dead host's lease."""
+        return set(self._dead_nodes)
+
     def node_alive(self, name: str) -> bool:
         return (
             bool(name)

@@ -478,6 +478,12 @@ class HostStore:
                 "records": records,
             }
 
+    def wal_ring_len(self) -> int:
+        """Records currently retained in the replication WAL ring (bounded
+        by `wal_ring`) — the INV009 accumulator feed."""
+        with self._lock:
+            return len(self._wal)
+
     def journal_bytes(self) -> int:
         """Bytes appended to the current journal generation since the last
         snapshot — the fleet plane's INV005 feed (a value persistently over

@@ -22,6 +22,30 @@ from typing import Dict, List, Optional
 ALL_SCHEMES = ("jax", "pytorch", "tensorflow", "xgboost", "paddle", "mpi")
 GANG_SCHEDULERS = ("none", "tpu-packer", "baseline", "baseline-firstfit")
 SOLVER_KERNELS = ("python", "numpy", "jax")
+CHAOS_TIERS = ("pod", "api", "wire", "node", "host")
+
+
+def parse_chaos_intensity(spec: str) -> Dict[str, float]:
+    """Parse a per-tier chaos intensity spec ("pod=1,api=0.5,...") into a
+    full tier->intensity map; unnamed tiers default to 1.0. Raises
+    ValueError on unknown tiers or negative intensities — config.validate
+    calls this so a bad spec fails at config time, not mid-soak."""
+    out = {tier: 1.0 for tier in CHAOS_TIERS}
+    for pair in (spec or "").split(","):
+        if not pair.strip():
+            continue
+        key, _, value = pair.partition("=")
+        key = key.strip()
+        if key not in CHAOS_TIERS:
+            raise ValueError(
+                f"unknown chaos tier {key!r} in {spec!r}; "
+                f"choose from {CHAOS_TIERS}"
+            )
+        intensity = float(value)
+        if intensity < 0:
+            raise ValueError(f"chaos intensity for {key} must be >= 0")
+        out[key] = intensity
+    return out
 
 
 @dataclass
@@ -165,6 +189,30 @@ class OperatorConfig:
     default_priority_class: str = ""
     tenancy_starvation_seconds: float = 600.0
     tenancy_max_preemptions: int = 3
+    # Time-compressed fleet soak (soak/harness.py; `make bench-soak` and
+    # the soak test tiers). The harness runs simulated days of fleet life
+    # on the virtual clock with all five chaos tiers live:
+    #   soak_hours — simulated fleet hours the soak covers (168 = a week).
+    #   soak_arrival_per_minute — mean job arrival rate of the Poisson
+    #       arrival process (heavy-tailed Pareto durations ride on top).
+    #   soak_compression — duration compression: job durations and the
+    #       soak's own control cadences (heartbeats, audits, resyncs) are
+    #       divided by this, so the same fleet life fits fewer simulated
+    #       seconds. 1.0 = uncompressed.
+    #   soak_chaos — per-tier chaos intensity spec "pod=1,api=1,wire=1,
+    #       node=1,host=1": 0 disables a tier, >1 scales its injection
+    #       rate up. The host tier is BINARY (any value > 0 schedules the
+    #       single mid-soak failover — the harness runs one warm standby,
+    #       so there is exactly one failover to have). Parsed by
+    #       parse_chaos_intensity().
+    #   soak_seed — THE seed: every tier's schedule, the arrival trace,
+    #       and all victim picks derive from it; two runs with the same
+    #       seed produce identical kill/arrival logs (replay-pinned).
+    soak_hours: float = 168.0
+    soak_arrival_per_minute: float = 2.0
+    soak_compression: float = 1.0
+    soak_chaos: str = "pod=1,api=1,wire=1,node=1,host=1"
+    soak_seed: int = 14
     # Probe/metrics HTTP port; 0 disables (reference --health-probe-bind-
     # address / --metrics-bind-address, collapsed to one server here).
     health_port: int = 0
@@ -255,6 +303,15 @@ class OperatorConfig:
             raise ValueError("node_toleration_seconds must be >= 0")
         if self.fleet_audit_interval < 0:
             raise ValueError("fleet_audit_interval must be >= 0 (0 disables)")
+        if self.soak_hours <= 0:
+            raise ValueError("soak_hours must be > 0")
+        if self.soak_arrival_per_minute <= 0:
+            raise ValueError("soak_arrival_per_minute must be > 0")
+        if self.soak_compression <= 0:
+            # Compression divides durations/cadences; zero or negative would
+            # stretch every job to infinity (or reverse time).
+            raise ValueError("soak_compression must be > 0")
+        parse_chaos_intensity(self.soak_chaos)  # raises on a malformed spec
         if self.tenancy_max_preemptions < 0:
             raise ValueError("tenancy_max_preemptions must be >= 0")
         if self.leader_lease_duration <= 0:

@@ -46,10 +46,12 @@ class OperatorManager:
         lease_duration: float = 15.0,
         resync_period: Optional[float] = 300.0,
         parallel_reconciles: int = 0,
+        gang_requeue_seconds: float = 30.0,
     ):
         self.cluster = cluster
         self.api = cluster.api
         self.gang_enabled = gang_enabled
+        self.gang_requeue_seconds = gang_requeue_seconds
         self.reconciles_per_tick = reconciles_per_tick
         # Namespace scope (reference --namespace / cache.Options.Namespaces):
         # events outside the scope are ignored entirely.
@@ -156,6 +158,7 @@ class OperatorManager:
             controller,
             now_fn=self.cluster.clock.now,
             gang_enabled=self.gang_enabled,
+            gang_requeue_seconds=self.gang_requeue_seconds,
             # The engine passes bare "ns/name"; prefix the kind so requeues
             # land in the same key space as event enqueues.
             requeue_after=lambda job_key, delay: self._requeue_after(
@@ -189,10 +192,26 @@ class OperatorManager:
     OWNED_KINDS = ("Pod", "Service", "PodGroup", "ConfigMap", "HorizontalPodAutoscaler")
 
     def _cascade_delete(self, job: Job) -> None:
+        # list_refs where available: this walk only READS owner_uid/name off
+        # the stored references — clone-on-read here cost more than the
+        # deletes under sustained job churn (every TTL GC paid five
+        # full-kind deep copies). Best-effort per item: a wire fault here
+        # must not abort the remaining deletes (or the rest of this tick's
+        # drained events); whatever is missed, the resync orphan sweep
+        # retries.
+        list_fn = getattr(self.api, "list_refs", None) or self.api.list
         for kind in self.OWNED_KINDS:
-            for obj in self.api.list(kind, job.namespace):
+            try:
+                objs = list_fn(kind, job.namespace)
+            except Exception:  # noqa: BLE001 — the orphan sweep retries
+                continue
+            for obj in objs:
                 if obj.metadata.owner_uid == job.uid:
-                    self.api.try_delete(kind, obj.namespace, obj.name)
+                    try:
+                        self.api.try_delete(
+                            kind, obj.metadata.namespace, obj.metadata.name)
+                    except Exception:  # noqa: BLE001
+                        pass
 
     # ------------------------------------------------------------------
 
@@ -210,12 +229,102 @@ class OperatorManager:
                 out[f"{kind}|{key}"] = age
         return out
 
+    def _list_light(self, kind: str):
+        """Clone-free list when the API offers it (in-process list_refs);
+        the remote client's list() already hands over fresh decoded objects
+        nobody else aliases. These walks only READ metadata."""
+        fn = getattr(self.api, "list_refs", None)
+        if fn is None:
+            fn = self.api.list
+        return fn(kind, self.namespace)
+
     def _resync_all(self) -> None:
         """Enqueue every in-scope job of every registered kind (the informer
-        initial-list a newly elected leader needs)."""
+        initial-list a newly elected leader needs). The resync is also the
+        self-healing pass for bookkeeping that one-shot event handling can
+        leak under sustained faults (both surfaced by the soak harness):
+        expired expectations whose echoes were lost with a dropped watch
+        batch, and owned objects whose cascade delete failed in flight."""
         for kind in self.controllers:
-            for job in self.api.list(kind, self.namespace):
-                self.queue.add(self._key(kind, job.namespace, job.name))
+            try:
+                jobs = self._list_light(kind)
+            except Exception:  # noqa: BLE001 — transport fault; next resync
+                log.debug("resync list of %s failed; retried next period", kind)
+                continue
+            for job in jobs:
+                self.queue.add(self._key(
+                    kind, job.metadata.namespace, job.metadata.name))
+        for _, jc in self.controllers.values():
+            jc.expectations.forget_expired()
+        self._sweep_orphans()
+
+    def _sweep_orphans(self) -> None:
+        """Cascade-GC retry (the k8s garbage collector's periodic role):
+        `_cascade_delete` runs once, on the owner's Deleted event — a wire
+        fault mid-cascade would otherwise strand the remaining owned
+        objects forever (an INV001 violation no later event can heal).
+        Sweep anything whose recorded owner uid no longer resolves to a
+        live job of any kind this control plane knows about.
+
+        Best-effort PER ITEM with bounded per-call retries, like the k8s
+        garbage collector behind client-go: one transient wire fault must
+        skip at most one attempt, not abort the whole pass — the soak
+        showed a wholesale abort leaves orphans standing for several resync
+        periods under sustained transport chaos (an INV001 violation the
+        machinery was supposed to heal), and unretried calls still missed
+        often enough to trip the auditor's grace."""
+
+        def attempt(fn, *args):
+            last = None
+            for _ in range(3):
+                try:
+                    return fn(*args)
+                except Exception as e:  # noqa: BLE001 — transport fault
+                    last = e
+            raise last
+
+        # Candidates FIRST, live-owner set SECOND — the order is the
+        # correctness argument: an owner always exists before anything it
+        # owns is created, and owner uids are never reused (uid floor), so
+        # an owner uid absent from a live set captured AFTER its owned
+        # object was listed is PERMANENTLY dead. The reverse order would
+        # race a concurrent writer (live set at T0, owner+owned both
+        # created at T1, owned walk at T2 reads the new object against the
+        # stale set and deletes a healthy one).
+        candidates = []
+        for kind in self.OWNED_KINDS + tuple(self.controllers):
+            try:
+                objs = attempt(self._list_light, kind)
+            except Exception:  # noqa: BLE001
+                continue
+            for obj in objs:
+                if obj.metadata.owner_uid:
+                    candidates.append((
+                        kind, obj.metadata.namespace, obj.metadata.name,
+                        obj.metadata.owner_uid,
+                    ))
+        if not candidates:
+            return
+        live = set()
+        try:
+            for kind in self.controllers:
+                for job in attempt(self._list_light, kind):
+                    live.add(job.metadata.uid)
+            # v2 TrainJobs own their v1 workload jobs; their uids must count
+            # as live owners even though no v1 controller reconciles them.
+            for tj in attempt(self._list_light, "TrainJob"):
+                live.add(tj.metadata.uid)
+        except Exception:  # noqa: BLE001 — transport fault mid-walk
+            # An INCOMPLETE live set must abort the sweep: missing uids
+            # would read as dead owners and delete healthy pods.
+            log.debug("orphan sweep skipped: live-owner walk failed")
+            return
+        for kind, namespace, name, uid in candidates:
+            if uid not in live:
+                try:
+                    attempt(self.api.try_delete, kind, namespace, name)
+                except Exception:  # noqa: BLE001 — next sweep retries
+                    pass
 
     def tick(self) -> None:
         if self.elector is not None and not self.elector.tick():

@@ -26,6 +26,7 @@ the CLI, and NodeChaos maintenance windows) so every caller agrees on what
 
 from __future__ import annotations
 
+import heapq
 from typing import Dict, List, Optional, Tuple
 
 from training_operator_tpu.api.common import JOB_KIND_LABEL, JOB_NAME_LABEL
@@ -180,16 +181,38 @@ class NodeLifecycleController:
         self._first_seen: Dict[str, float] = {}  # grace basis pre-heartbeat
         self._tainted_at: Dict[str, float] = {}  # node -> taint instant
         self._pods_by_node: Dict[str, Dict[Tuple[str, str], Pod]] = {}
-        self._wakeup_armed = False
+        # Deadline heap (t, kind, node) with kind "grace" (heartbeat may
+        # have lapsed at t) or "evict" (toleration expires at t). Entries
+        # are validated lazily at pop against the live heartbeat/state, so
+        # a renewed lease simply orphans its old entry. This keeps the
+        # tick O(due + events): the original full-node scan per tick was
+        # 10k node_ready() calls every step at fleet scale — the single
+        # hottest control-plane loop the soak harness surfaced.
+        self._deadlines: List[Tuple[float, str, str]] = []
+        self._wakeup_at: Optional[float] = None
         now = cluster.clock.now()
-        for node in self.api.list("Node"):
-            self._nodes[node.name] = node
+        # list_refs: the cached node objects are read-only here (writes
+        # re-get + replace), and the stored references are never mutated in
+        # place — the clone-on-read walk cost one full fleet copy per
+        # controller (re)start.
+        for node in self.api.list_refs("Node"):
+            self._nodes[node.metadata.name] = node
             self._first_seen[node.name] = now
+            if not node_ready(node):
+                # Inherited NotReady (restored state / another controller).
+                self._tainted_at[node.name] = now
+                self._push(now + toleration_seconds, "evict", node.name)
         for lease in self.api.list("Lease", NODE_LEASE_NAMESPACE):
             self._hb[lease.name] = lease.renew_time
+        for name in self._nodes:
+            hb = self._hb.get(name, now)
+            self._push(hb + grace_period, "grace", name)
         for pod in self.api.list("Pod"):
             self._observe_pod("Added", pod)
         cluster.add_ticker(self.tick)
+
+    def _push(self, t: float, kind: str, name: str) -> None:
+        heapq.heappush(self._deadlines, (t, kind, name))
 
     # ------------------------------------------------------------------
 
@@ -215,42 +238,91 @@ class NodeLifecycleController:
                     self._hb.pop(name, None)
                     self._first_seen.pop(name, None)
                     self._tainted_at.pop(name, None)
+                    # No host will ever come back for these pods: evict
+                    # immediately (the k8s pod-GC rule).
+                    if self._pods_by_node.get(name):
+                        self._evict_node_pods(
+                            name, f"node {name} no longer exists", now,
+                        )
                 else:
+                    first = name not in self._nodes
                     self._nodes[name] = ev.obj
                     self._first_seen.setdefault(name, now)
+                    if first:
+                        hb = self._hb.get(name, now)
+                        self._push(hb + self.grace_period, "grace", name)
+                    if not node_ready(ev.obj) and name not in self._tainted_at:
+                        # NotReady written by a restore or another
+                        # controller: start the toleration window here.
+                        self._tainted_at[name] = now
+                        self._push(now + self.toleration_seconds, "evict", name)
             elif ev.kind == "Lease":
                 if (
                     ev.type != "Deleted"
                     and (ev.obj.metadata.namespace or "") == NODE_LEASE_NAMESPACE
                 ):
-                    self._hb[ev.obj.metadata.name] = ev.obj.renew_time
+                    name = ev.obj.metadata.name
+                    renew = ev.obj.renew_time
+                    self._hb[name] = renew
+                    self._push(renew + self.grace_period, "grace", name)
+                    node = self._nodes.get(name)
+                    if (
+                        node is not None
+                        and not node_ready(node)
+                        and now - renew < self.grace_period
+                    ):
+                        self._mark_ready(name, now)
             else:
                 self._observe_pod(ev.type, ev.obj)
+                if (
+                    ev.type != "Deleted"
+                    and ev.obj.node_name
+                    and not ev.obj.is_terminal()
+                ):
+                    node = self._nodes.get(ev.obj.node_name)
+                    if node is None:
+                        self._evict_node_pods(
+                            ev.obj.node_name,
+                            f"node {ev.obj.node_name} no longer exists", now,
+                        )
+                    elif not node_ready(node):
+                        # Bound onto a node that already burned its
+                        # toleration (stale placement): re-arm the evict
+                        # deadline — the one-shot entry for this node has
+                        # already fired.
+                        self._push(
+                            self._tainted_at.get(ev.obj.node_name, now)
+                            + self.toleration_seconds,
+                            "evict", ev.obj.node_name,
+                        )
 
     def tick(self) -> None:
         self._drain_events()
         now = self.cluster.clock.now()
-        next_deadline: Optional[float] = None
-        for name, node in list(self._nodes.items()):
+        heap = self._deadlines
+        while heap and heap[0][0] <= now:
+            _, kind, name = heapq.heappop(heap)
+            node = self._nodes.get(name)
+            if node is None:
+                continue  # deleted; its pods were evicted at the event
             hb = self._hb.get(name, self._first_seen.get(name, now))
             # Inclusive at the boundary: the wakeup timer lands exactly at
             # hb + grace, and a strict > would re-arm a due-now timer
             # forever (wedging a virtual clock at the detection instant).
             stale = now - hb >= self.grace_period
-            if node_ready(node):
-                if stale:
-                    self._mark_notready(name, now)
-                else:
-                    next_deadline = self._min(next_deadline, hb + self.grace_period)
-            else:
+            if kind == "grace":
                 if not stale:
-                    self._mark_ready(name, now)
-                    continue
-                tainted_at = self._tainted_at.get(name)
-                if tainted_at is None:
-                    # NotReady inherited from a restore/another controller:
-                    # start the toleration window at first observation.
-                    self._tainted_at[name] = tainted_at = now
+                    continue  # renewed since; a fresher entry is queued
+                if node_ready(node):
+                    self._mark_notready(name, now)
+                self._push(
+                    self._tainted_at.get(name, now) + self.toleration_seconds,
+                    "evict", name,
+                )
+            else:  # evict
+                if node_ready(node) or not stale:
+                    continue  # recovered before the toleration expired
+                tainted_at = self._tainted_at.setdefault(name, now)
                 evict_at = tainted_at + self.toleration_seconds
                 if now >= evict_at:
                     self._evict_node_pods(
@@ -258,30 +330,22 @@ class NodeLifecycleController:
                         detect_at=tainted_at, honor_tolerations=True,
                     )
                 else:
-                    next_deadline = self._min(next_deadline, evict_at)
-        # Pods bound to nodes that don't exist at all: no host will ever
-        # come back — evict immediately (the k8s pod-GC rule).
-        for node_name in list(self._pods_by_node):
-            if node_name not in self._nodes and self._pods_by_node[node_name]:
-                self._evict_node_pods(
-                    node_name, f"node {node_name} no longer exists", now,
-                )
-        self._arm_wakeup(now, next_deadline)
+                    self._push(evict_at, "evict", name)
+        self._arm_wakeup(now)
 
-    @staticmethod
-    def _min(a: Optional[float], b: float) -> float:
-        return b if a is None else min(a, b)
-
-    def _arm_wakeup(self, now: float, deadline: Optional[float]) -> None:
-        if deadline is None or self._wakeup_armed:
+    def _arm_wakeup(self, now: float) -> None:
+        if not self._deadlines:
             return
-        self._wakeup_armed = True
-        self.cluster.schedule_at(max(deadline, now), self._wakeup)
+        top = max(self._deadlines[0][0], now)
+        if self._wakeup_at is not None and self._wakeup_at <= top + 1e-9:
+            return  # an armed timer already covers the earliest deadline
+        self._wakeup_at = top
+        self.cluster.schedule_at(top, self._wakeup)
 
     def _wakeup(self) -> None:
         # No-op body: exists so a virtual clock has a timer to jump to at
         # the detection/eviction instant; the tick that follows acts.
-        self._wakeup_armed = False
+        self._wakeup_at = None
 
     # ------------------------------------------------------------------
 

@@ -96,11 +96,20 @@ class JobController:
         gang_enabled: bool = False,
         requeue_after: Optional[Callable[[str, float], None]] = None,
         delete_job: Optional[Callable[[Job], None]] = None,
+        gang_requeue_seconds: float = 30.0,
     ):
         self.api = api
         self.controller = controller
         self.now = now_fn
         self.gang_enabled = gang_enabled
+        # Safety-net poll for gang-gated jobs (admission itself is
+        # event-driven; see reconcile). Interactive default 30s; long-wait
+        # deployments (the soak's oversubscribed queues hold jobs pending
+        # for hours) raise it — N pending jobs re-reconciling every 30
+        # sim-seconds for hours IS the reconcile storm the inline comment
+        # warns about, just accumulated over fleet time instead of burst
+        # width.
+        self.gang_requeue_seconds = gang_requeue_seconds
         self.requeue_after = requeue_after or (lambda key, delay: None)
         self.delete_job = delete_job
         self.expectations = ControllerExpectations(now_fn)
@@ -200,7 +209,7 @@ class JobController:
                 # safety net, so keep it long: a tight poll here multiplies
                 # into reconcile storms under queue pressure (1k pending jobs
                 # x 20 polls/s was the bench bottleneck).
-                self.requeue_after(key, 30.0)
+                self.requeue_after(key, self.gang_requeue_seconds)
 
         # -- expectations gate ------------------------------------------
         if not self._satisfied_expectations(job):

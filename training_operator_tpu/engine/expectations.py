@@ -90,6 +90,28 @@ class ControllerExpectations:
             if not exp.fulfilled()
         }
 
+    def forget_expired(self) -> int:
+        """Drop unfulfilled entries older than the TTL; returns how many.
+
+        An entry past the TTL no longer gates anything (satisfied_
+        expectations opens at expiry) — it is residue of watch events that
+        were lost (flaky informer connection, a faulted tick dropping a
+        drained batch). The periodic resync re-lists every job, so the
+        state those events carried is re-observed anyway; keeping the
+        entry would only make the INV004 feed report a leak that the
+        resync machinery has in fact already healed. Call from the resync
+        path: then anything unfulfilled past TTL + resync period really IS
+        wedged, which is exactly what INV004 should mean."""
+        now = self._now()
+        stale = [
+            key for key, exp in self._store.items()
+            if not exp.fulfilled()
+            and now - exp.timestamp > EXPECTATION_TIMEOUT_SECONDS
+        ]
+        for key in stale:
+            del self._store[key]
+        return len(stale)
+
     def clear(self) -> None:
         """Drop every expectation — for a controller whose watch stream had
         a gap (e.g. a standby period between two leadership terms): stale

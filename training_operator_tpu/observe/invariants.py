@@ -40,6 +40,11 @@ reference table cannot drift against scattered registrations):
                                  replication_max_lag_seconds — failover
                                  from it would lose that much acknowledged
                                  history (the warm standby is cold)
+  INV009 unbounded-accumulator   an in-memory accumulator (event store,
+                                 timeline LRU, replication WAL ring,
+                                 workqueue, ...) holding more entries than
+                                 its configured bound — under sustained
+                                 load it is growing without bound
 
 Mechanics: every rule returns *candidates*; the auditor tracks first-seen
 times and reports a violation only once it has persisted past the rule's
@@ -117,6 +122,14 @@ class FleetSources:
     # StandbyController.lag(): {"role", "records", "seconds", "connected",
     # ...} — present only on a standby (or promoted ex-standby) host.
     replication_lag: Optional[Callable[[], Dict[str, Any]]] = None
+    # Generic bounded-accumulator feed (INV009): name -> (size, bound) for
+    # every in-memory accumulator this deployment shape is supposed to keep
+    # ring/cap-bounded — the event store, the timeline LRU, the replication
+    # WAL ring, the manager workqueue, ... . INV005 audits the two storage
+    # structures with their own protocols (journal bytes, resume rings);
+    # this feed catches the rest, so "nothing grows without bound over a
+    # simulated week" is one rule, not a scattering of ad-hoc asserts.
+    accumulators: Optional[Callable[[], Dict[str, Tuple[int, int]]]] = None
 
 
 class AuditContext:
@@ -443,6 +456,33 @@ register_invariant(InvariantRule(
     # the candidate only exists once lag has already persisted past the
     # configured bound, so a second grace window would double-count it.
     _check_replication_lag, grace=0.0,
+))
+
+
+def _check_unbounded_accumulators(ctx: AuditContext) -> List[Violation]:
+    src = ctx.sources.accumulators
+    if src is None:
+        return []
+    out = []
+    for name, (size, bound) in sorted(src().items()):
+        if bound > 0 and size > bound:
+            out.append(Violation(
+                "INV009", "Accumulator", "", name,
+                f"accumulator {name} holds {int(size)} entries > configured "
+                f"bound {int(bound)} — it is growing without bound "
+                f"(retention/trim machinery broke, or the bound was set "
+                f"below live steady state)",
+            ))
+    return out
+
+
+register_invariant(InvariantRule(
+    "INV009", "in-memory accumulator over its configured bound",
+    # Every audited accumulator trims synchronously at its cap (event
+    # store, timeline LRU, WAL ring, ...), so even one pass over the bound
+    # means the trim machinery itself failed; the transient grace only
+    # absorbs feeds sampled mid-burst (e.g. a workqueue drained per tick).
+    _check_unbounded_accumulators,
 ))
 
 

@@ -143,6 +143,12 @@ class TimelineStore:
     def now(self) -> float:
         return self._now()
 
+    def count(self) -> int:
+        """Timelines currently retained (LRU-bounded by max_jobs) — the
+        INV009 accumulator feed."""
+        with self._lock:
+            return len(self._jobs)
+
     def _timeline_locked(self, namespace: str, name: str) -> JobTimeline:
         key = (namespace or "", name)
         tl = self._jobs.get(key)

@@ -107,7 +107,12 @@ def lint_trainjob_admission(api, job: TrainJob) -> None:
     # job actually asks for TPU placement; everything else gets the O(1)
     # spec-only rules.
     tpu = runtime.spec.ml_policy.tpu if runtime is not None else None
-    nodes = api.list("Node") if tpu is not None and tpu.topology else None
+    # list_refs when available: the analyzer only READS node labels and
+    # accelerator geometry — clone-on-read here was one full 10k-node deep
+    # copy per TPU TrainJob admission (the soak's hottest single allocation
+    # site), paid under the store lock.
+    list_fn = getattr(api, "list_refs", None) or api.list
+    nodes = list_fn("Node") if tpu is not None and tpu.topology else None
     from training_operator_tpu.tenancy.api import (
         PRIORITY_CLASS_LABEL,
         QUEUE_LABEL,
@@ -123,7 +128,7 @@ def lint_trainjob_admission(api, job: TrainJob) -> None:
     report = analyze_trainjob(
         job, runtime,
         nodes=nodes if nodes else None,
-        podgroups=api.list("PodGroup") if nodes else None,
+        podgroups=list_fn("PodGroup") if nodes else None,
         priority_classes=pcs,
         cluster_queues=cqs,
     )

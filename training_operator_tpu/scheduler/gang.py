@@ -459,12 +459,17 @@ class GangScheduler:
         since_last = now - self._last_solve_at
         if not self._solve_dirty and since_last < self.resolve_period:
             return
-        if self._solve_dirty and since_last < self.min_solve_interval:
+        # Tolerance on the deferral window: without it, a wakeup that fires
+        # at (last_solve + min_interval) can leave `min_interval -
+        # since_last` a float hair above zero — the re-armed timer then
+        # lands at an instant where now + delta == now, and the tick/timer
+        # pair busy-steps the virtual clock forever at one instant (the
+        # week-long soak surfaced this as a wall-clock stall).
+        remaining = self.min_solve_interval - since_last
+        if self._solve_dirty and remaining > 1e-9:
             if not self._wakeup_armed:
                 self._wakeup_armed = True
-                self.cluster.schedule_after(
-                    self.min_solve_interval - since_last, self._wakeup
-                )
+                self.cluster.schedule_after(remaining, self._wakeup)
             return
         t0 = time.perf_counter()
         solve_at = now  # cluster-clock solve start, for the timeline spans
@@ -818,13 +823,17 @@ class GangScheduler:
         if not self._unbound:
             return
         groups = self._groups
+        cached_nodes = self._nodes
+
         # NotReady nodes are as unusable as cordoned ones: a bind onto a
-        # dead host would start nothing and re-evict later.
-        nodes = {
-            n.name
-            for n in self._nodes.values()
-            if not n.unschedulable and node_ready(n)
-        }
+        # dead host would start nothing and re-evict later. Checked per
+        # TARGET node — materializing the usable set up front walked all
+        # 10k nodes on every tick that had an unbound pod (a soak-surfaced
+        # hot loop; binds touch a handful of nodes each).
+        def usable(name: str) -> bool:
+            n = cached_nodes.get(name)
+            return n is not None and not n.unschedulable and node_ready(n)
+
         for key, pod in list(self._unbound.items()):
             pg_name = pod.spec.annotations.get(PodGroupControl.POD_GROUP_ANNOTATION)
             if not pg_name:
@@ -836,7 +845,7 @@ class GangScheduler:
             target = pg.placement.get(pod.name)
             if target is None:
                 continue
-            if target not in nodes:
+            if not usable(target):
                 # Placed node vanished/died before binding: re-solve the
                 # whole gang (evicts any members already running, so the
                 # solve sees the gang's full demand against live capacity).

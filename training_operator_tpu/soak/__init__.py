@@ -1,0 +1,42 @@
+"""Time-compressed fleet soak harness (ROADMAP item 3).
+
+`SoakHarness` drives the full control plane through simulated days of
+fleet life on the virtual clock — sustained heavy-tailed arrivals, all
+five chaos tiers live at once, rolling maintenance, a mid-soak host
+failover — under the fail-fast invariant auditor. See soak/harness.py for
+the architecture and soak/orchestrator.py for the single-seed chaos
+schedule derivation.
+"""
+
+from training_operator_tpu.soak.harness import (
+    SoakConfig,
+    SoakError,
+    SoakHarness,
+    VirtualStandby,
+    WireFacade,
+)
+from training_operator_tpu.soak.orchestrator import ChaosOrchestrator, derive_seed
+from training_operator_tpu.soak.workload import (
+    Arrival,
+    SoakTrace,
+    build_arrival_trace,
+    build_v1_job,
+    build_v2_job,
+    tenancy_objects,
+)
+
+__all__ = [
+    "Arrival",
+    "ChaosOrchestrator",
+    "SoakConfig",
+    "SoakError",
+    "SoakHarness",
+    "SoakTrace",
+    "VirtualStandby",
+    "WireFacade",
+    "build_arrival_trace",
+    "build_v1_job",
+    "build_v2_job",
+    "derive_seed",
+    "tenancy_objects",
+]

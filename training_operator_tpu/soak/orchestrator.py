@@ -1,0 +1,312 @@
+"""Single-seed chaos orchestration: one `soak_seed` drives all five tiers.
+
+Each chaos tier owns its injection *machinery* (cluster/chaos.py); what a
+soak needs on top is one authority over *when* every tier fires, on one
+virtual clock, derived from one seed — so a failing week of fleet life is
+replayable as a whole, not per-tier. The orchestrator precomputes a merged
+action schedule at construction (pure function of the seed + config) and
+executes due actions from the harness loop:
+
+  pod    ChaosMonkey.strike_once (seeded victim pick) on a Poisson schedule
+  node   NodeChaos.strike_once with reboot-class recovery, occasional
+         whole-slice kills, and rolling maintenance windows (cordon+drain,
+         uncordon at window end) walking the slice inventory
+  api    APIChaos continuous conflict/drop/dup rates against the operator's
+         watch queues (bound at attach, rebound after failover)
+  wire   WireChaos continuous error/reset decisions, sampled by the
+         harness's in-process wire boundary (soak/harness.py WireFacade)
+  host   control-plane host kill + standby promotion, executed by the
+         harness (the orchestrator only schedules it)
+
+Recovery and window-end timers are orchestrator actions, NOT cluster
+timers: a host failover kills the dead cluster's timer heap, but a worker
+node mid-reboot comes back regardless of who runs the control plane — so
+the orchestrator re-arms its own pending actions against the promoted
+cluster instead of losing them with the old one.
+
+`log` records every executed action as (sim_time, tier, action, target);
+together with the arrival trace it is the replay pin: two runs from the
+same seed produce identical logs.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from training_operator_tpu.cluster.chaos import (
+    APIChaos,
+    ChaosMonkey,
+    NodeChaos,
+    WireChaos,
+)
+from training_operator_tpu.utils import metrics
+
+# Base cadences at intensity 1.0, in simulated seconds (scaled down by the
+# harness's compression factor before they reach the orchestrator).
+POD_KILL_MEAN_S = 2 * 3600.0        # one pod kill every ~2 sim hours
+NODE_KILL_MEAN_S = 6 * 3600.0       # one host death every ~6 sim hours
+NODE_RECOVER_S = 1800.0             # reboot-class outage length
+SLICE_KILL_MEAN_S = 48 * 3600.0     # correlated whole-slice failure
+MAINTENANCE_PERIOD_S = 8 * 3600.0   # one slice enters maintenance
+MAINTENANCE_WINDOW_S = 3600.0       # ... for this long
+# Continuous-rate tiers at intensity 1.0 (capped after scaling).
+API_CONFLICT_RATE = 0.03
+API_DROP_RATE = 0.015
+API_DUP_RATE = 0.008
+WIRE_ERROR_RATE = 0.015
+WIRE_RESET_RATE = 0.008
+
+
+def derive_seed(soak_seed: int, tag: str) -> int:
+    """Stable per-consumer sub-seed: crc32 keeps it deterministic across
+    processes and Python versions (hash() is salted)."""
+    return zlib.crc32(f"{soak_seed}:{tag}".encode()) & 0x7FFFFFFF
+
+
+class ChaosOrchestrator:
+    def __init__(
+        self,
+        seed: int,
+        intensity: Dict[str, float],
+        sim_seconds: float,
+        compression: float = 1.0,
+        node_recover_s: Optional[float] = None,
+        failovers: Optional[int] = None,
+    ):
+        self.seed = seed
+        self.intensity = dict(intensity)
+        self.sim_seconds = sim_seconds
+        self.compression = max(1e-9, compression)
+        self.node_recover_s = (
+            node_recover_s if node_recover_s is not None
+            else NODE_RECOVER_S / self.compression
+        )
+        self.log: List[Tuple[float, str, str, str]] = []
+        # Optional callback(tier, node_names) fired BEFORE a disruption
+        # that synchronously changes pod state (maintenance drains): the
+        # harness snapshots which running jobs are affected while their
+        # pods still exist; kills leave pods frozen, so those are sampled
+        # after the fact.
+        self.pre_disrupt = None
+        # (time, seq, tier, action, arg) min-heap; seq breaks time ties
+        # deterministically.
+        self._actions: List[Tuple[float, int, str, str, Optional[str]]] = []
+        self._seq = itertools.count()
+        self._rebinds = 0
+        # Bound tier objects (attach()).
+        self.cluster = None
+        self.kubelet = None
+        self.monkey: Optional[ChaosMonkey] = None
+        self.nodes: Optional[NodeChaos] = None
+        self.api_chaos: Optional[APIChaos] = None
+        self.wire: Optional[WireChaos] = None
+        self._build_schedule(failovers)
+
+    # -- schedule construction (pure function of seed + config) ---------
+
+    def _poisson_times(self, rng: random.Random, mean_gap: float) -> List[float]:
+        out, t = [], 0.0
+        while True:
+            t += rng.expovariate(1.0 / mean_gap)
+            if t >= self.sim_seconds:
+                return out
+            out.append(t)
+
+    def _push(self, t: float, tier: str, action: str, arg: Optional[str] = None):
+        heapq.heappush(self._actions, (t, next(self._seq), tier, action, arg))
+
+    def _build_schedule(self, failovers: Optional[int]) -> None:
+        scale = self.compression
+        if self.intensity.get("pod", 0.0) > 0:
+            rng = random.Random(derive_seed(self.seed, "sched-pod"))
+            mean = POD_KILL_MEAN_S / self.intensity["pod"] / scale
+            for t in self._poisson_times(rng, mean):
+                self._push(t, "pod", "kill")
+        if self.intensity.get("node", 0.0) > 0:
+            i = self.intensity["node"]
+            rng = random.Random(derive_seed(self.seed, "sched-node"))
+            for t in self._poisson_times(rng, NODE_KILL_MEAN_S / i / scale):
+                self._push(t, "node", "kill")
+            rng = random.Random(derive_seed(self.seed, "sched-slice"))
+            for t in self._poisson_times(rng, SLICE_KILL_MEAN_S / i / scale):
+                self._push(t, "node", "kill_slice")
+            # Rolling maintenance: deterministic cadence (planned work is
+            # calendar-shaped, not Poisson), slice picked by counter.
+            period = MAINTENANCE_PERIOD_S / i / scale
+            window = MAINTENANCE_WINDOW_S / scale
+            t, k = period, 0
+            while t < self.sim_seconds:
+                self._push(t, "node", "maintenance_begin", str(k))
+                self._push(t + window, "node", "maintenance_end", str(k))
+                t += period
+                k += 1
+        if failovers is None:
+            failovers = 1 if self.intensity.get("host", 0.0) > 0 else 0
+        # The host tier is BINARY (documented in config.soak_chaos): the
+        # harness runs exactly one warm standby, so there is exactly one
+        # failover to schedule — a second would kill the promoted host
+        # with nothing left to promote.
+        failovers = min(int(failovers), 1)
+        if failovers:
+            rng = random.Random(derive_seed(self.seed, "sched-host"))
+            for k in range(failovers):
+                # Mid-soak, jittered: never at the very start or end.
+                frac = (k + 1) / (failovers + 1)
+                t = self.sim_seconds * (frac + rng.uniform(-0.08, 0.08))
+                self._push(min(max(t, 1.0), self.sim_seconds * 0.9),
+                           "host", "failover")
+        self.wire = WireChaos(
+            seed=derive_seed(self.seed, "wire"),
+            error_rate=min(0.25, WIRE_ERROR_RATE * self.intensity.get("wire", 0.0)),
+            reset_rate=min(0.25, WIRE_RESET_RATE * self.intensity.get("wire", 0.0)),
+        )
+
+    # -- binding to a (possibly promoted) cluster ------------------------
+
+    def attach(self, cluster, kubelet, victims) -> None:
+        """Bind the tier machinery to a live cluster. Called once at soak
+        start and again after each host failover (`victims` = the new
+        operator's watch queues; per-incarnation sub-seeds keep victim
+        picks deterministic across the rebind)."""
+        inc = self._rebinds
+        self._rebinds += 1
+        dead = kubelet.dead_nodes() if self.kubelet is None else (
+            self.kubelet.dead_nodes()
+        )
+        self.cluster = cluster
+        self.monkey = ChaosMonkey(
+            cluster, kubelet,
+            seed=derive_seed(self.seed, f"pod/{inc}"), budget=0,
+        )
+        self.nodes = NodeChaos(
+            cluster, kubelet,
+            seed=derive_seed(self.seed, f"node/{inc}"), budget=0,
+        )
+        if self.api_chaos is not None:
+            self.api_chaos.stop()
+        i = self.intensity.get("api", 0.0)
+        self.api_chaos = APIChaos(
+            cluster, seed=derive_seed(self.seed, f"api/{inc}"),
+            conflict_rate=min(0.25, API_CONFLICT_RATE * i),
+            drop_rate=min(0.25, API_DROP_RATE * i),
+            dup_rate=min(0.25, API_DUP_RATE * i),
+            victims=list(victims),
+        ) if i > 0 else None
+        # Worker-node death is external state: re-silence it on the new
+        # kubelet BEFORE its first heartbeat resurrects the leases.
+        if inc > 0:
+            for name in sorted(dead):
+                kubelet.kill_node(name)
+        self.kubelet = kubelet
+
+    def detach(self) -> None:
+        if self.api_chaos is not None:
+            self.api_chaos.stop()
+            self.api_chaos = None
+        if self.monkey is not None:
+            self.monkey.stop()
+        if self.nodes is not None:
+            self.nodes.stop()
+
+    # -- execution -------------------------------------------------------
+
+    def next_action_at(self) -> Optional[float]:
+        return self._actions[0][0] if self._actions else None
+
+    def _slice_ids(self) -> List[str]:
+        return sorted({
+            n.accelerator.tpu_slice
+            for n in self.cluster.api.list_refs("Node")
+            if n.accelerator.kind == "tpu" and n.accelerator.tpu_slice
+        })
+
+    def _record(self, tier: str, action: str, target: str) -> None:
+        self.log.append((self.cluster.clock.now(), tier, action, target))
+        metrics.soak_disruptions.inc(tier)
+
+    def run_due(self, now: float) -> List[str]:
+        """Execute every action due at `now`; returns the special signals
+        the HARNESS must act on ("failover") — the orchestrator cannot kill
+        the control plane it is riding on."""
+        signals: List[str] = []
+        while self._actions and self._actions[0][0] <= now:
+            _, _, tier, action, arg = heapq.heappop(self._actions)
+            if tier == "pod" and action == "kill":
+                victim = self.monkey.strike_once()
+                if victim:
+                    self._record("pod", "kill", victim)
+            elif tier == "node" and action == "kill":
+                victim = self.nodes.strike_once()
+                if victim:
+                    self._record("node", "kill", victim)
+                    self._push(now + self.node_recover_s,
+                               "node", "recover", victim)
+            elif tier == "node" and action == "recover":
+                self.nodes.recover_node(arg)
+                self._record("node", "recover", arg)
+            elif tier == "node" and action == "kill_slice":
+                slices = self._slice_ids()
+                if slices:
+                    sid = slices[
+                        random.Random(
+                            derive_seed(self.seed, f"slicepick/{now:.3f}")
+                        ).randrange(len(slices))
+                    ]
+                    members = self.nodes.kill_slice(sid)
+                    self._record("node", "kill_slice", sid)
+                    for m in members:
+                        self._push(now + self.node_recover_s,
+                                   "node", "recover", m)
+            elif tier == "node" and action == "maintenance_begin":
+                from training_operator_tpu.controllers.nodelifecycle import (
+                    drain_node,
+                )
+
+                slices = self._slice_ids()
+                if slices:
+                    sid = slices[int(arg) % len(slices)]
+                    hosts = self._slice_hosts(sid)
+                    if self.pre_disrupt is not None:
+                        self.pre_disrupt("node", hosts)
+                    for h in hosts:
+                        drain_node(self.cluster.api, h, now=now)
+                    self._record("node", "maintenance_begin", sid)
+            elif tier == "node" and action == "maintenance_end":
+                from training_operator_tpu.controllers.nodelifecycle import (
+                    uncordon_node,
+                )
+
+                slices = self._slice_ids()
+                if slices:
+                    sid = slices[int(arg) % len(slices)]
+                    for h in self._slice_hosts(sid):
+                        uncordon_node(self.cluster.api, h, now=now)
+                    self._record("node", "maintenance_end", sid)
+            elif tier == "host" and action == "failover":
+                self._record("host", "failover", "primary")
+                signals.append("failover")
+        return signals
+
+    def _slice_hosts(self, slice_id: str) -> List[str]:
+        return sorted(
+            n.metadata.name
+            for n in self.cluster.api.list_refs("Node")
+            if n.accelerator.kind == "tpu"
+            and n.accelerator.tpu_slice == slice_id
+        )
+
+    # -- replay pin ------------------------------------------------------
+
+    def replay_log(self) -> List[Tuple[float, str, str, str]]:
+        return [(round(t, 6), tier, action, target)
+                for t, tier, action, target in self.log]
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for _, tier, action, _t in self.log:
+            out[f"{tier}:{action}"] = out.get(f"{tier}:{action}", 0) + 1
+        return out

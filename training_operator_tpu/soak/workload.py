@@ -1,0 +1,337 @@
+"""Soak workload: a sustained, heavy-tailed, mixed-kind arrival process.
+
+Everything so far benched this operator with single bursts; real fleets see
+a *process*: jobs arriving continuously for days, with Pareto-tailed
+durations (most jobs are minutes, a few are many hours — the README
+tail-physics analysis), across every workload kind the stack serves, into
+ClusterQueues whose quotas are deliberately oversubscribed (PR 8's
+contention shape). This module turns one seed into that process as a
+deterministic, replayable *trace*: `build_arrival_trace` is a pure function
+of (seed, config), so two soak runs from the same seed submit byte-identical
+workloads at identical instants — the foundation of the soak's replay pin.
+
+Kinds in the mix (weights in `KIND_WEIGHTS`):
+
+  jax-sub     2x4 sub-slice JAX gang (2 hosts)          team queue
+  jax-host    1x4 single-host JAX gang                  team queue
+  jax-full    4x4 whole-slice JAX gang (4 hosts)        team queue
+  jax-multi   2-slice 4x4 multi-slice JAX gang (8 hosts) team queue
+  prod        4x4 whole-slice, high priority             prod queue
+  elastic     elastic PyTorchJob on the CPU pool (HPA-resizable)
+  mpi         MPIJob launcher + workers on the CPU pool
+  cpu         TFJob on the CPU pool
+  v2          v2 TrainJob -> per-job TrainingRuntime -> 2x4 JAX gang
+
+Every job carries `ttl_seconds_after_finished`, so terminal jobs (and their
+pods, via cascade GC) leave the store — without it a week of fleet life
+grows the object store linearly, which is exactly the accumulator class
+INV009 exists to catch.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import training_operator_tpu.api.common as capi
+from training_operator_tpu.api.common import (
+    Container,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RunPolicy,
+    SchedulingPolicy,
+)
+from training_operator_tpu.api.jobs import (
+    ElasticPolicy,
+    JAXJob,
+    MPIJob,
+    ObjectMeta,
+    PyTorchJob,
+    TFJob,
+    TPUPolicy,
+)
+from training_operator_tpu.cluster.inventory import TPU_RESOURCE
+from training_operator_tpu.cluster.runtime import ANNOTATION_SIM_DURATION
+
+# Heavy-tailed duration physics: Pareto(alpha) scaled to x_m, truncated so
+# one astronomically unlucky draw cannot outlive the whole soak. alpha=1.6
+# gives a finite mean (~2.7 x_m) with a serious tail (p99 ~ 18 x_m).
+PARETO_ALPHA = 1.6
+DURATION_XM_S = 180.0
+DURATION_CAP_S = 24 * 3600.0
+
+# Team queues submit the bulk of the load; "prod" carries the high-priority
+# wave class. Quotas are sized by the harness to oversubscribe each team
+# ~2-3x at the configured arrival rate.
+TEAM_QUEUES = ("team-a", "team-b", "team-c", "team-d")
+PROD_QUEUE = "prod"
+
+KIND_WEIGHTS = (
+    ("jax-sub", 0.26),
+    ("jax-host", 0.18),
+    ("jax-full", 0.12),
+    ("jax-multi", 0.05),
+    ("prod", 0.07),
+    ("elastic", 0.07),
+    ("mpi", 0.07),
+    ("cpu", 0.10),
+    ("v2", 0.08),
+)
+
+
+@dataclass
+class Arrival:
+    """One scheduled submission: everything needed to build the job is
+    fixed at trace time, so the trace IS the workload."""
+
+    t: float
+    kind: str
+    name: str
+    duration: float
+    queue: str
+    priority: str
+
+    def key(self) -> tuple:
+        return (round(self.t, 6), self.kind, self.name,
+                round(self.duration, 6), self.queue, self.priority)
+
+
+@dataclass
+class SoakTrace:
+    arrivals: List[Arrival] = field(default_factory=list)
+
+    def due(self, now: float, cursor: int) -> List[Arrival]:
+        out = []
+        while cursor < len(self.arrivals) and self.arrivals[cursor].t <= now:
+            out.append(self.arrivals[cursor])
+            cursor += 1
+        return out
+
+    def log(self) -> List[tuple]:
+        """The replay pin: a value-comparable view of the whole trace."""
+        return [a.key() for a in self.arrivals]
+
+
+def _pick_kind(rng: random.Random) -> str:
+    r = rng.random()
+    acc = 0.0
+    for kind, w in KIND_WEIGHTS:
+        acc += w
+        if r < acc:
+            return kind
+    return KIND_WEIGHTS[-1][0]
+
+
+def build_arrival_trace(
+    seed: int,
+    sim_seconds: float,
+    arrival_per_minute: float,
+    compression: float = 1.0,
+) -> SoakTrace:
+    """Poisson arrivals at `arrival_per_minute` over `sim_seconds`, each
+    with a truncated-Pareto duration divided by `compression`. Pure
+    function of its arguments — the replay test depends on it."""
+    rng = random.Random(seed)
+    rate = arrival_per_minute / 60.0
+    trace = SoakTrace()
+    # Tail cap relative to the soak horizon: a Pareto draw several times
+    # the whole run would make drain-phase convergence structurally
+    # impossible (a 24h job in a compressed-hour smoke can never finish) —
+    # a week-shaped run keeps the full 24h tail.
+    cap = min(DURATION_CAP_S / compression, sim_seconds * 0.25)
+    t = 0.0
+    i = 0
+    while True:
+        t += rng.expovariate(rate)
+        if t >= sim_seconds:
+            break
+        kind = _pick_kind(rng)
+        dur = DURATION_XM_S * rng.paretovariate(PARETO_ALPHA) / compression
+        dur = max(1.0, min(cap, dur))
+        if kind == "prod":
+            queue, priority = PROD_QUEUE, "high"
+            # Prod waves are deadline-shaped: shorter, never tail-deep.
+            dur = min(dur, 1800.0 / compression)
+        elif kind in ("elastic", "mpi", "cpu"):
+            queue, priority = "", "batch"  # CPU pool: unquota'd, low tier
+        else:
+            queue = TEAM_QUEUES[rng.randrange(len(TEAM_QUEUES))]
+            priority = "normal" if rng.random() < 0.85 else "batch"
+        trace.arrivals.append(Arrival(
+            t=t, kind=kind, name=f"soak-{kind}-{i:05d}", duration=dur,
+            queue=queue, priority=priority,
+        ))
+        i += 1
+    return trace
+
+
+# ---------------------------------------------------------------------------
+# Job construction
+# ---------------------------------------------------------------------------
+
+
+def _tpu_template(duration: float, cpu: float = 1.0) -> PodTemplateSpec:
+    return PodTemplateSpec(
+        containers=[Container(
+            name="jax", image="soak-trainer",
+            resources={"cpu": cpu, TPU_RESOURCE: 4.0},
+        )],
+        annotations={ANNOTATION_SIM_DURATION: f"{duration:g}"},
+    )
+
+
+def _cpu_template(duration: float, cpu: float = 1.0,
+                  name: str = "worker") -> PodTemplateSpec:
+    return PodTemplateSpec(
+        containers=[Container(
+            name=name, image="soak-worker", resources={"cpu": cpu},
+        )],
+        annotations={ANNOTATION_SIM_DURATION: f"{duration:g}"},
+    )
+
+
+def _run_policy(arrival: Arrival, ttl: int) -> RunPolicy:
+    return RunPolicy(
+        ttl_seconds_after_finished=ttl,
+        scheduling_policy=SchedulingPolicy(
+            queue=arrival.queue, priority_class=arrival.priority,
+        ),
+    )
+
+
+def build_v1_job(arrival: Arrival, ttl: int):
+    """The v1 arm of the mix; returns a submit-ready job object."""
+    a = arrival
+    if a.kind in ("jax-sub", "jax-host", "jax-full", "jax-multi", "prod"):
+        topo, workers, slices = {
+            "jax-sub": ("2x4", 2, 1),
+            "jax-host": ("1x4", 1, 1),
+            "jax-full": ("4x4", 4, 1),
+            "jax-multi": ("4x4", 8, 2),
+            "prod": ("4x4", 4, 1),
+        }[a.kind]
+        chips = 4 * workers
+        return JAXJob(
+            metadata=ObjectMeta(name=a.name),
+            replica_specs={"Worker": ReplicaSpec(
+                replicas=workers, template=_tpu_template(a.duration),
+                restart_policy=capi.RestartPolicy.EXIT_CODE,
+            )},
+            tpu_policy=TPUPolicy(
+                accelerator=f"v5e-{chips // max(1, slices)}", topology=topo,
+                num_slices=slices,
+            ),
+            run_policy=_run_policy(a, ttl),
+        )
+    if a.kind == "elastic":
+        return PyTorchJob(
+            metadata=ObjectMeta(name=a.name),
+            replica_specs={"Worker": ReplicaSpec(
+                replicas=2, template=_cpu_template(a.duration, name="pytorch"),
+                restart_policy=capi.RestartPolicy.EXIT_CODE,
+            )},
+            elastic_policy=ElasticPolicy(min_replicas=1, max_replicas=4),
+            run_policy=_run_policy(a, ttl),
+        )
+    if a.kind == "mpi":
+        return MPIJob(
+            metadata=ObjectMeta(name=a.name),
+            replica_specs={
+                "Launcher": ReplicaSpec(
+                    replicas=1,
+                    template=_cpu_template(a.duration, cpu=0.5, name="mpi"),
+                    restart_policy=capi.RestartPolicy.EXIT_CODE,
+                ),
+                "Worker": ReplicaSpec(
+                    replicas=2,
+                    template=_cpu_template(a.duration, name="mpi"),
+                    restart_policy=capi.RestartPolicy.EXIT_CODE,
+                ),
+            },
+            slots_per_worker=2,
+            run_policy=_run_policy(a, ttl),
+        )
+    if a.kind == "cpu":
+        return TFJob(
+            metadata=ObjectMeta(name=a.name),
+            replica_specs={"Worker": ReplicaSpec(
+                replicas=2, template=_cpu_template(a.duration, name="tensorflow"),
+                restart_policy=capi.RestartPolicy.EXIT_CODE,
+            )},
+            run_policy=_run_policy(a, ttl),
+        )
+    raise ValueError(f"not a v1 arrival kind: {a.kind!r}")
+
+
+def build_v2_job(arrival: Arrival):
+    """The v2 arm: a per-job namespaced TrainingRuntime carrying this job's
+    sim duration (pod annotations come from the runtime's pod template, so
+    per-job durations need per-job runtimes) plus the TrainJob referencing
+    it. Tenancy routes via the TrainJob's labels (QUEUE_LABEL /
+    PRIORITY_CLASS_LABEL, the kueue queue-name-label pattern). Returns
+    (runtime, trainjob); the harness's janitor deletes both once the
+    TrainJob is terminal (TrainJobs have no TTL field — the janitor plays
+    the user's cleanup-controller role)."""
+    from training_operator_tpu.runtime import MLPolicy, TrainJob
+    from training_operator_tpu.runtime.api import (
+        CoschedulingPolicy,
+        PodGroupPolicy,
+        ReplicatedJobTemplate,
+        RuntimeRef,
+        TrainingRuntime,
+        TrainingRuntimeSpec,
+        TRAINER_NODE,
+    )
+    from training_operator_tpu.tenancy.api import (
+        PRIORITY_CLASS_LABEL,
+        QUEUE_LABEL,
+    )
+
+    a = arrival
+    runtime = TrainingRuntime(
+        metadata=ObjectMeta(name=f"{a.name}-rt"),
+        spec=TrainingRuntimeSpec(
+            ml_policy=MLPolicy(
+                num_nodes=2,
+                tpu=TPUPolicy(accelerator="v5e-8", topology="2x4",
+                              mesh_axes={"data": 2, "fsdp": 4}),
+            ),
+            pod_group_policy=PodGroupPolicy(coscheduling=CoschedulingPolicy()),
+            template=[ReplicatedJobTemplate(
+                name=TRAINER_NODE, replicas=2,
+                template=_tpu_template(a.duration, cpu=0.5),
+            )],
+        ),
+    )
+    job = TrainJob(
+        metadata=ObjectMeta(name=a.name),
+        runtime_ref=RuntimeRef(kind=TrainingRuntime.KIND, name=f"{a.name}-rt"),
+        labels={QUEUE_LABEL: a.queue, PRIORITY_CLASS_LABEL: a.priority},
+    )
+    return runtime, job
+
+
+def tenancy_objects(team_quota_chips: float, prod_quota_chips: float):
+    """The queue/priority catalog the soak submits into: four team queues
+    with equal chip quotas (borrowing up to one extra quota each) plus the
+    prod queue, and the three priority tiers."""
+    from training_operator_tpu.tenancy import ClusterQueue, PriorityClass
+
+    objs: List[object] = [
+        PriorityClass(metadata=ObjectMeta(name="high"), value=1000),
+        PriorityClass(metadata=ObjectMeta(name="normal"), value=500,
+                      global_default=True),
+        PriorityClass(metadata=ObjectMeta(name="batch"), value=100),
+    ]
+    for team in TEAM_QUEUES:
+        objs.append(ClusterQueue(
+            metadata=ObjectMeta(name=team),
+            quota={TPU_RESOURCE: team_quota_chips},
+            borrowing_limit={TPU_RESOURCE: team_quota_chips},
+        ))
+    objs.append(ClusterQueue(
+        metadata=ObjectMeta(name=PROD_QUEUE),
+        quota={TPU_RESOURCE: prod_quota_chips},
+    ))
+    return objs

@@ -714,3 +714,32 @@ gang_preemptions = registry.counter(
     "by victim queue",
     ("queue",),
 )
+# Event retention (cluster/apiserver.py): the store's Event list is bounded
+# (the k8s events-TTL analogue); oldest records dropped past the cap.
+events_trimmed = registry.counter(
+    "training_events_trimmed_total",
+    "Event records dropped by the store's retention cap", (),
+)
+# Time-compressed fleet soak (soak/): the harness's own progress plane —
+# sustained-load runs are hours of simulated fleet life, so the epoch
+# counter and the per-tier disruption counter are how an operator (or the
+# bench artifact) sees that every tier actually fired.
+soak_epochs = registry.counter(
+    "training_soak_epochs_total",
+    "Simulated epochs completed by the soak harness", (),
+)
+soak_arrivals = registry.counter(
+    "training_soak_arrivals_total",
+    "Jobs submitted by the soak arrival process, by workload kind",
+    ("kind",),
+)
+soak_disruptions = registry.counter(
+    "training_soak_disruptions_total",
+    "Chaos injections performed by the soak orchestrator, by tier",
+    ("tier",),
+)
+soak_wire_faults = registry.counter(
+    "training_soak_wire_faults_total",
+    "Wire-tier faults injected at the in-process operator boundary, by kind",
+    ("kind",),
+)
