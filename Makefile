@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast test-wire test-chaos test-fleet test-tenancy test-failover soak-smoke lint bench bench-quick bench-solver bench-wire bench-wire-v2 bench-wire-resume bench-observe bench-audit bench-node-chaos bench-tenancy bench-failover bench-soak dryrun operator-demo ha-demo native clean
+.PHONY: test test-fast test-wire test-chaos test-fleet test-tenancy test-failover test-shards soak-smoke lint bench bench-quick bench-solver bench-wire bench-wire-v2 bench-wire-resume bench-observe bench-audit bench-node-chaos bench-tenancy bench-failover bench-shards bench-soak dryrun operator-demo ha-demo native clean
 
 test:            ## full suite (no hardware needed; ~10 min)
 	$(PY) -m pytest tests/ -q
@@ -34,6 +34,14 @@ test-tenancy:    ## the multi-tenancy lane: quotas, priority, fair share, preemp
 # crash-window store tests — no OS-process spawning, kept out of `slow`.
 test-failover:   ## control-plane failover lane (WAL standby, HostChaos, crash-safe store)
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_failover.py tests/test_store.py -q
+
+# Operator scale-out lane: shard election primitives + the takeover-CAS
+# fix, the 3-replica death-handoff burst with its single-writer pin, the
+# follower-read client against a real primary/standby pair, INV010
+# semantics, knob round-trips, and the 3-replica replica-kill soak smoke.
+test-shards:     ## operator scale-out lane (shard leases, handoff, follower reads)
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_shards.py tests/test_config_knobs.py \
+	  tests/test_soak.py -q -m "not slow" -k "not CompressedDay"
 
 # The soak smoke tier: a compressed hour of fleet life with ALL FIVE chaos
 # tiers live at once + one host failover, under the fail-fast INV001-INV009
@@ -129,6 +137,13 @@ bench-audit:     ## auditor-overhead block (one JSON line + BENCH_SELF_AUDIT art
 # for N surviving watch sessions), and steady-state replication lag.
 bench-failover:  ## control-plane failover MTTR block -> BENCH_SELF_FAILOVER artifact
 	JAX_PLATFORMS=cpu $(PY) bench.py --failover-only
+
+# Operator scale-out A/B: the same wire burst through 1/2/3 sharded
+# operator OS processes (jobs/minute vs replica count), plus the 1k-session
+# follower-read swarm (primary write p50: no sessions vs sessions-on-
+# primary vs sessions-on-standby).
+bench-shards:    ## operator scale-out block -> BENCH_SELF_SHARDS artifact
+	JAX_PLATFORMS=cpu $(PY) bench.py --shards-only
 
 # Kill one host of a whole-slice TPU gang on a virtual clock and measure
 # node-loss MTTR: detect (grace) -> evict (toleration) -> gang re-solve ->

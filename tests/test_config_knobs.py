@@ -389,3 +389,78 @@ class TestSoakKnobs:
         sc = SoakConfig.from_operator_config(cfg)
         assert sc.sim_hours == 168.0
         assert all(v == 1.0 for v in sc.chaos.values())
+
+
+class TestShardKnobs:
+    """PR 15 satellite: operator scale-out knobs ride CLI flags ->
+    OperatorConfig -> the OperatorManager / RemoteAPIServer the process
+    entry points actually construct (the make_host_store discipline)."""
+
+    def test_cli_flags_reach_the_manager(self):
+        from training_operator_tpu.__main__ import build_cluster, build_stack
+
+        args = parse_args([
+            "--operator-shards", "4",
+            "--shard-takeover-grace", "2.5",
+            "--virtual-clock",
+        ])
+        args.cluster = None
+        cfg = build_config(args)
+        assert cfg.operator_shards == 4
+        assert cfg.shard_takeover_grace == 2.5
+        cluster = build_cluster(args)
+        mgr, _v2 = build_stack(cluster, cfg)
+        try:
+            assert mgr.shard_elector is not None
+            assert mgr.num_shards == 4
+            assert mgr.shard_elector.takeover_grace == 2.5
+            # Every shard elector rides the configured grace as its lease
+            # duration (the INV010 bound).
+            assert all(
+                el.lease_duration == 2.5
+                for el in mgr.shard_elector.electors
+            )
+        finally:
+            mgr.stop()
+
+    def test_read_from_standby_reaches_the_wire_client(self):
+        from training_operator_tpu.__main__ import make_remote_api
+
+        cfg = build_config(parse_args(["--read-from-standby"]))
+        assert cfg.read_from_standby is True
+        api = make_remote_api(
+            cfg, "http://127.0.0.1:1,http://127.0.0.1:2")
+        assert api.read_from_standby is True
+        assert api.read_url == "http://127.0.0.1:2"
+        assert api.base_url == "http://127.0.0.1:1"
+        # One address: follower reads self-disable (nowhere to follow).
+        api1 = make_remote_api(cfg, "http://127.0.0.1:1")
+        assert api1.read_from_standby is False
+
+    def test_config_file_round_trip(self, tmp_path):
+        path = tmp_path / "op.json"
+        path.write_text(json.dumps({
+            "operator_shards": 3,
+            "shard_takeover_grace": 7.0,
+            "read_from_standby": True,
+        }))
+        cfg = build_config(parse_args(["--config", str(path)]))
+        assert cfg.operator_shards == 3
+        assert cfg.shard_takeover_grace == 7.0
+        assert cfg.read_from_standby is True
+        # CLI overrides the file.
+        cfg = build_config(parse_args(
+            ["--config", str(path), "--operator-shards", "5"]))
+        assert cfg.operator_shards == 5
+
+    def test_defaults_are_unsharded_primary_reads(self):
+        cfg = OperatorConfig()
+        assert cfg.operator_shards == 1
+        assert cfg.shard_takeover_grace == 10.0
+        assert cfg.read_from_standby is False
+
+    def test_validation_bounds(self):
+        with pytest.raises(ValueError):
+            OperatorConfig(operator_shards=0).validate()
+        with pytest.raises(ValueError):
+            OperatorConfig(shard_takeover_grace=0.0).validate()

@@ -225,7 +225,20 @@ class StandbyStack:
         while time.monotonic() < deadline:
             lag = self.ctrl.lag()
             if lag["connected"] and lag["records"] == 0:
-                return
+                # lag() is computed from the last page the tailer FETCHED —
+                # a write appended since then can sit invisible in the gap
+                # between its WAL append and the tailer's next apply. Ask
+                # the primary for its CURRENT head: only cursor >= head is
+                # proof of catch-up (the flake this closes predates the
+                # follower-read tests that also lean on this helper).
+                try:
+                    head = int(self.ctrl.remote.get_wal(
+                        after=self.ctrl._cursor, limit=1, timeout=0.0,
+                    ).get("head", 0))
+                except Exception:  # noqa: BLE001 — transient; retry
+                    head = None
+                if head is not None and head <= self.ctrl._cursor:
+                    return
             time.sleep(0.02)
         raise AssertionError(f"standby never caught up: {self.ctrl.lag()}")
 

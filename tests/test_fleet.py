@@ -98,7 +98,7 @@ class TestInvariantRules:
     def test_catalog_is_complete_and_unique(self):
         ids = [r.rule_id for r in RULES]
         assert ids == sorted(set(ids))
-        assert ids == [f"INV00{i}" for i in range(1, 10)]
+        assert ids == [f"INV00{i}" for i in range(1, 10)] + ["INV010"]
 
     def test_inv001_orphaned_pod(self):
         cluster = make_cluster(tpu_slices=0)
@@ -332,6 +332,138 @@ class TestInvariantRules:
         assert tl is not None
         spans = [s for s in tl["spans"] if s["name"] == "invariant"]
         assert spans and spans[0]["attrs"]["rule"] == "INV002"
+
+
+class TestInv010ShardOwnership:
+    """PR 15 satellite: the shard-ownership contract, unit-tested incident/
+    grace/heal semantics (the live exercise is the replica-kill soak smoke
+    in tests/test_soak.py and the handoff burst in tests/test_shards.py)."""
+
+    GRACE = 5.0
+
+    def _feed(self, state):
+        return lambda: {
+            "num_shards": state.get("num_shards", 2),
+            "grace": self.GRACE,
+            "claims": state["claims"],
+        }
+
+    def _shard_lease(self, api, shard, holder, renew_time, duration=None):
+        from training_operator_tpu.controllers.leader import (
+            SHARD_NAMESPACE, shard_lease_name,
+        )
+        from training_operator_tpu.cluster.objects import Lease
+
+        return api.create(Lease(
+            metadata=ObjectMeta(
+                name=shard_lease_name(shard), namespace=SHARD_NAMESPACE),
+            holder=holder, lease_duration=duration or self.GRACE,
+            acquire_time=renew_time, renew_time=renew_time,
+        ))
+
+    def test_double_claim_fires_after_grace_and_heals(self):
+        cluster = make_cluster(tpu_slices=0)
+        state = {"claims": {"op-a": [0, 1], "op-b": [1]}}
+        auditor = make_auditor(
+            cluster, sources=FleetSources(shards=self._feed(state)))
+        first, second = detect(cluster, auditor, rule_by_id("INV010").grace)
+        assert first == [], "handoff windows must ride the grace"
+        assert [v.rule for v in second] == ["INV010"]
+        assert second[0].name == "shard-1"
+        assert "op-a" in second[0].message and "op-b" in second[0].message
+        # Once per incident, not once per audit pass.
+        before = metrics.invariant_violations.value("INV010")
+        auditor.audit()
+        assert metrics.invariant_violations.value("INV010") == before
+        # Heal: the loser observed its lost lease and dropped the claim.
+        state["claims"] = {"op-a": [0], "op-b": [1]}
+        # Shard leases present and live so the unowned arm stays quiet.
+        now = cluster.clock.now()
+        self._shard_lease(cluster.api, 0, "op-a", now)
+        self._shard_lease(cluster.api, 1, "op-b", now)
+        assert auditor.audit() == []
+
+    def test_unowned_past_takeover_grace_fires(self):
+        cluster = make_cluster(tpu_slices=0)
+        now = cluster.clock.now()
+        state = {"claims": {"op-a": [0]}}  # shard 1 claimed by nobody
+        auditor = make_auditor(
+            cluster, sources=FleetSources(shards=self._feed(state)))
+        self._shard_lease(cluster.api, 0, "op-a", now + 1000.0)
+        # Shard 1's lease expired long ago: unowned_for > grace already.
+        self._shard_lease(cluster.api, 1, "op-dead", now - 100.0)
+        first, second = detect(cluster, auditor, rule_by_id("INV010").grace)
+        assert first == []
+        assert [v.rule for v in second] == ["INV010"]
+        assert second[0].name == "shard-1"
+        assert "unowned" in second[0].message
+        # Heal: a survivor adopts (claims it; lease renewed).
+        state["claims"] = {"op-a": [0, 1]}
+        assert auditor.audit() == []
+
+    def test_recently_expired_lease_is_within_grace(self):
+        """A dead replica's shard is legitimately unowned for up to the
+        takeover grace — the lease arithmetic must not condemn it early."""
+        cluster = make_cluster(tpu_slices=0)
+        now = cluster.clock.now()
+        state = {"claims": {"op-a": [0]}}
+        auditor = make_auditor(
+            cluster, sources=FleetSources(shards=self._feed(state)))
+        self._shard_lease(cluster.api, 0, "op-a", now + 1000.0)
+        # Expired JUST now: within the takeover grace, survivors still
+        # have time — not a violation no matter how long it persists
+        # unless the lease stays stale.
+        self._shard_lease(cluster.api, 1, "op-dead", now - self.GRACE - 0.5)
+        first, second = detect(cluster, auditor, rule_by_id("INV010").grace)
+        assert first == [] and second == []
+
+    def test_released_lease_ages_from_the_release_instant(self):
+        """A voluntarily released lease (rebalance handoff in flight) is
+        backdated by exactly one duration, so the unowned age counts from
+        the RELEASE — a fresh release is within the grace no matter how
+        negative renew_time looks, and a stale one is condemned."""
+        cluster = make_cluster(tpu_slices=0)
+        now = cluster.clock.now()
+        state = {"claims": {"op-a": [0]}}
+        auditor = make_auditor(
+            cluster, sources=FleetSources(shards=self._feed(state)))
+        self._shard_lease(cluster.api, 0, "op-a", now + 1000.0)
+        # Released JUST now: renew_time = release - duration.
+        self._shard_lease(cluster.api, 1, "", now - self.GRACE)
+        first, second = detect(cluster, auditor, rule_by_id("INV010").grace)
+        # After detect's clock advance the release is ~30s old > grace —
+        # the candidate appears on the SECOND pass only (first-seen), so
+        # no violation yet; a third pass past the rule grace condemns it.
+        assert first == [] and second == []
+        cluster.clock.advance(rule_by_id("INV010").grace + 0.1)
+        third = auditor.audit()
+        assert [v.rule for v in third] == ["INV010"]
+        assert "release" in third[0].message
+
+    def test_missing_lease_with_live_replicas_fires(self):
+        cluster = make_cluster(tpu_slices=0)
+        state = {"claims": {"op-a": [0]}}  # shard 1: no claim, no lease
+        auditor = make_auditor(
+            cluster, sources=FleetSources(shards=self._feed(state)))
+        now = cluster.clock.now()
+        self._shard_lease(cluster.api, 0, "op-a", now + 1000.0)
+        first, second = detect(cluster, auditor, rule_by_id("INV010").grace)
+        assert first == []
+        assert [v.rule for v in second] == ["INV010"]
+        assert "no lease" in second[0].message
+
+    def test_unsharded_and_feedless_are_clean(self):
+        cluster = make_cluster(tpu_slices=0)
+        # No feed at all.
+        auditor = make_auditor(cluster)
+        _, second = detect(cluster, auditor, rule_by_id("INV010").grace)
+        assert second == []
+        # Single shard (unsharded deployment shape).
+        state = {"num_shards": 1, "claims": {"op-a": [0]}}
+        auditor2 = make_auditor(
+            cluster, sources=FleetSources(shards=self._feed(state)))
+        _, second = detect(cluster, auditor2, rule_by_id("INV010").grace)
+        assert second == []
 
 
 # ---------------------------------------------------------------------------

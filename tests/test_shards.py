@@ -1,0 +1,688 @@
+"""Operator scale-out (PR 15): sharded reconcile ownership + follower reads.
+
+Four planes, matching the tentpole's two halves plus their satellites:
+
+  primitives     namespace->shard hashing, rendezvous ownership (minimal
+                 movement on membership change), and the LeaderElector
+                 takeover-CAS conflict fix (re-read the winner instead of
+                 flapping _set_leader)
+  shard elector  leader-per-shard leases: single-member grab-all, join
+                 rebalance, death handoff within the grace, suspect-then-
+                 confirm under clock jumps, graceful release
+  sharded manager  3 replicas over one cluster: replica death mid-burst ->
+                 survivors adopt its shards within shard_takeover_grace,
+                 every job converges, and the single-writer pin — every
+                 reconcile runs on the replica that owns the shard at that
+                 instant, with no other live replica claiming it
+  follower reads  the PR 9 warm standby serves LISTs and whole watch
+                 sessions for a `read_from_standby` client at bounded
+                 staleness (X-Training-Staleness observed client-side);
+                 writes and strong single-object reads stay on the
+                 primary; a dead standby degrades reads, never writes
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import pytest
+
+import training_operator_tpu.api.common as capi
+from training_operator_tpu.api.common import (
+    Container,
+    PodTemplateSpec,
+    ReplicaSpec,
+)
+from training_operator_tpu.api.jobs import JAXJob, ObjectMeta
+from training_operator_tpu.cluster.apiserver import APIServer, ConflictError
+from training_operator_tpu.cluster.inventory import make_cpu_pool
+from training_operator_tpu.cluster.objects import Lease
+from training_operator_tpu.cluster.runtime import (
+    ANNOTATION_SIM_DURATION,
+    Cluster,
+    DefaultScheduler,
+    SimKubelet,
+    VirtualClock,
+)
+from training_operator_tpu.controllers import JAXController, OperatorManager
+from training_operator_tpu.controllers.leader import (
+    LeaderElector,
+    ShardElector,
+    rendezvous_owner,
+    shard_lease_name,
+    shard_of,
+    SHARD_NAMESPACE,
+)
+
+
+# ---------------------------------------------------------------------------
+# Primitives
+# ---------------------------------------------------------------------------
+
+
+class TestShardPrimitives:
+    def test_shard_of_is_stable_and_in_range(self):
+        for n in (1, 2, 3, 7):
+            for ns in ("", "default", "team-a", "soak-ns-5"):
+                s = shard_of(ns, n)
+                assert 0 <= s < n
+                assert s == shard_of(ns, n)  # pure function
+        assert shard_of("anything", 1) == 0
+
+    def test_shard_of_spreads_namespaces(self):
+        shards = {shard_of(f"ns-{i}", 4) for i in range(64)}
+        assert shards == {0, 1, 2, 3}
+
+    def test_rendezvous_minimal_movement(self):
+        """Removing one member moves ONLY that member's shards — the
+        rebalance-protocol property the rendezvous hash was chosen for."""
+        members = [f"op-{i}" for i in range(5)]
+        before = {s: rendezvous_owner(s, members) for s in range(32)}
+        gone = "op-2"
+        survivors = [m for m in members if m != gone]
+        after = {s: rendezvous_owner(s, survivors) for s in range(32)}
+        for s in range(32):
+            if before[s] != gone:
+                assert after[s] == before[s], "a survivor's shard moved"
+            else:
+                assert after[s] in survivors
+
+    def test_rendezvous_deterministic_across_order(self):
+        assert rendezvous_owner(3, ["b", "a", "c"]) == rendezvous_owner(
+            3, ["c", "b", "a"]
+        )
+
+
+class TestTakeoverConflictNoFlap:
+    """Satellite: the `_try_takeover` CAS must tolerate a 409 from a
+    concurrent claimant by re-reading the winner — not by unconditionally
+    flapping `_set_leader` to False."""
+
+    def _expired_lease(self, api, now):
+        lease = Lease(
+            metadata=ObjectMeta(name="race-lease", namespace="operator-system"),
+            holder="dead-holder", lease_duration=1.0,
+            acquire_time=now - 100.0, renew_time=now - 100.0,
+        )
+        return api.create(lease)
+
+    def test_losing_claimant_stays_standby_without_callbacks(self):
+        api = APIServer()
+        clock = VirtualClock()
+        self._expired_lease(api, clock.now())
+        a = LeaderElector(api, clock.now, "op-a", lease_name="race-lease")
+        b = LeaderElector(api, clock.now, "op-b", lease_name="race-lease")
+        stops = []
+        b.on_stopped_leading.append(lambda: stops.append("b"))
+        # Both read the expired lease; A's CAS lands first, B's conflicts.
+        lease_a = api.get(Lease.KIND, "operator-system", "race-lease")
+        lease_b = api.get(Lease.KIND, "operator-system", "race-lease")
+        a._try_takeover(lease_a, clock.now())
+        assert a.is_leader
+        b._try_takeover(lease_b, clock.now())
+        assert not b.is_leader
+        assert stops == []  # was never leader; no spurious stop callback
+        assert api.get(Lease.KIND, "operator-system", "race-lease").holder == "op-a"
+
+    def test_own_racing_claim_does_not_flap(self):
+        """The 409 whose winner is US (double-tick paths: a timer and an
+        explicit tick driving one elector, a retried wire request landing
+        twice): the elector must keep/become leader, with zero
+        stopped-leading callbacks fired."""
+        api = APIServer()
+        clock = VirtualClock()
+        self._expired_lease(api, clock.now())
+        c = LeaderElector(api, clock.now, "op-c", lease_name="race-lease")
+        flaps = []
+        c.on_stopped_leading.append(lambda: flaps.append("stop"))
+        stale = api.get(Lease.KIND, "operator-system", "race-lease")
+        c._try_takeover(
+            api.get(Lease.KIND, "operator-system", "race-lease"), clock.now()
+        )
+        assert c.is_leader
+        # The stale copy's CAS conflicts — but the stored holder is c
+        # itself, so this must NOT step down.
+        with pytest.raises(ConflictError):
+            api.update(stale)  # prove the copy really is stale
+        c._try_takeover(stale, clock.now())
+        assert c.is_leader
+        assert flaps == []
+
+    def test_two_managers_race_one_winner(self):
+        """Two-elector integration arm: an expired lease contested by two
+        live electors resolves to exactly one leader and stays stable
+        across further ticks."""
+        api = APIServer()
+        clock = VirtualClock()
+        self._expired_lease(api, clock.now())
+        a = LeaderElector(api, clock.now, "op-a", lease_name="race-lease")
+        b = LeaderElector(api, clock.now, "op-b", lease_name="race-lease")
+        for _ in range(5):
+            a.tick()
+            b.tick()
+            clock.advance(0.2)
+            assert a.is_leader != b.is_leader  # exactly one, every round
+        assert a.is_leader  # first ticker won and keeps renewing
+
+
+# ---------------------------------------------------------------------------
+# ShardElector
+# ---------------------------------------------------------------------------
+
+
+def _elector(api, clock, ident, shards=4, grace=5.0):
+    return ShardElector(api, clock.now, ident, num_shards=shards,
+                        takeover_grace=grace)
+
+
+class TestShardElector:
+    def test_single_member_owns_everything(self):
+        api = APIServer()
+        clock = VirtualClock()
+        a = _elector(api, clock, "op-a")
+        assert a.tick() == frozenset(range(4))
+        assert a.claims()["shards"] == [0, 1, 2, 3]
+
+    def test_join_rebalances_to_rendezvous_assignment(self):
+        api = APIServer()
+        clock = VirtualClock()
+        a = _elector(api, clock, "op-a")
+        a.tick()
+        b = _elector(api, clock, "op-b")
+        # A few alternating ticks: releases and acquisitions settle.
+        for _ in range(4):
+            b.tick()
+            a.tick()
+            clock.advance(0.5)
+        desired = {
+            s: rendezvous_owner(s, ["op-a", "op-b"]) for s in range(4)
+        }
+        assert a.owned == frozenset(
+            s for s, o in desired.items() if o == "op-a")
+        assert b.owned == frozenset(
+            s for s, o in desired.items() if o == "op-b")
+        assert a.owned | b.owned == frozenset(range(4))
+        assert not (a.owned & b.owned)
+        assert a.rebalances > 0  # a released what b now owns
+
+    def test_death_handoff_within_grace(self):
+        api = APIServer()
+        clock = VirtualClock()
+        a = _elector(api, clock, "op-a", grace=5.0)
+        b = _elector(api, clock, "op-b", grace=5.0)
+        for _ in range(4):
+            a.tick()
+            b.tick()
+            clock.advance(0.5)
+        dead_shards = set(b.owned)
+        assert dead_shards
+        # b dies: stops ticking. Its leases expire after the grace; a
+        # needs the suspect tick plus the confirm tick past expiry.
+        t_death = clock.now()
+        adopted_at = None
+        for _ in range(40):
+            clock.advance(0.5)
+            a.tick()
+            if a.owned == frozenset(range(4)):
+                adopted_at = clock.now()
+                break
+        assert adopted_at is not None, "survivor never adopted"
+        # Handoff bound: lease expiry (<= grace after death) + the
+        # suspect/confirm tick pair.
+        assert adopted_at - t_death <= 5.0 + 2 * 0.5 + 1e-9
+        assert a.handoffs >= len(dead_shards)
+
+    def test_clock_jump_does_not_steal_live_holders_shards(self):
+        """Suspect-then-confirm: a virtual-clock jump past the grace makes
+        every lease look expired at once; the first replica to tick must
+        NOT steal a live peer's shards (the peer renews on its own tick in
+        the same round)."""
+        api = APIServer()
+        clock = VirtualClock()
+        a = _elector(api, clock, "op-a", grace=5.0)
+        b = _elector(api, clock, "op-b", grace=5.0)
+        for _ in range(4):
+            a.tick()
+            b.tick()
+            clock.advance(0.5)
+        owned_a, owned_b = set(a.owned), set(b.owned)
+        handoffs_before = a.handoffs + b.handoffs
+        clock.advance(60.0)  # way past every lease
+        for _ in range(4):
+            a.tick()
+            b.tick()
+            clock.advance(0.1)
+        assert set(a.owned) == owned_a
+        assert set(b.owned) == owned_b
+        assert a.handoffs + b.handoffs == handoffs_before
+
+    def test_release_all_hands_over_without_waiting_grace(self):
+        api = APIServer()
+        clock = VirtualClock()
+        a = _elector(api, clock, "op-a", grace=30.0)
+        b = _elector(api, clock, "op-b", grace=30.0)
+        for _ in range(4):
+            a.tick()
+            b.tick()
+            clock.advance(0.5)
+        handoffs_before = b.handoffs
+        a.release_all()
+        assert a.owned == frozenset()
+        # b adopts the released leases on ordinary ticks — no 30s wait.
+        t0 = clock.now()
+        for _ in range(6):
+            b.tick()
+            clock.advance(0.5)
+        assert b.owned == frozenset(range(4))
+        assert clock.now() - t0 < 30.0
+        # Adopting RELEASED leases is a rebalance pickup, not a death
+        # handoff: the handoff counter (and its metric) must not move.
+        assert b.handoffs == handoffs_before
+
+
+# ---------------------------------------------------------------------------
+# Sharded manager: replica death mid-burst
+# ---------------------------------------------------------------------------
+
+
+def _job(name, ns, dur="3.0"):
+    return JAXJob(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        replica_specs={"Worker": ReplicaSpec(
+            replicas=1,
+            template=PodTemplateSpec(
+                containers=[Container(name="jax", image="trainer",
+                                      resources={"cpu": 0.5})],
+                annotations={ANNOTATION_SIM_DURATION: dur},
+            ),
+        )},
+    )
+
+
+class TestShardedManagerFailover:
+    GRACE = 5.0
+
+    def _stack(self, replicas=3):
+        cluster = Cluster(VirtualClock())
+        cluster.add_nodes(make_cpu_pool(8, cpu_per_node=16.0))
+        DefaultScheduler(cluster)
+        SimKubelet(cluster)
+        seq = itertools.count()
+        events = []  # (seq, identity, key, owns, others_claim)
+        mgrs = []
+        for i in range(replicas):
+            m = OperatorManager(
+                cluster, operator_shards=replicas,
+                shard_takeover_grace=self.GRACE,
+                identity=f"op-{i}", resync_period=30.0,
+            )
+            m.register(JAXController(cluster.api))
+
+            def probe(key, _m=m, _orig=None):
+                pass
+
+            orig = m._process
+
+            def probe(key, _m=m, _orig=orig):  # noqa: F811
+                kind, nsname = key.split("|", 1)
+                ns = nsname.split("/", 1)[0]
+                shard = shard_of(ns, _m.num_shards)
+                others = [
+                    o.identity for o in mgrs
+                    if o is not _m and o._alive and shard in o.owned_shards
+                ]
+                events.append((
+                    next(seq), _m.identity, key,
+                    shard in _m.owned_shards, others,
+                ))
+                _orig(key)
+
+            m._process = probe
+            m._alive = True
+            mgrs.append(m)
+        return cluster, mgrs, events
+
+    def test_replica_death_handoff_converges_single_writer(self):
+        cluster, mgrs, events = self._stack()
+        names = []
+        for i in range(30):
+            ns = f"team-{i % 9}"
+            cluster.api.create(_job(f"j-{i}", ns))
+            names.append((ns, f"j-{i}"))
+        cluster.run_for(2.0)  # election settles; burst is in flight
+        victim = max(mgrs, key=lambda m: len(m.owned_shards))
+        stranded = set(victim.owned_shards)
+        assert stranded, "victim owned nothing; test is vacuous"
+        kill_t = cluster.clock.now()
+        kill_marker = len(events)
+        victim.kill()
+        victim._alive = False
+        survivors = [m for m in mgrs if m is not victim]
+
+        # Survivors adopt the stranded shards within the grace bound
+        # (lease expiry + the suspect/confirm tick pair).
+        adopted = cluster.run_until(
+            lambda: stranded <= set().union(
+                *(m.owned_shards for m in survivors)
+            ),
+            timeout=self.GRACE * 4,
+        )
+        assert adopted, "stranded shards were never adopted"
+        assert cluster.clock.now() - kill_t <= self.GRACE * 3
+
+        # Every job converges despite the mid-burst death.
+        done = cluster.run_until(
+            lambda: all(
+                capi.is_succeeded(cluster.api.get("JAXJob", ns, n).status)
+                for ns, n in names
+            ),
+            timeout=600,
+        )
+        assert done, "burst did not converge after the replica death"
+
+        # Single-writer pin: every reconcile ran on a replica that owned
+        # the key's shard at that instant, with NO other live replica
+        # claiming it — reconciling one job generation twice would need
+        # exactly the overlap this forbids.
+        assert events
+        for _s, ident, key, owned, others in events:
+            assert owned, f"{ident} reconciled {key} without owning its shard"
+            assert not others, (
+                f"{ident} reconciled {key} while {others} also claimed it"
+            )
+
+        # The dead replica stays silent after the kill: its ticker was
+        # removed, so no reconcile of its is recorded past the marker.
+        assert all(
+            e[1] != victim.identity for e in events[kill_marker:]
+        ), "the killed replica kept reconciling"
+
+    def test_rebalance_handoff_no_double_reconcile(self):
+        """A live rebalance (replica joins late) keeps the single-writer
+        contract: the releasing replica's queue keys for a moved shard are
+        dropped at pop, never reconciled."""
+        cluster, mgrs, events = self._stack(replicas=3)
+        names = []
+        for i in range(18):
+            ns = f"team-{i % 6}"
+            cluster.api.create(_job(f"r-{i}", ns))
+            names.append((ns, f"r-{i}"))
+        done = cluster.run_until(
+            lambda: all(
+                capi.is_succeeded(cluster.api.get("JAXJob", ns, n).status)
+                for ns, n in names
+            ),
+            timeout=600,
+        )
+        assert done
+        for _s, ident, key, owned, others in events:
+            assert owned and not others
+
+    def test_unsharded_manager_unchanged(self):
+        """operator_shards=1 keeps the exact pre-shard shape: no shard
+        elector, no shard leases, single leader election still available."""
+        cluster = Cluster(VirtualClock())
+        m = OperatorManager(cluster, operator_shards=1, leader_elect=True)
+        assert m.shard_elector is None
+        assert m.elector is not None
+        assert m.owns_namespace("anything")
+        m2 = OperatorManager(cluster)
+        assert m2.shard_elector is None and m2.elector is None
+        assert m2.owns_namespace("x")
+
+
+# ---------------------------------------------------------------------------
+# INV010 feed shape (unit semantics live in tests/test_fleet.py)
+# ---------------------------------------------------------------------------
+
+
+class TestShardClaimsFeed:
+    def test_manager_claims_shape(self):
+        cluster = Cluster(VirtualClock())
+        m = OperatorManager(cluster, operator_shards=3, identity="op-x",
+                            shard_takeover_grace=7.0)
+        cluster.step()
+        c = m.shard_claims()
+        assert c["identity"] == "op-x"
+        assert c["num_shards"] == 3
+        assert c["grace"] == 7.0
+        assert c["shards"] == [0, 1, 2]  # sole member owns everything
+
+    def test_shard_feed_aggregates(self):
+        from training_operator_tpu.__main__ import shard_feed
+
+        cluster = Cluster(VirtualClock())
+        a = OperatorManager(cluster, operator_shards=2, identity="op-a",
+                            shard_takeover_grace=3.0)
+        b = OperatorManager(cluster, operator_shards=2, identity="op-b",
+                            shard_takeover_grace=3.0)
+        for _ in range(4):
+            cluster.step()
+            cluster.clock.advance(0.5)
+        feed = shard_feed([a, b])
+        assert feed["num_shards"] == 2
+        assert feed["grace"] == 3.0
+        assert set(feed["claims"]) == {"op-a", "op-b"}
+        owned = sorted(
+            s for shards in feed["claims"].values() for s in shards
+        )
+        assert owned == [0, 1]  # disjoint and complete
+
+    def test_shard_leases_visible_in_fleet_snapshot(self):
+        from training_operator_tpu.observe.fleet import collect_fleet, render_top
+        from training_operator_tpu.__main__ import shard_feed
+        from training_operator_tpu.observe.invariants import FleetSources
+
+        cluster = Cluster(VirtualClock())
+        m = OperatorManager(cluster, operator_shards=2, identity="op-f",
+                            shard_takeover_grace=5.0)
+        cluster.step()
+        fleet = collect_fleet(
+            cluster.api, cluster.clock.now(),
+            FleetSources(shards=lambda: shard_feed([m])),
+        )
+        shards = fleet["shards"]
+        assert shards["num_shards"] == 2
+        assert shards["owners"] == {"op-f": 2}
+        assert shards["unowned"] == 0
+        assert shards["members"] == ["op-f"]
+        assert shards["claims"] == {"op-f": [0, 1]}
+        assert "shards:" in render_top(fleet)
+
+    def test_shard_handoff_timeline_spans(self):
+        from training_operator_tpu import observe
+
+        cluster = Cluster(VirtualClock())
+        prev = observe.enabled()
+        observe.set_enabled(True)
+        try:
+            m = OperatorManager(cluster, operator_shards=2, identity="op-t",
+                                shard_takeover_grace=5.0)
+            cluster.step()
+            tl = cluster.api.get_timeline("operator-system", "shard-0")
+            assert tl is not None
+            spans = [s["name"] for s in tl["spans"]]
+            assert "shard_handoff" in spans
+        finally:
+            observe.set_enabled(prev)
+
+
+# ---------------------------------------------------------------------------
+# Follower reads: the warm standby serves LISTs + watch sessions
+# ---------------------------------------------------------------------------
+
+
+class TestFollowerReads:
+    """Rides the PR 9 in-process HA pair (tests/test_failover.py stacks):
+    a `read_from_standby` client routes LISTs/fleet/events and its whole
+    watch session to the standby at bounded staleness while writes and
+    strong single-object reads stay on the primary."""
+
+    @pytest.fixture()
+    def ha_pair(self, tmp_path):
+        from tests.test_failover import PrimaryStack, StandbyStack
+
+        primary = PrimaryStack(tmp_path / "primary")
+        standby = None
+        try:
+            standby = StandbyStack(tmp_path / "standby", primary.url)
+            yield primary, standby
+        finally:
+            if standby is not None:
+                standby.shutdown()
+            primary.shutdown()
+
+    def _client(self, primary, standby, **kw):
+        from training_operator_tpu.cluster.httpapi import RemoteAPIServer
+
+        return RemoteAPIServer(
+            addresses=[primary.url, standby.url], timeout=5.0,
+            read_from_standby=True, **kw,
+        )
+
+    def test_lists_ride_standby_with_staleness_header(self, ha_pair):
+        import time as _t
+
+        from training_operator_tpu.cluster.objects import ConfigMap
+        from training_operator_tpu.utils import metrics
+
+        primary, standby = ha_pair
+        client = self._client(primary, standby)
+        assert client.base_url == primary.url      # writes
+        assert client.read_url == standby.url      # follower reads
+        for i in range(5):
+            client.create(ConfigMap(
+                metadata=ObjectMeta(name=f"fr-{i}"), data={"k": str(i)},
+            ))
+        standby.wait_caught_up()
+        before = metrics.read_staleness_seconds.count
+        deadline = _t.monotonic() + 10.0
+        got = []
+        while _t.monotonic() < deadline:
+            got = client.list("ConfigMap")
+            if len(got) >= 5:
+                break
+            _t.sleep(0.05)
+        assert len(got) >= 5
+        # The standby stamped the response: observed staleness proves the
+        # read really was served by the follower, at bounded lag.
+        assert metrics.read_staleness_seconds.count > before
+        assert metrics.read_staleness_seconds.max < 30.0
+
+    def test_primary_reads_carry_no_staleness(self, ha_pair):
+        from training_operator_tpu.cluster.httpapi import RemoteAPIServer
+        from training_operator_tpu.cluster.objects import ConfigMap
+        from training_operator_tpu.utils import metrics
+
+        primary, standby = ha_pair
+        direct = RemoteAPIServer(primary.url, timeout=5.0)
+        direct.create(ConfigMap(metadata=ObjectMeta(name="np-1"), data={}))
+        before = metrics.read_staleness_seconds.count
+        direct.list("ConfigMap")
+        direct.get("ConfigMap", "default", "np-1")
+        assert metrics.read_staleness_seconds.count == before
+
+    def test_strong_reads_and_writes_stay_on_primary(self, ha_pair):
+        """get/try_get read their own writes immediately — they ride the
+        primary, not the (possibly lagging) standby."""
+        from training_operator_tpu.cluster.objects import ConfigMap
+
+        primary, standby = ha_pair
+        client = self._client(primary, standby)
+        client.create(ConfigMap(
+            metadata=ObjectMeta(name="ryw-1"), data={"v": "1"},
+        ))
+        # Read-your-write with NO wait for replication: only the primary
+        # can guarantee this.
+        got = client.get("ConfigMap", "default", "ryw-1")
+        assert got.data["v"] == "1"
+        got.data["v"] = "2"
+        client.update(got, status_only=False)
+        assert client.get("ConfigMap", "default", "ryw-1").data["v"] == "2"
+
+    def test_watch_session_served_from_standby(self, ha_pair):
+        import time as _t
+
+        from training_operator_tpu.cluster.objects import ConfigMap
+
+        primary, standby = ha_pair
+        client = self._client(primary, standby)
+        q = client.watch(kinds=["ConfigMap"])
+        assert client.read_url == standby.url
+        # The whole SESSION lives on the standby: minted there (POST
+        # /watches rides the read channel), polled there. A session minted
+        # on the primary instead would 404 every standby poll and
+        # degenerate into a permanent heal-and-relist loop — pinned below
+        # by the session id staying constant across drains.
+        wid = client._shared_watch.watch_id
+        assert wid is not None
+        with standby.server._sessions_lock:
+            assert wid in standby.server._sessions
+        client.create(ConfigMap(metadata=ObjectMeta(name="w-1"), data={}))
+        seen = []
+        deadline = _t.monotonic() + 10.0
+        while _t.monotonic() < deadline and not any(
+            ev.obj.metadata.name == "w-1" for ev in seen
+        ):
+            seen.extend(q.drain(timeout=0.2))
+        assert any(ev.obj.metadata.name == "w-1" for ev in seen), (
+            "write to the primary never arrived via the standby session"
+        )
+        # Replicated delivery, not relist synthesis: the event carries the
+        # primary's seq (relist-synthesized events carry seq 0), and the
+        # session never healed/reopened.
+        assert all(ev.seq > 0 for ev in seen if ev.obj.metadata.name == "w-1")
+        assert client._shared_watch.watch_id == wid
+        with primary.server._sessions_lock:
+            assert not primary.server._sessions, (
+                "watch sessions leaked onto the primary"
+            )
+        client.unwatch(q)
+
+    def test_dead_standby_degrades_reads_not_writes(self, ha_pair):
+        import time as _t
+
+        from training_operator_tpu.cluster.httpapi import ApiUnavailableError
+        from training_operator_tpu.cluster.objects import ConfigMap
+
+        primary, standby = ha_pair
+        client = self._client(primary, standby)
+        client.create(ConfigMap(metadata=ObjectMeta(name="deg-1"), data={}))
+        standby.wait_caught_up()
+        assert client.list("ConfigMap")  # served by the standby
+        standby.ctrl.stop()
+        standby.server.kill()  # sever the read channel mid-life
+        # Reads degrade to the primary (one visible failure while the read
+        # channel rotates is allowed — the ordinary retry arm).
+        got = None
+        deadline = _t.monotonic() + 10.0
+        while _t.monotonic() < deadline:
+            try:
+                got = client.list("ConfigMap")
+                break
+            except ApiUnavailableError:
+                _t.sleep(0.05)
+        assert got, "reads never degraded to the primary"
+        assert client.read_url == primary.url
+        # Writes never moved off the healthy primary.
+        assert client.base_url == primary.url
+        client.create(ConfigMap(metadata=ObjectMeta(name="deg-2"), data={}))
+
+    def test_read_degrade_recovers_toward_preferred_standby(self, ha_pair):
+        """A transient read-side failure must not park reads on the
+        primary forever: after read_retry_interval the client re-probes
+        the preferred standby address."""
+        import time as _t
+
+        primary, standby = ha_pair
+        client = self._client(primary, standby)
+        standby.wait_caught_up()
+        assert client.list("ConfigMap") is not None
+        assert client.read_url == standby.url
+        # Simulate the degrade a transient standby blip causes.
+        client._rotate_read(client._read_gen)
+        assert client.read_url == primary.url
+        client.read_retry_interval = 0.05
+        _t.sleep(0.1)
+        client.list("ConfigMap")  # the re-probe rides this read
+        assert client.read_url == standby.url

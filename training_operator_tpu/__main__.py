@@ -299,6 +299,22 @@ def parse_args(argv=None) -> argparse.Namespace:
                     action="store_true", default=None,
                     help="lease-based leader election (standby until the "
                          "active operator's lease expires or is released)")
+    ap.add_argument("--operator-shards", type=int, default=None,
+                    help="partition reconcile ownership by namespace hash "
+                         "across this many operator-shard-{i} leases; every "
+                         "replica runs active for its owned shards and a "
+                         "replica death hands only ITS shards over "
+                         "(default 1 = single global leader election)")
+    ap.add_argument("--shard-takeover-grace", type=float, default=None,
+                    help="shard/membership lease duration: how long a dead "
+                         "replica's shards stay unowned before survivors "
+                         "adopt them (default 10)")
+    ap.add_argument("--read-from-standby", dest="read_from_standby",
+                    action="store_true", default=None,
+                    help="operator role: route LISTs, watch sessions, "
+                         "/fleet, events, logs, and timelines to a standby "
+                         "of the --api-server HA list (bounded staleness); "
+                         "writes and single-object reads stay on the primary")
     ap.add_argument("--leader-identity", default=None,
                     help="identity written into the lease (default: unique)")
     ap.add_argument("--leader-lease-seconds", type=float, default=None,
@@ -397,6 +413,12 @@ def build_config(args: argparse.Namespace) -> OperatorConfig:
         cfg.leader_identity = args.leader_identity
     if args.leader_lease_seconds is not None:
         cfg.leader_lease_duration = args.leader_lease_seconds
+    if args.operator_shards is not None:
+        cfg.operator_shards = args.operator_shards
+    if args.shard_takeover_grace is not None:
+        cfg.shard_takeover_grace = args.shard_takeover_grace
+    if args.read_from_standby is not None:
+        cfg.read_from_standby = args.read_from_standby
     cfg.validate()
     return cfg
 
@@ -534,6 +556,8 @@ def build_stack(cluster: Cluster, cfg: OperatorConfig):
         leader_elect=cfg.leader_elect,
         identity=cfg.leader_identity,
         lease_duration=cfg.leader_lease_duration,
+        operator_shards=cfg.operator_shards,
+        shard_takeover_grace=cfg.shard_takeover_grace,
     )
     for scheme in cfg.enabled_schemes:
         mgr.register(SCHEME_CONTROLLERS[scheme](cluster.api))
@@ -541,16 +565,37 @@ def build_stack(cluster: Cluster, cfg: OperatorConfig):
     if cfg.enable_v2:
         from training_operator_tpu.runtime.controller import TrainJobManager
 
-        v2 = TrainJobManager(cluster)
+        v2 = TrainJobManager(
+            cluster,
+            namespace_gate=(
+                mgr.owns_namespace if mgr.shard_elector is not None else None
+            ),
+        )
     from training_operator_tpu.observe import FleetSources
 
     # In-process deployment: the manager's expectation caches are local, so
-    # the auditor can watch for wedged entries (INV004) directly.
-    wire_fleet_plane(
-        cluster, cfg,
-        sources=FleetSources(expectations=mgr.unfulfilled_expectations),
-    )
+    # the auditor can watch for wedged entries (INV004) directly — and with
+    # sharded ownership, its live claims feed INV010 the same way.
+    sources = FleetSources(expectations=mgr.unfulfilled_expectations)
+    if mgr.shard_elector is not None:
+        sources.shards = lambda: shard_feed([mgr])
+    wire_fleet_plane(cluster, cfg, sources=sources)
     return mgr, v2
+
+
+def shard_feed(managers) -> dict:
+    """Aggregate live managers' shard claims into the INV010/fleet feed
+    shape — one entry per replica still alive to claim anything. Shared by
+    build_stack (the 1-replica case) and the in-process multi-replica
+    harnesses (tests, soak) so the feed shape cannot drift."""
+    claims = {}
+    num_shards, grace = 0, 0.0
+    for mgr in managers:
+        c = mgr.shard_claims()
+        claims[c["identity"]] = c["shards"]
+        num_shards = max(num_shards, int(c.get("num_shards", 0)))
+        grace = max(grace, float(c.get("grace", 0.0)))
+    return {"num_shards": num_shards, "grace": grace, "claims": claims}
 
 
 def load_workload(path: str, mgr: OperatorManager):
@@ -700,6 +745,9 @@ def make_remote_api(cfg: OperatorConfig, url: str, token: "str | None" = None,
         # Depth 0 pins ALL of v2 — including chunked LISTs — so the escape
         # hatch really reproduces v1 wire traffic, not a hybrid.
         list_page_limit=cfg.list_page_limit if cfg.wire_pipeline_depth > 0 else 0,
+        # Follower reads: with an HA endpoint list, LISTs/watches/fleet/
+        # events/logs/timelines ride a standby address at bounded staleness.
+        read_from_standby=cfg.read_from_standby,
     )
 
 
@@ -1107,6 +1155,8 @@ def run_operator(args, cfg) -> int:
         leader_elect=cfg.leader_elect,
         identity=cfg.leader_identity,
         lease_duration=cfg.leader_lease_duration,
+        operator_shards=cfg.operator_shards,
+        shard_takeover_grace=cfg.shard_takeover_grace,
         # Real concurrency only where reconciles pay wire latency.
         parallel_reconciles=min(8, cfg.controller_threads),
     )
@@ -1117,11 +1167,16 @@ def run_operator(args, cfg) -> int:
 
         # The v2 loop rides the same lease: only the elected v1 leader
         # reconciles TrainJobs (reference: one manager process owns both
-        # controller generations under one leader election).
+        # controller generations under one leader election). With operator
+        # shards, it rides the v1 manager's shard ownership instead — each
+        # TrainJob reconciled by exactly its namespace-shard's owner.
         TrainJobManager(
             runtime,
             leader_gate=(
                 (lambda: mgr.elector.is_leader) if mgr.elector is not None else None
+            ),
+            namespace_gate=(
+                mgr.owns_namespace if mgr.shard_elector is not None else None
             ),
         )
     print(f"OPERATOR_UP={cfg.leader_identity or 'anon'}", flush=True)

@@ -393,6 +393,15 @@ class ApiHTTPServer:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                # Follower reads: a standby stamps every answer with the
+                # bounded staleness it is serving at (replication lag in
+                # seconds) so clients can observe — and alert on — how far
+                # behind the primary their reads run.
+                staleness = outer.read_staleness()
+                if staleness is not None:
+                    self.send_header(
+                        "X-Training-Staleness", f"{staleness:.3f}"
+                    )
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -712,6 +721,21 @@ class ApiHTTPServer:
         if self.promote_hook is None:
             raise NotFoundError("not a standby (nothing to promote)")
         h._send(200, self.promote_hook())
+
+    def read_staleness(self) -> Optional[float]:
+        """Seconds of bounded staleness this server is serving reads at:
+        the live replication lag while acting as a standby, None when this
+        is the primary (or staleness is unknowable — no lag feed). The
+        value every response carries as X-Training-Staleness."""
+        if self.read_only_fn is None or not self.read_only_fn():
+            return None
+        lag = self.fleet_sources.replication_lag
+        if lag is None:
+            return None
+        try:
+            return max(0.0, float(lag().get("seconds", 0.0)))
+        except Exception:  # noqa: BLE001 — a sick feed must not kill reads
+            return None
 
     @property
     def resume_ring(self) -> "_ResumeRing":

@@ -549,6 +549,7 @@ class RemoteAPIServer:
         coalesce_window_ms: float = 0.0,
         list_page_limit: int = 0,
         addresses: Optional[List[str]] = None,
+        read_from_standby: bool = False,
     ):
         """`ca_file`: PEM CA bundle to verify an https host against (the
         pin on the host-minted CA, certs.mint_ca). Without it an https URL
@@ -587,6 +588,23 @@ class RemoteAPIServer:
 
         `list_page_limit` sets the page size this client's full-relist arm
         uses for chunked LISTs (limit/continue); 0 = unpaginated v1 LISTs.
+
+        `read_from_standby` (follower reads, needs 2+ `addresses`): route
+        the bulk/observe read surfaces — LISTs, the whole watch session,
+        GET /fleet, events, pod logs, timelines, metrics — to a standby
+        address, at the bounded staleness the standby advertises in its
+        X-Training-Staleness header (observed into the
+        training_read_staleness_seconds histogram client-side). The PR 9
+        standby applies the WAL in seq lockstep and owns an identical
+        resume ring, so watch sessions served there replay/dedup exactly
+        as on the primary. Writes AND the strong-read surfaces stay on the
+        primary: single-object get/try_get back the optimistic-concurrency
+        conflict arm and Lease arbitration, where a stale read would turn
+        into conflict churn or leadership flap — the same split client-go
+        makes between lister reads and direct reads. A read-address
+        transport failure falls the read channels back to the next address
+        (ultimately the primary) without rotating the write address away
+        from a healthy host.
         """
         urls = [u.rstrip("/") for u in (addresses or []) if u]
         if base_url and base_url.rstrip("/") not in urls:
@@ -602,6 +620,23 @@ class RemoteAPIServer:
         self._addr_idx = 0
         self._addr_gen = 0
         self._addr_lock = threading.Lock()
+        # Follower reads: the read channels ("read" + "watch") speak to
+        # their own address — the first address that isn't the write
+        # primary — with their own rotation generation, so a dead standby
+        # degrades reads back to the primary without touching the write
+        # path, and a write failover doesn't tear down healthy read conns.
+        self.read_from_standby = bool(read_from_standby) and len(urls) > 1
+        self._read_idx = 1 if self.read_from_standby else 0
+        # The PREFERRED read address, and a recovery timer: after a
+        # transient standby failure degrades reads to another address, a
+        # later read re-probes the preferred standby — without it, one
+        # dropped connection would silently park the whole read/watch
+        # fanout back on the primary for the client's lifetime (the exact
+        # load the feature exists to move).
+        self._read_pref = self._read_idx
+        self._read_gen = 0
+        self._read_rotated_at = 0.0
+        self.read_retry_interval = 30.0
         # Request-path trims: the URLs are parsed once and the header dict
         # is built once — a reconcile makes ~8 wire calls and a 1k-job
         # burst makes tens of thousands, so per-request urlsplit + dict
@@ -643,6 +678,13 @@ class RemoteAPIServer:
     def addresses(self) -> List[str]:
         return list(self._addresses)
 
+    @property
+    def read_url(self) -> str:
+        """The address the read channels currently speak to (the write
+        address unless follower reads are routing elsewhere)."""
+        idx = self._read_idx if self.read_from_standby else self._addr_idx
+        return self._addresses[idx]
+
     def _rotate_address(self, seen_gen: int) -> None:
         """Advance to the next address after a transport failure. Gen-
         guarded so N threads failing on the same dead host rotate ONCE,
@@ -656,7 +698,53 @@ class RemoteAPIServer:
                     "wire transport failing over to %s", self.base_url
                 )
 
+    def _rotate_read(self, seen_gen: int) -> None:
+        """The read-side twin of _rotate_address: a dead/unreachable read
+        address degrades the read channels to the next address (cycling
+        through the primary) WITHOUT rotating the write path away from a
+        healthy primary — follower reads are an optimization, never a
+        reason to fail writes over."""
+        with self._addr_lock:
+            if seen_gen == self._read_gen:
+                self._read_idx = (self._read_idx + 1) % len(self._addresses)
+                self._read_gen += 1
+                self._read_rotated_at = _time.monotonic()
+                log.warning(
+                    "follower reads failing over to %s",
+                    self._addresses[self._read_idx],
+                )
+
+    def _maybe_recover_read(self) -> None:
+        """Periodically re-probe the preferred read address after a
+        degrade: the next read rides it again; if it is still dead, that
+        read fails once, _rotate_read degrades again, and the timer
+        re-arms — bounded retry cost, unbounded recovery."""
+        if self._read_idx == self._read_pref:
+            return
+        if _time.monotonic() - self._read_rotated_at < self.read_retry_interval:
+            return
+        with self._addr_lock:
+            if (
+                self._read_idx != self._read_pref
+                and _time.monotonic() - self._read_rotated_at
+                >= self.read_retry_interval
+            ):
+                self._read_idx = self._read_pref
+                self._read_gen += 1
+                self._read_rotated_at = _time.monotonic()
+                log.info(
+                    "follower reads re-probing preferred address %s",
+                    self._addresses[self._read_idx],
+                )
+
     # -- transport ---------------------------------------------------------
+
+    def _read_channel(self) -> str:
+        """Channel for the follower-read surfaces: the dedicated "read"
+        connection (routed to the read address) when follower reads are on;
+        otherwise the ordinary main channel — no extra socket per thread
+        for the single-address deployment shape."""
+        return "read" if self.read_from_standby else "main"
 
     def _conn(self, channel: str = "main"):
         """Thread-local persistent connection (HTTP/1.1 keep-alive), one per
@@ -678,15 +766,25 @@ class RemoteAPIServer:
         rebuilt against the CURRENT address on the next call).
         """
         cached = getattr(self._local, "conn_" + channel, None)
-        gen = self._addr_gen
+        # Follower reads: the read channels resolve to the read address
+        # and are invalidated ONLY by the read-side generation; write
+        # channels only by the write-side one. Mixing both generations
+        # into one token would tear down every healthy read connection on
+        # a write failover (and vice versa) for nothing.
+        read_routed = self.read_from_standby and channel in ("read", "watch")
+        idx = self._read_idx if read_routed else self._addr_idx
+        token = (
+            ("r", self._read_gen, idx) if read_routed
+            else ("w", self._addr_gen, idx)
+        )
         if cached is not None:
             if isinstance(cached, tuple):
-                conn, conn_gen = cached
+                conn, conn_token = cached
             else:
                 # A bare connection object: the white-box test idiom
                 # (tests inject fakes without the address generation).
-                conn, conn_gen = cached, gen
-            if conn_gen == gen:
+                conn, conn_token = cached, token
+            if conn_token == token:
                 return conn
             # Address rotated since this thread's connection was built:
             # it points at the dead (or demoted) host.
@@ -694,7 +792,7 @@ class RemoteAPIServer:
                 conn.close()
             except OSError:
                 pass
-        parsed = self._parsed[self._addr_idx]
+        parsed = self._parsed[idx]
         if parsed.scheme == "https":
             conn = http.client.HTTPSConnection(
                 parsed.hostname, parsed.port, timeout=self.timeout,
@@ -708,7 +806,7 @@ class RemoteAPIServer:
         # Same delayed-ACK tax in the other direction: the request line/
         # headers and the JSON body are separate send()s too.
         conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        setattr(self._local, "conn_" + channel, (conn, gen))
+        setattr(self._local, "conn_" + channel, (conn, token))
         return conn
 
     def _drop_conn(self, channel: str = "main") -> None:
@@ -743,6 +841,10 @@ class RemoteAPIServer:
         data = json.dumps(body).encode() if body is not None else None
         headers = self._headers
         gen = self._addr_gen
+        read_routed = self.read_from_standby and channel in ("read", "watch")
+        if read_routed:
+            self._maybe_recover_read()
+        rgen = self._read_gen
 
         for attempt in (0, 1):
             try:
@@ -753,6 +855,15 @@ class RemoteAPIServer:
                 resp = conn.getresponse()
                 raw = resp.read()
                 status = resp.status
+                stale = resp.getheader("X-Training-Staleness")
+                if stale is not None and status < 400:
+                    # A standby served this read: record the bounded
+                    # staleness it advertised (the follower-read contract's
+                    # observable half).
+                    try:
+                        metrics.read_staleness_seconds.observe(float(stale))
+                    except ValueError:
+                        pass
                 break
             except (http.client.HTTPException, socket.timeout, OSError) as e:
                 self._drop_conn(channel)
@@ -786,8 +897,13 @@ class RemoteAPIServer:
                     continue
                 # HA failover: point the NEXT request (from any thread) at
                 # the next address; this one still fails — the caller's
-                # retry arm re-drives it against the rotated target.
-                self._rotate_address(gen)
+                # retry arm re-drives it against the rotated target. Read
+                # channels rotate their OWN address (back toward the
+                # primary) so a dead standby never fails writes over.
+                if read_routed:
+                    self._rotate_read(rgen)
+                else:
+                    self._rotate_address(gen)
                 raise ApiUnavailableError(f"{method} {path}: {e}") from None
 
         if status < 400:
@@ -814,7 +930,13 @@ class RemoteAPIServer:
             # A standby declining a write is "this address can't serve
             # you", not a server bug: same taxonomy as a dead socket, so
             # the failover rotation and every existing retry arm apply.
-            self._rotate_address(gen)
+            # (Read channels rotate their own side — a NotLeader can only
+            # reach them through a route the standby won't serve, and the
+            # write address must not move off a healthy primary for it.)
+            if read_routed:
+                self._rotate_read(rgen)
+            else:
+                self._rotate_address(gen)
             raise ApiUnavailableError(f"{method} {path}: {msg}")
         raise ApiServerError(f"{method} {path}: {status} {msg}")
 
@@ -867,7 +989,8 @@ class RemoteAPIServer:
         out: List[Any] = []
         while True:
             payload = self._request(
-                "GET", f"/objects/{quote_seg(kind)}", query=query or None
+                "GET", f"/objects/{quote_seg(kind)}", query=query or None,
+                channel=self._read_channel(),
             )
             out.extend(wire.decode(d) for d in payload["items"])
             token = payload.get("continue") if limit else None
@@ -965,7 +1088,7 @@ class RemoteAPIServer:
         utilization, queue depths, job/object counts, store occupancy, and
         the standing auditor's live violations. Cheap to poll — the server
         rebuilds it only when the store version or audit generation moved."""
-        return self._request("GET", "/fleet")
+        return self._request("GET", "/fleet", channel=self._read_channel())
 
     # -- replication -------------------------------------------------------
 
@@ -1001,7 +1124,8 @@ class RemoteAPIServer:
         (GET /timelines/{ns}/{name}); None when no spans were recorded."""
         try:
             return self._request(
-                "GET", f"/timelines/{ns_seg(namespace)}/{quote_seg(name)}"
+                "GET", f"/timelines/{ns_seg(namespace)}/{quote_seg(name)}",
+                channel=self._read_channel(),
             )
         except NotFoundError:
             return None
@@ -1052,7 +1176,10 @@ class RemoteAPIServer:
         query = {"since": str(since)}
         if tail is not None:
             query["tail"] = str(tail)
-        payload = self._request("GET", f"/logs/{ns_seg(namespace)}/{quote_seg(name)}", query=query)
+        payload = self._request(
+            "GET", f"/logs/{ns_seg(namespace)}/{quote_seg(name)}",
+            query=query, channel=self._read_channel(),
+        )
         return payload["lines"], payload["cursor"]
 
     def record_event(self, event: Event) -> None:
@@ -1074,5 +1201,6 @@ class RemoteAPIServer:
             query["object_name"] = object_name
         if reason:
             query["reason"] = reason
-        payload = self._request("GET", "/events", query=query or None)
+        payload = self._request("GET", "/events", query=query or None,
+                                channel=self._read_channel())
         return [wire.decode(d, Event) for d in payload["items"]]

@@ -167,6 +167,18 @@ class _SharedWatch:
                 self._open()
             return q
 
+    def _session_channel(self) -> str:
+        """The channel session-lifecycle requests (open/delete) ride. MUST
+        resolve to the same address as the poll channel ("watch"): with
+        follower reads on, a session minted on the primary but polled on
+        the standby would 404 every poll and turn the whole watch path
+        into a permanent heal-and-relist loop that leaks a session on the
+        primary per drain. The standby serves /watches by design (its
+        resume ring runs in seq lockstep), so the whole session lives
+        wherever reads are routed."""
+        fn = getattr(self._remote, "_read_channel", None)
+        return fn() if fn is not None else "main"
+
     def unsubscribe(self, q: RemoteWatchQueue) -> None:
         with self._lock:
             if q in self._subs:
@@ -174,7 +186,8 @@ class _SharedWatch:
             if not self._subs and self.watch_id is not None:
                 wid, self.watch_id = self.watch_id, None
                 try:
-                    self._remote._request("DELETE", f"/watches/{wid}")
+                    self._remote._request("DELETE", f"/watches/{wid}",
+                                          channel=self._session_channel())
                 except (NotFoundError, ApiUnavailableError, ApiServerError,
                         PermissionError):
                     pass  # server GC reaps stale sessions anyway
@@ -185,7 +198,8 @@ class _SharedWatch:
             body["resume"] = dict(self._watermarks)
             body["epoch"] = self._epoch
             body["base"] = self._base
-        payload = self._remote._request("POST", "/watches", body=body)
+        payload = self._remote._request("POST", "/watches", body=body,
+                                        channel=self._session_channel())
         self.watch_id = payload["watch_id"]
         epoch = payload.get("epoch")
         if epoch != self._epoch:
@@ -281,7 +295,8 @@ class _SharedWatch:
             # that DELETE would be a guaranteed-wasted round trip on the
             # reconnect path the bench measures.
             try:
-                self._remote._request("DELETE", f"/watches/{old}")
+                self._remote._request("DELETE", f"/watches/{old}",
+                                      channel=self._session_channel())
             except (NotFoundError, ApiUnavailableError, ApiServerError,
                     PermissionError):
                 pass

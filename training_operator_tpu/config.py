@@ -238,6 +238,28 @@ class OperatorConfig:
     # Lease duration: how long a dead leader's lease blocks takeover
     # (controller-runtime LeaseDuration; renew interval is duration/3).
     leader_lease_duration: float = 15.0
+    # Operator scale-out (controllers/leader.py ShardElector + the
+    # follower-read wire client):
+    #   operator_shards — partition reconcile ownership by namespace hash
+    #       across this many `operator-shard-{i}` leases; every replica of
+    #       the operator runs ACTIVE for its owned shards instead of one
+    #       leader reconciling everything. 1 (default) keeps the single
+    #       global leader election. Run with >= as many replicas as you
+    #       want death-tolerance; shards > replicas is fine (rendezvous
+    #       hashing spreads them).
+    #   shard_takeover_grace — shard/membership lease duration: how long a
+    #       dead replica's shards stay unowned before survivors take them
+    #       over (short = fast handoff, long = tolerance for GC pauses).
+    #       Also the INV010 bound: a shard unowned longer than this is a
+    #       standing violation.
+    #   read_from_standby — route the wire client's LISTs, watch sessions,
+    #       /fleet, events, logs, and timelines to a standby address of
+    #       the HA endpoint list (bounded staleness, X-Training-Staleness
+    #       header); writes and single-object reads (lease arbitration,
+    #       the optimistic-concurrency conflict arm) stay on the primary.
+    operator_shards: int = 1
+    shard_takeover_grace: float = 10.0
+    read_from_standby: bool = False
 
     def validate(self) -> None:
         unknown = [s for s in self.enabled_schemes if s not in ALL_SCHEMES]
@@ -314,6 +336,13 @@ class OperatorConfig:
         parse_chaos_intensity(self.soak_chaos)  # raises on a malformed spec
         if self.tenancy_max_preemptions < 0:
             raise ValueError("tenancy_max_preemptions must be >= 0")
+        if self.operator_shards < 1:
+            raise ValueError("operator_shards must be >= 1 (1 = unsharded)")
+        if self.shard_takeover_grace <= 0:
+            # A non-positive grace is a permanently expired shard lease:
+            # every replica would fight over every shard every tick —
+            # continuous handoff churn, not ownership.
+            raise ValueError("shard_takeover_grace must be > 0")
         if self.leader_lease_duration <= 0:
             # A non-positive lease is permanently expired: leadership would
             # flap between candidates every tick, each transition firing a

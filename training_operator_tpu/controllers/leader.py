@@ -1,4 +1,4 @@
-"""Leader election for the operator manager.
+"""Leader election + sharded reconcile ownership for the operator manager.
 
 Parity target: the reference manager runs controller-runtime leader election
 (`cmd/training-operator.v1/main.go` LeaderElection + LeaderElectionID
@@ -12,12 +12,28 @@ The elector is a pure tick function driven by the cluster clock — no
 threads — which makes failover deterministic under the virtual clock: stop
 renewing (process death) and any standby acquires the moment the lease
 expires.
+
+`ShardElector` generalizes this from ONE global leader to leader-PER-SHARD:
+reconcile ownership is partitioned by namespace hash (`shard_of`) across
+`operator-shard-{i}` leases, so N replicas each own a slice of the fleet
+and a replica death stops reconciling for only its shards, only until
+their leases expire. Assignment is rendezvous hashing over the LIVE member
+set (each replica renews an `operator-member-{identity}` lease, the
+membership heartbeat): on a membership change only the joining/dying
+replica's shards move — survivors keep theirs, no global reshuffle. A
+replica that observes it is no longer a shard's desired owner RELEASES the
+lease (rebalance, handoff within a tick); a replica that dies simply stops
+renewing and the desired survivor takes the lease over at expiry (death
+handoff within `shard_takeover_grace`). Both sides of that contract are
+what invariant INV010 audits: no shard claimed by two live replicas, no
+shard unowned past the grace.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Callable, List, Optional
+import zlib
+from typing import Callable, Dict, FrozenSet, List, Optional
 
 from training_operator_tpu.cluster.apiserver import (
     AlreadyExistsError,
@@ -26,10 +42,45 @@ from training_operator_tpu.cluster.apiserver import (
 )
 from training_operator_tpu.cluster.objects import Lease
 from training_operator_tpu.api.jobs import ObjectMeta
+from training_operator_tpu.utils import metrics
 
 log = logging.getLogger(__name__)
 
 DEFAULT_LEASE_NAME = "training-operator-tpu"
+
+# The shard-ownership lease vocabulary, shared with the INV010 audit rule
+# (observe/invariants.py) and the fleet collector's `shards` section: the
+# leases ARE the observable ownership record, exactly as the reference's
+# leader election is observable through its coordination.k8s.io Lease.
+SHARD_NAMESPACE = "operator-system"
+SHARD_LEASE_PREFIX = "operator-shard-"
+MEMBER_LEASE_PREFIX = "operator-member-"
+
+
+def shard_lease_name(shard: int) -> str:
+    return f"{SHARD_LEASE_PREFIX}{shard}"
+
+
+def shard_of(namespace: str, num_shards: int) -> int:
+    """Namespace -> shard index. crc32, not hash(): stable across processes
+    and Python versions, so every replica partitions identically."""
+    if num_shards <= 1:
+        return 0
+    return zlib.crc32((namespace or "").encode()) % num_shards
+
+
+def rendezvous_owner(shard: int, members) -> Optional[str]:
+    """Highest-random-weight owner of `shard` among `members` (identity
+    strings). Rendezvous hashing is the rebalance protocol: a membership
+    change moves ONLY the joining/dying member's shards — every surviving
+    (member, shard) weight is unchanged, so survivors keep what they own.
+    Sorted iteration makes weight ties deterministic across replicas."""
+    best, best_w = None, -1
+    for m in sorted(members):
+        w = zlib.crc32(f"{m}|{shard}".encode())
+        if w > best_w:
+            best, best_w = m, w
+    return best
 
 
 class LeaderElector:
@@ -62,6 +113,11 @@ class LeaderElector:
             renew_interval if renew_interval is not None else lease_duration / 3.0
         )
         self.is_leader = False
+        # True when the most recent acquisition went through the expired-
+        # lease takeover arm (a previous holder's term ended without a
+        # release) — how the ShardElector tells a death HANDOFF from an
+        # ordinary first acquisition or a rebalance pickup.
+        self.last_acquire_was_takeover = False
         self.on_started_leading: List[Callable[[], None]] = []
         self.on_stopped_leading: List[Callable[[], None]] = []
 
@@ -97,7 +153,13 @@ class LeaderElector:
                 lease = self.api.get(Lease.KIND, self.namespace, self.lease_name)
                 if lease.holder == self.identity:
                     lease.holder = ""
-                    lease.renew_time = -self.lease_duration
+                    # Backdate by exactly one duration: expired() flips True
+                    # NOW (immediate takeover, the ReleaseOnCancel intent)
+                    # while `renew_time + duration` still reads as the
+                    # release instant — so lease-age arithmetic (INV010's
+                    # unowned-past-grace bound, the fleet `age` column)
+                    # dates the vacancy from the release, not from t=0.
+                    lease.renew_time = self.now() - self.lease_duration
                     self.api.update(lease)
                 break
             except ConflictError:
@@ -125,6 +187,7 @@ class LeaderElector:
         # Anything else propagates: swallowing an unexpected create failure
         # here would turn the whole candidate fleet into silent standbys.
         log.info("leader election: %s acquired new lease", self.identity)
+        self.last_acquire_was_takeover = False
         self._set_leader(True)
 
     def _renew(self, lease: Lease, now: float) -> None:
@@ -143,19 +206,40 @@ class LeaderElector:
             self._set_leader(False)
 
     def _try_takeover(self, lease: Lease, now: float) -> None:
+        # A non-empty prior holder means a term ENDED WITHOUT a release (a
+        # dead/wedged holder) — a true takeover. holder "" is a lease the
+        # previous owner handed back voluntarily (rebalance): adopting it
+        # is an ordinary acquisition, not a death handoff.
+        was_held = bool(lease.holder)
         lease.holder = self.identity
         lease.acquire_time = now
         lease.renew_time = now
         lease.transitions += 1
         try:
             self.api.update(lease)
-        except (ConflictError, NotFoundError):  # someone else won the race
-            self._set_leader(False)
+        except (ConflictError, NotFoundError):
+            # A concurrent claimant's write landed first — but "concurrent
+            # claimant" can be OUR OWN racing claim (the host-lease timer
+            # and an explicit tick() both drive one elector; a retried wire
+            # request can land twice). Re-read to learn the actual winner
+            # instead of assuming we lost: stepping down when the lease now
+            # names us would flap _set_leader (a spurious stopped+started
+            # pair = one full expectations-clear + resync for nothing).
+            current = self.api.try_get(
+                Lease.KIND, self.namespace, self.lease_name
+            )
+            won = current is not None and current.holder == self.identity
+            if won:
+                self.last_acquire_was_takeover = was_held
+            self._set_leader(won)
             return
         log.info(
-            "leader election: %s took over expired lease (transition %d)",
-            self.identity, lease.transitions,
+            "leader election: %s %s expired lease (transition %d)",
+            self.identity,
+            "took over" if was_held else "adopted released",
+            lease.transitions,
         )
+        self.last_acquire_was_takeover = was_held
         self._set_leader(True)
 
     def _set_leader(self, leader: bool) -> None:
@@ -167,3 +251,205 @@ class LeaderElector:
                 cb()
             except Exception:
                 log.exception("leader election callback failed")
+
+
+class ShardElector:
+    """Leader-per-shard election: N `operator-shard-{i}` leases, one
+    LeaderElector each, plus a per-replica membership lease.
+
+    `tick()` is the whole protocol, driven from the manager's tick on the
+    cluster clock (no threads, deterministic under the virtual clock):
+
+      1. renew this replica's `operator-member-{identity}` lease — the
+         membership heartbeat other replicas balance against;
+      2. read the live member set (unexpired member leases);
+      3. for each shard, the rendezvous-hash owner among live members
+         claims it (acquire/renew through the version-checked lease, same
+         CAS discipline as the global elector); a replica that holds a
+         shard it is no longer the desired owner of RELEASES it, so a
+         rebalance hands the lease over within one tick of both replicas.
+
+    A dead replica stops renewing everything: its membership lease expires
+    (survivors stop assigning it shards) and its shard leases expire (the
+    newly desired owners take them over) — both within `takeover_grace`.
+    The returned owned set is the manager's dispatch filter; the manager
+    diffs consecutive returns to adopt/drop shards.
+    """
+
+    def __init__(
+        self,
+        api,
+        now_fn: Callable[[], float],
+        identity: str,
+        num_shards: int,
+        namespace: str = SHARD_NAMESPACE,
+        takeover_grace: float = 10.0,
+        renew_interval: Optional[float] = None,
+    ):
+        if num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        self.api = api
+        self.now = now_fn
+        self.identity = identity
+        self.num_shards = num_shards
+        self.namespace = namespace
+        self.takeover_grace = takeover_grace
+        self.electors: List[LeaderElector] = [
+            LeaderElector(
+                api, now_fn, identity,
+                lease_name=shard_lease_name(i), namespace=namespace,
+                lease_duration=takeover_grace, renew_interval=renew_interval,
+            )
+            for i in range(num_shards)
+        ]
+        # Membership is itself a lease only this replica ever claims; the
+        # elector machinery (create/renew/version-checked CAS) is reused
+        # verbatim — a takeover of our own expired member lease after a
+        # long stall is exactly the re-join semantics we want.
+        self._member = LeaderElector(
+            api, now_fn, identity,
+            lease_name=f"{MEMBER_LEASE_PREFIX}{identity}",
+            namespace=namespace,
+            lease_duration=takeover_grace, renew_interval=renew_interval,
+        )
+        self.owned: FrozenSet[int] = frozenset()
+        self.handoffs = 0     # shards adopted via expired-lease takeover
+        self.rebalances = 0   # shards voluntarily released to a new owner
+        # Suspect-then-confirm takeover state: shard -> (holder, renew_time)
+        # observed expired last tick. A takeover of ANOTHER holder's
+        # expired lease only proceeds when a second consecutive tick sees
+        # it still expired with the renew_time unchanged — i.e. the holder
+        # had a whole tick to renew and didn't. Without this, a virtual-
+        # clock jump (or a wall-clock stall of the whole process group)
+        # past the grace makes every lease look expired at the same
+        # instant, and whichever replica ticks first steals live holders'
+        # shards for one churn-y round of handoffs, rebalances, and
+        # double-claim windows.
+        self._suspect: Dict[int, tuple] = {}
+
+    # ------------------------------------------------------------------
+
+    def live_members(self, now: float) -> List[str]:
+        """Identities holding an unexpired membership lease. Always
+        includes self (the membership renew precedes this read in tick;
+        belt-and-braces for the first tick's create race). Member leases
+        dead for many grace periods are garbage-collected in passing —
+        identities are per-process unique, so without this every operator
+        restart would leak one expired Lease object forever."""
+        members = {self.identity}
+        for lease in self.api.list(Lease.KIND, self.namespace):
+            if not lease.metadata.name.startswith(MEMBER_LEASE_PREFIX):
+                continue
+            if lease.holder and not lease.expired(now):
+                members.add(lease.holder)
+            elif now - lease.renew_time > 10.0 * self.takeover_grace:
+                # Long-dead (or released) member record: any replica may
+                # sweep it; try_delete is idempotent across the race.
+                try:
+                    self.api.try_delete(
+                        Lease.KIND, self.namespace, lease.metadata.name
+                    )
+                except Exception:  # noqa: BLE001 — next tick retries
+                    pass
+        return sorted(members)
+
+    def tick(self) -> FrozenSet[int]:
+        """Advance membership + every shard election; returns the owned
+        shard set. Transport faults propagate — the manager tick's retry
+        arm (run_forever / the soak facade) re-drives next tick, and the
+        leases tolerate a missed renewal up to the grace."""
+        now = self.now()
+        self._member.tick()
+        members = self.live_members(now)
+        owned = set()
+        for i, el in enumerate(self.electors):
+            desired = rendezvous_owner(i, members)
+            was_leader = el.is_leader
+            if desired == self.identity:
+                if self._may_claim(i, el, now):
+                    el.tick()
+                if el.is_leader and not was_leader:
+                    if el.last_acquire_was_takeover:
+                        self.handoffs += 1
+                        metrics.shard_handoffs.inc(self.identity)
+                        log.info(
+                            "shard %d: %s took over from a dead holder",
+                            i, self.identity,
+                        )
+            elif el.is_leader:
+                # Rebalance: the desired owner moved (a replica joined or
+                # its membership healed). Release NOW so the new owner's
+                # next tick acquires without waiting out the grace.
+                el.release()
+                self.rebalances += 1
+                metrics.shard_rebalances.inc(self.identity)
+                log.info(
+                    "shard %d: %s released to rebalance toward %s",
+                    i, self.identity, desired,
+                )
+            # Not desired and not held: do NOT tick the elector — it would
+            # take over an expired lease that belongs to another member.
+            if desired != self.identity:
+                self._suspect.pop(i, None)
+            if el.is_leader:
+                owned.add(i)
+        self.owned = frozenset(owned)
+        metrics.shard_owned.set(self.identity, value=float(len(owned)))
+        return self.owned
+
+    def _may_claim(self, shard: int, el: LeaderElector, now: float) -> bool:
+        """Gate the elector's takeover arm with suspect-then-confirm (see
+        `_suspect`). Creating a missing lease, renewing our own, observing
+        an unexpired holder, and adopting a RELEASED lease (holder "") are
+        all immediately safe — only taking over another holder's expired
+        lease needs the second look."""
+        if el.is_leader:
+            self._suspect.pop(shard, None)
+            return True  # holder path: renew (or honestly lose the CAS)
+        lease = self.api.try_get(
+            Lease.KIND, self.namespace, el.lease_name
+        )
+        if (
+            lease is None
+            or not lease.holder
+            or lease.holder == self.identity
+            or not lease.expired(now)
+        ):
+            self._suspect.pop(shard, None)
+            return True
+        seen = (lease.holder, lease.renew_time)
+        if self._suspect.get(shard) == seen:
+            # Second consecutive tick, same stale renew_time: the holder
+            # really is gone (or wedged past its own renew period).
+            self._suspect.pop(shard, None)
+            return True
+        self._suspect[shard] = seen
+        return False
+
+    def release_all(self) -> None:
+        """Graceful shutdown: hand every held shard lease back (the next
+        owner adopts on its next tick instead of waiting out the grace)
+        and DELETE the membership lease — survivors rebalance immediately
+        and the per-identity record doesn't linger until the sweep."""
+        for el in self.electors:
+            if el.is_leader:
+                el.release()
+        self._member.release()
+        try:
+            self.api.try_delete(
+                Lease.KIND, self.namespace, self._member.lease_name
+            )
+        except Exception:  # noqa: BLE001 — the live_members sweep covers it
+            pass
+        self.owned = frozenset()
+        metrics.shard_owned.set(self.identity, value=0.0)
+
+    def claims(self) -> Dict[str, object]:
+        """This replica's live claim record — one entry of the INV010
+        feed (observe/invariants.FleetSources.shards)."""
+        return {
+            "identity": self.identity,
+            "shards": sorted(self.owned),
+            "num_shards": self.num_shards,
+            "grace": self.takeover_grace,
+        }

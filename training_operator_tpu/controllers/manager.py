@@ -47,6 +47,8 @@ class OperatorManager:
         resync_period: Optional[float] = 300.0,
         parallel_reconciles: int = 0,
         gang_requeue_seconds: float = 30.0,
+        operator_shards: int = 1,
+        shard_takeover_grace: float = 10.0,
     ):
         self.cluster = cluster
         self.api = cluster.api
@@ -90,19 +92,44 @@ class OperatorManager:
         # manager keeps its watch/queue quiet until it wins the lease, then
         # resyncs every job — expectations start empty and existing pods are
         # re-owned through the claim path, exactly the restart story.
+        #
+        # operator_shards > 1 generalizes this to leader-PER-SHARD: instead
+        # of one replica reconciling everything while N-1 stand idle,
+        # reconcile ownership is partitioned by namespace hash across
+        # `operator-shard-{i}` leases (controllers/leader.py ShardElector)
+        # and every replica works its owned slice. Event dispatch, the
+        # workqueue, the resync, and the orphan sweep all filter to owned
+        # shards; adoption of a shard re-primes only THAT shard's
+        # expectations and resyncs only its namespaces — no global relist.
         self.elector = None
-        if leader_elect:
-            import os
-            import uuid
+        self.shard_elector = None
+        self.num_shards = max(1, int(operator_shards))
+        self.owned_shards: frozenset = frozenset()
+        import os
+        import uuid
 
+        # Unique ACROSS processes (id() is only per-process unique, and a
+        # collision means silent split-brain).
+        self.identity = (
+            identity or f"operator-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        )
+        if self.num_shards > 1:
+            from training_operator_tpu.controllers.leader import ShardElector
+
+            self.shard_elector = ShardElector(
+                self.api,
+                cluster.clock.now,
+                self.identity,
+                num_shards=self.num_shards,
+                takeover_grace=shard_takeover_grace,
+            )
+        elif leader_elect:
             from training_operator_tpu.controllers.leader import LeaderElector
 
             self.elector = LeaderElector(
                 self.api,
                 cluster.clock.now,
-                # Unique ACROSS processes (id() is only per-process unique,
-                # and a collision means silent split-brain).
-                identity or f"operator-{os.getpid()}-{uuid.uuid4().hex[:8]}",
+                self.identity,
                 lease_duration=lease_duration,
             )
             # Order matters: expectations from a previous term reference
@@ -150,6 +177,23 @@ class OperatorManager:
             self.api.unregister_admission(kind, validate_job)
         if self.elector is not None:
             self.elector.release()
+        if self.shard_elector is not None:
+            self.shard_elector.release_all()
+            self.owned_shards = frozenset()
+
+    def kill(self) -> None:
+        """SIGKILL semantics (the replica-death chaos seam, HostChaos
+        style): detach the ticker and the watch queue so the dead replica
+        stops consuming, but release NOTHING — its membership and shard
+        leases keep their last renew_time and survivors adopt only at
+        lease expiry, exactly what a dead process looks like from the
+        store. No flushes either: in-flight buffered writes die with it."""
+        self.cluster.remove_ticker(self.tick)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+        self.api.unwatch(self._watch)
+        for kind in self.controllers:
+            self.api.unregister_admission(kind, validate_job)
 
     def register(self, controller) -> None:
         kind = controller.kind
@@ -219,6 +263,87 @@ class OperatorManager:
         for _, jc in self.controllers.values():
             jc.expectations.clear()
 
+    # -- sharded ownership ----------------------------------------------
+
+    def owns_namespace(self, namespace: str) -> bool:
+        """The dispatch filter: True when this replica owns the shard the
+        namespace hashes into (always True unsharded)."""
+        if self.shard_elector is None:
+            return True
+        from training_operator_tpu.controllers.leader import shard_of
+
+        return shard_of(namespace or "", self.num_shards) in self.owned_shards
+
+    def shard_claims(self) -> Dict[str, object]:
+        """This replica's live shard-claim record — the INV010 feed
+        (observe/invariants.FleetSources.shards aggregates one of these
+        per live replica)."""
+        if self.shard_elector is None:
+            return {"identity": self.identity, "shards": [],
+                    "num_shards": 1, "grace": 0.0}
+        return self.shard_elector.claims()
+
+    def _adopt_shards(self, shards) -> None:
+        """Shard leases were just won (death handoff or rebalance pickup):
+        the previous owners' expectations reference watch echoes THIS
+        replica may never have seen, and jobs in the shards may have moved
+        while nobody owned them. Re-prime only the adopted slice — drop
+        those shards' expectation entries and enqueue their jobs — leaving
+        every other owned shard's in-flight state untouched (no global
+        relist; the reference's whole-manager resync is the 1-shard
+        degenerate case of this). Batched: adopting a dead peer's K
+        shards in one tick lists each kind ONCE, not K times."""
+        from training_operator_tpu.controllers.leader import shard_of
+
+        gained = frozenset(shards)
+
+        def in_gained(exp_key: str) -> bool:
+            ns = exp_key.split("/", 1)[0]
+            return shard_of(ns, self.num_shards) in gained
+
+        for _, jc in self.controllers.values():
+            jc.expectations.forget_where(in_gained)
+        for kind in self.controllers:
+            try:
+                jobs = self._list_light(kind)
+            except Exception:  # noqa: BLE001 — transport fault; next resync
+                log.debug("shard adoption list of %s failed; the resync "
+                          "covers it", kind)
+                continue
+            for job in jobs:
+                ns = job.metadata.namespace
+                if shard_of(ns, self.num_shards) in gained:
+                    self.queue.add(self._key(kind, ns, job.metadata.name))
+        self._handoff_spans(gained, "adopt")
+
+    def _drop_shards(self, shards) -> None:
+        """Shard leases were lost (released in a rebalance, or taken over
+        after this replica stalled past the grace): stop reconciling them
+        NOW — the _process ownership check already gates queued keys — and
+        drop their expectation entries, which reference a watch stream
+        whose next chapters belong to the new owners."""
+        from training_operator_tpu.controllers.leader import shard_of
+
+        lost = frozenset(shards)
+
+        def in_lost(exp_key: str) -> bool:
+            ns = exp_key.split("/", 1)[0]
+            return shard_of(ns, self.num_shards) in lost
+
+        for _, jc in self.controllers.values():
+            jc.expectations.forget_where(in_lost)
+        self._handoff_spans(lost, "drop")
+
+    def _handoff_spans(self, shards, action: str) -> None:
+        if not observe.enabled():
+            return
+        now = self.cluster.clock.now()
+        for shard in sorted(shards):
+            self.api.timelines.record_span(
+                "operator-system", f"shard-{shard}", "", "shard_handoff",
+                start=now, end=now, replica=self.identity, action=action,
+            )
+
     def unfulfilled_expectations(self) -> Dict[str, float]:
         """Unfulfilled expectation ages across every registered kind,
         prefixed with the kind — the INV004 feed (observe/invariants.py):
@@ -252,6 +377,11 @@ class OperatorManager:
                 log.debug("resync list of %s failed; retried next period", kind)
                 continue
             for job in jobs:
+                # Sharded: resync only the owned slice — every shard has
+                # exactly one live resyncer, so the periodic pass can never
+                # race another replica's reconcile of the same job.
+                if not self.owns_namespace(job.metadata.namespace):
+                    continue
                 self.queue.add(self._key(
                     kind, job.metadata.namespace, job.metadata.name))
         for _, jc in self.controllers.values():
@@ -298,7 +428,12 @@ class OperatorManager:
             except Exception:  # noqa: BLE001
                 continue
             for obj in objs:
-                if obj.metadata.owner_uid:
+                # Sharded: sweep only owned namespaces — deleting another
+                # shard's orphan would race its owner's own sweep (and a
+                # mid-cascade delete it is still retrying).
+                if obj.metadata.owner_uid and self.owns_namespace(
+                    obj.metadata.namespace
+                ):
                     candidates.append((
                         kind, obj.metadata.namespace, obj.metadata.name,
                         obj.metadata.owner_uid,
@@ -327,7 +462,22 @@ class OperatorManager:
                     pass
 
     def tick(self) -> None:
-        if self.elector is not None and not self.elector.tick():
+        if self.shard_elector is not None:
+            # Sharded ownership: every replica is active for its slice.
+            # Diff consecutive owned sets; ordering matters — the gate in
+            # _process/_handle_event reads owned_shards, so it must be
+            # updated BEFORE adoption enqueues keys (or they'd be dropped)
+            # and before lost shards' events stop mattering.
+            owned = self.shard_elector.tick()
+            if owned != self.owned_shards:
+                gained = owned - self.owned_shards
+                lost = self.owned_shards - owned
+                self.owned_shards = owned
+                if lost:
+                    self._drop_shards(lost)
+                if gained:
+                    self._adopt_shards(gained)
+        elif self.elector is not None and not self.elector.tick():
             # Standby: discard events — the resync on winning re-lists
             # everything, so nothing observed here is load-bearing.
             self._watch.drain()
@@ -366,6 +516,11 @@ class OperatorManager:
             and getattr(obj.metadata, "namespace", None) not in (None, "", self.namespace)
         ):
             return  # out of scope
+        if not self.owns_namespace(getattr(obj.metadata, "namespace", "") or ""):
+            # Another replica's shard: its owner observes this same event
+            # on its own watch. Dropping it here (not merely skipping the
+            # reconcile) keeps expectations single-writer per shard.
+            return
         if kind in self.controllers:
             if ev.status_only:
                 return  # our own status write echoing back; no work to do
@@ -408,6 +563,13 @@ class OperatorManager:
         ns, name = nsname.split("/", 1)
         entry = self.controllers.get(kind)
         if entry is None:
+            return
+        if not self.owns_namespace(ns):
+            # Ownership moved between enqueue and pop (a rebalance, or the
+            # lease was taken over after a stall): the new owner's adoption
+            # resync re-enqueued this job on ITS queue — reconciling here
+            # too would be the double-reconcile INV010 exists to forbid.
+            self.queue.forget(key)
             return
         _, jc = entry
         # Queue wait is attributed BEFORE the reconcile so a slow pass does

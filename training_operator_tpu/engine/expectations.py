@@ -119,5 +119,16 @@ class ControllerExpectations:
         discarded and will never arrive."""
         self._store.clear()
 
+    def forget_where(self, pred: Callable[[str], bool]) -> int:
+        """Drop every expectation whose key matches `pred`; returns how
+        many. The shard-scoped twin of clear(): a replica adopting (or
+        losing) one reconcile shard must reset ONLY that shard's entries —
+        its other shards' watch streams had no gap, and clearing them would
+        open their creation gates mid-flight."""
+        stale = [key for key in self._store if pred(key)]
+        for key in stale:
+            del self._store[key]
+        return len(stale)
+
     def delete_expectations(self, key: str) -> None:
         self._store.pop(key, None)

@@ -194,6 +194,59 @@ def collect_fleet(api, now: float,
     if sources.replication_lag is not None:
         replication = dict(sources.replication_lag())
 
+    # Sharded operator ownership: the leases are the durable record (any
+    # deployment shape can render who owns what from the store alone); the
+    # live claims feed — present in-process — adds what leases can't say
+    # (a replica still claiming a shard it lost). One section serves
+    # GET /fleet, the gauges, `top`, and the INV010 evidence trail.
+    shard_plane = None
+    shard_leases = []
+    members = []
+    from training_operator_tpu.controllers.leader import (
+        MEMBER_LEASE_PREFIX,
+        SHARD_LEASE_PREFIX,
+        SHARD_NAMESPACE,
+    )
+
+    for lease in api.list_refs("Lease", SHARD_NAMESPACE):
+        lname = lease.metadata.name
+        if lname.startswith(SHARD_LEASE_PREFIX):
+            shard_leases.append({
+                "shard": int(lname[len(SHARD_LEASE_PREFIX):]),
+                "holder": lease.holder,
+                "expired": lease.expired(now),
+                "age": round(max(0.0, now - lease.renew_time), 1),
+            })
+        elif lname.startswith(MEMBER_LEASE_PREFIX):
+            if lease.holder and not lease.expired(now):
+                members.append(lease.holder)
+    if shard_leases or members or sources.shards is not None:
+        owners: Dict[str, int] = {}
+        for row in shard_leases:
+            if row["holder"] and not row["expired"]:
+                owners[row["holder"]] = owners.get(row["holder"], 0) + 1
+        shard_plane = {
+            "num_shards": len(shard_leases),
+            "leases": sorted(shard_leases, key=lambda r: r["shard"]),
+            "members": sorted(set(members)),
+            "owners": owners,
+            "unowned": sum(
+                1 for r in shard_leases
+                if not r["holder"] or r["expired"]
+            ),
+        }
+        if sources.shards is not None:
+            info = sources.shards()
+            shard_plane["num_shards"] = max(
+                shard_plane["num_shards"], int(info.get("num_shards", 0))
+            )
+            shard_plane["claims"] = {
+                ident: list(shards)
+                for ident, shards in sorted(
+                    (info.get("claims") or {}).items()
+                )
+            }
+
     # Gang-solver cycle stats (the training_solver_* counter families +
     # the solve-wall histogram), so `top` and the /fleet consumers see the
     # O(changed) plane without scraping /metrics separately.
@@ -234,6 +287,7 @@ def collect_fleet(api, now: float,
         "objects": api.object_counts(),
         "store": store,
         **({"replication": replication} if replication is not None else {}),
+        **({"shards": shard_plane} if shard_plane is not None else {}),
     }
 
 
@@ -466,6 +520,20 @@ def render_top(fleet: Dict[str, Any]) -> str:
         if parts:
             lines.append("")
             lines.append("store:   " + "  ".join(parts))
+
+    shards = fleet.get("shards")
+    if shards and shards.get("num_shards"):
+        owners = shards.get("owners") or {}
+        owner_str = "  ".join(
+            f"{ident}={count}" for ident, count in sorted(owners.items())
+        ) or "none"
+        lines.append("")
+        lines.append(
+            f"shards:  {shards['num_shards']} total  "
+            f"unowned {shards.get('unowned', 0)}  "
+            f"members {len(shards.get('members') or [])}  "
+            f"owned: {owner_str}"
+        )
 
     repl = fleet.get("replication")
     if repl:

@@ -45,6 +45,12 @@ reference table cannot drift against scattered registrations):
                                  workqueue, ...) holding more entries than
                                  its configured bound — under sustained
                                  load it is growing without bound
+  INV010 shard-ownership-broken  an operator reconcile shard claimed by
+                                 two LIVE replicas at once (double-
+                                 reconcile split brain), or unowned past
+                                 `shard_takeover_grace` (death-handoff
+                                 machinery failed; that slice of the
+                                 fleet is not being reconciled)
 
 Mechanics: every rule returns *candidates*; the auditor tracks first-seen
 times and reports a violation only once it has persisted past the rule's
@@ -122,6 +128,14 @@ class FleetSources:
     # StandbyController.lag(): {"role", "records", "seconds", "connected",
     # ...} — present only on a standby (or promoted ex-standby) host.
     replication_lag: Optional[Callable[[], Dict[str, Any]]] = None
+    # Sharded operator ownership (INV010): the live replicas' shard-claim
+    # records — {"num_shards": N, "grace": seconds, "claims": {identity:
+    # [shard indices]}} — aggregated from each OperatorManager.shard_claims
+    # (one per live replica in this deployment). The shard LEASES live in
+    # the store (controllers/leader.py) and carry the unowned-age evidence;
+    # the claims carry what no lease can express — two live replicas both
+    # believing they own one shard.
+    shards: Optional[Callable[[], Dict[str, Any]]] = None
     # Generic bounded-accumulator feed (INV009): name -> (size, bound) for
     # every in-memory accumulator this deployment shape is supposed to keep
     # ring/cap-bounded — the event store, the timeline LRU, the replication
@@ -483,6 +497,91 @@ register_invariant(InvariantRule(
     # means the trim machinery itself failed; the transient grace only
     # absorbs feeds sampled mid-burst (e.g. a workqueue drained per tick).
     _check_unbounded_accumulators,
+))
+
+
+def _check_shard_ownership(ctx: AuditContext) -> List[Violation]:
+    """INV010, the sharded-operator ownership contract, both directions:
+
+      split-brain   a shard claimed by >= 2 LIVE replicas at once — two
+                    reconcilers writing one job's status/pods (the lease
+                    CAS should make this impossible; a replica that kept
+                    claiming after losing its lease is exactly the bug)
+      orphaned      a shard no live replica claims whose lease has been
+                    expired longer than `shard_takeover_grace` — the
+                    death-handoff machinery failed and that slice of the
+                    fleet is not being reconciled by anyone
+
+    The double-claim side reads the live claims feed (a dead replica
+    cannot claim); the unowned side reads lease ages from the store, so
+    "past the grace" is lease arithmetic, not audit-cadence luck."""
+    from training_operator_tpu.controllers.leader import (
+        SHARD_NAMESPACE,
+        shard_lease_name,
+    )
+
+    src = ctx.sources.shards
+    if src is None:
+        return []
+    info = src()
+    n = int(info.get("num_shards", 0))
+    claims: Dict[str, Any] = info.get("claims", {}) or {}
+    if n <= 1 or not claims:
+        return []  # unsharded, or no live replicas to hold anything
+    grace = float(info.get("grace", 10.0))
+    by_shard: Dict[int, List[str]] = {}
+    for identity, shards in claims.items():
+        for s in shards:
+            by_shard.setdefault(int(s), []).append(identity)
+    out: List[Violation] = []
+    for s in sorted(by_shard):
+        owners = sorted(by_shard[s])
+        if len(owners) > 1:
+            out.append(Violation(
+                "INV010", "Shard", "", f"shard-{s}",
+                f"shard {s} claimed by {len(owners)} live replicas "
+                f"({', '.join(owners)}) — double-reconcile split brain",
+            ))
+    for s in range(n):
+        if by_shard.get(s):
+            continue
+        lease = ctx.api.try_get("Lease", SHARD_NAMESPACE, shard_lease_name(s))
+        if lease is None:
+            # Never owned at all while replicas are live: the bootstrap
+            # window; the rule grace absorbs it, persistence condemns it.
+            out.append(Violation(
+                "INV010", "Shard", "", f"shard-{s}",
+                f"shard {s} has no lease and no live claimant "
+                f"({len(claims)} replicas alive)",
+            ))
+            continue
+        # `renew_time + duration` is the instant the shard became
+        # adoptable: lease expiry for a dead holder, the release instant
+        # for a voluntary handoff (release() backdates by exactly one
+        # duration) — either way, older than the grace means the takeover/
+        # pickup machinery failed.
+        expiry = lease.renew_time + lease.lease_duration
+        unowned_for = ctx.now - expiry
+        if lease.expired(ctx.now) and unowned_for > grace:
+            out.append(Violation(
+                "INV010", "Shard", "", f"shard-{s}",
+                f"shard {s} unowned for {unowned_for:.1f}s past "
+                f"{'release' if not lease.holder else 'lease expiry'} > "
+                f"shard_takeover_grace {grace:.1f}s (last holder "
+                f"{lease.holder or '<released>'}; takeover machinery "
+                f"failed) — its namespaces are not being reconciled",
+            ))
+    return out
+
+
+register_invariant(InvariantRule(
+    "INV010",
+    "operator shard owned by two live replicas, or unowned past the grace",
+    # The transient grace absorbs legitimate handoff windows: a losing
+    # replica claims until its next tick observes the lost lease, and a
+    # dying one's shards are honestly unowned for up to takeover_grace
+    # (which the unowned arm already discounts via lease arithmetic).
+    _check_shard_ownership,
 ))
 
 

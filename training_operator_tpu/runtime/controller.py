@@ -207,15 +207,24 @@ class TrainJobManager:
         registry: Optional[PluginRegistry] = None,
         leader_gate=None,
         resync_period: Optional[float] = 300.0,
+        namespace_gate=None,
     ):
         """`leader_gate` (callable -> bool): when provided, the tick stays
         quiet unless it returns True — lets HA deployments ride the v1
         manager's lease so only the elected leader reconciles TrainJobs
         (reference: one manager process owns both controller generations
-        under one leader election)."""
+        under one leader election).
+
+        `namespace_gate` (callable namespace -> bool): the sharded-
+        ownership filter — with operator shards, this manager rides the v1
+        manager's ShardElector (OperatorManager.owns_namespace) so each
+        TrainJob is reconciled by exactly the replica owning its
+        namespace's shard, the same single-writer contract the v1 kinds
+        get."""
         self.cluster = cluster
         self.api = cluster.api
         self.leader_gate = leader_gate
+        self.namespace_gate = namespace_gate
         self.controller = TrainJobController(
             self.api, now_fn=cluster.clock.now, registry=registry
         )
@@ -264,11 +273,21 @@ class TrainJobManager:
             self._resync_pending = False
             self._last_resync = now
             for tj in self.api.list(TrainJob.KIND):
+                if self.namespace_gate is not None and not self.namespace_gate(
+                    tj.namespace
+                ):
+                    continue
                 self.queue.add(tj.key())
         for ev in self._watch.drain():
             self._handle_event(ev)
         for key in self.queue.drain(limit=256):
             ns, name = key.split("/", 1)
+            if self.namespace_gate is not None and not self.namespace_gate(ns):
+                # Ownership moved between enqueue and pop (shard handoff):
+                # the new owner's resync covers this job; reconciling here
+                # too would double-drive one generation.
+                self.queue.forget(key)
+                continue
             try:
                 self.controller.reconcile(ns, name)
             except Exception:
@@ -280,6 +299,10 @@ class TrainJobManager:
 
     def _handle_event(self, ev) -> None:
         obj = ev.obj
+        if self.namespace_gate is not None and not self.namespace_gate(
+            getattr(obj.metadata, "namespace", "") or ""
+        ):
+            return  # another replica's shard; its owner sees this event
         if ev.kind == TrainJob.KIND:
             if ev.type == "Deleted":
                 self._cascade_delete(obj)

@@ -5,9 +5,10 @@ topology. The soak harness runs the FULL stack — durable host store, wire
 fault boundary, operator manager (v1 + v2), incremental gang solver,
 tenancy arbiter, node lifecycle, WAL replication — through a sustained
 heavy-tailed arrival process on a 10k-node topology, with all five chaos
-tiers live simultaneously and the fail-fast invariant auditor (INV001–
-INV009) as the standing oracle: any invariant violation halts the run with
-a replayable seed.
+tiers live simultaneously — plus, for sharded multi-replica runs, a sixth
+disruption class that SIGKILLs one operator replica mid-soak — and the
+fail-fast invariant auditor (INV001–INV010) as the standing oracle: any
+invariant violation halts the run with a replayable seed.
 
 Time compression: `compression` C maps fleet time onto sim time — job
 durations, arrival gaps, and every control cadence are divided by C, and
@@ -133,6 +134,18 @@ class SoakConfig:
     slo_p50_ttr_s: float = 7200.0
     slo_p99_ttr_s: float = 48 * 3600.0
     slo_high_p99_ttr_s: float = 6 * 3600.0
+    # Operator scale-out: run this many sharded operator replicas (v1
+    # manager + v2 manager pairs) over the same control plane, with
+    # reconcile ownership partitioned across `operator_replicas` shard
+    # leases. 1 (default) keeps the single-manager shape byte-identical to
+    # the pre-shard soak. With > 1, the orchestrator schedules one mid-soak
+    # replica kill (the sixth disruption class, HostChaos-style SIGKILL
+    # semantics: ticker + watch detached, leases left to expire) and
+    # arrivals spread across `namespaces` namespaces so every shard
+    # carries load; INV010 audits the ownership contract live.
+    operator_replicas: int = 1
+    shard_grace_seconds: float = 600.0  # fleet seconds (sim via sim())
+    namespaces: int = 1
     # Safety rails.
     max_wall_seconds: float = 3600.0
     failovers: Optional[int] = None  # None = 1 iff chaos host tier > 0
@@ -327,6 +340,7 @@ class JobRecord:
     queue: str
     priority: str
     submitted: float  # sim time
+    namespace: str = "default"
     running: Optional[float] = None      # first Running (sim)
     last_running: Optional[float] = None  # latest Running transition (sim)
     finished: Optional[float] = None
@@ -371,8 +385,9 @@ class JobTracker:
                 self._observe(kind, obj, deleted=False)
 
     def track(self, name: str, kind: str, queue: str, priority: str,
-              submitted: float) -> None:
-        self.jobs[name] = JobRecord(kind, queue, priority, submitted)
+              submitted: float, namespace: str = "default") -> None:
+        self.jobs[name] = JobRecord(kind, queue, priority, submitted,
+                                    namespace=namespace)
 
     def _observe(self, kind: str, obj, deleted: bool,
                  now: float = 0.0) -> None:
@@ -455,17 +470,25 @@ class SoakHarness:
         self.submit_retries = 0
         self.failover_report: Optional[Dict[str, Any]] = None
         self.host_chaos = HostChaos()
+        # Identities SIGKILLed by the replica tier: the failover rebuild
+        # must not resurrect them (a dead process does not come back
+        # because the control-plane host moved).
+        self._dead_replicas: set = set()
         self._v2_live: List[str] = []  # terminal-TrainJob janitor queue
         self._arrival_cursor = 0
         c = cfg
         self.trace = wl.build_arrival_trace(
             c.seed, c.sim_seconds, c.arrival_per_minute * c.compression,
-            c.compression,
+            c.compression, namespaces=c.namespaces,
         )
         self.orch = ChaosOrchestrator(
             c.seed, c.chaos, c.sim_seconds, compression=c.compression,
             node_recover_s=c.sim(c.recover_seconds),
             failovers=c.failovers,
+            # The sixth disruption class: with a sharded replica fleet,
+            # kill one operator replica mid-soak (survivors adopt its
+            # shards within the grace; INV010 watches the whole time).
+            replica_kills=1 if c.operator_replicas > 1 else 0,
         )
         self.orch.pre_disrupt = self._open_for_nodes
         self._op_cfg = self._make_operator_config()
@@ -501,7 +524,13 @@ class SoakHarness:
         slow = resync + 2 * audit + 300.0
         out = []
         for rule in RULES:
-            if rule.rule_id in ("INV001", "INV004", "INV006"):
+            # INV010 rides the slow set too: under the virtual clock a
+            # post-kill adoption waits out the lease expiry PLUS a couple
+            # of quiescent-step timer gaps, so an "unowned past grace"
+            # candidate can legitimately exist for a beat before the
+            # survivor's confirm tick lands — persistent candidates are
+            # still condemned, exactly like the resync-healed rules.
+            if rule.rule_id in ("INV001", "INV004", "INV006", "INV010"):
                 out.append(dataclasses.replace(rule, grace=rule.grace + slow))
             else:
                 out.append(rule)
@@ -511,36 +540,60 @@ class SoakHarness:
                      standby_lag=None):
         """Cluster services + wire-faulted operator managers + fail-fast
         fleet plane on `cluster` — used for the primary at build time and
-        again for the standby at promotion."""
-        from training_operator_tpu.__main__ import wire_cluster_services
+        again for the standby at promotion. Builds `operator_replicas`
+        (v1 manager, v2 manager) pairs; with > 1 they shard reconcile
+        ownership across `operator-shard-{i}` leases and the claims feed
+        arms INV010."""
+        from training_operator_tpu.__main__ import shard_feed, wire_cluster_services
         from training_operator_tpu.observe import FleetCollector
         from training_operator_tpu.runtime.controller import TrainJobManager
 
         c = self.cfg
+        replicas = max(1, int(c.operator_replicas))
         wire_cluster_services(cluster, self._op_cfg)
         facade = WireFacade(cluster, self.orch.wire)
         facade.api.enabled = False  # boot over a healthy channel
-        mgr = OperatorManager(
-            facade, gang_enabled=True,
-            reconciles_per_tick=self._op_cfg.controller_threads,
-            resync_period=c.sim(c.resync_seconds),
-            # Event-driven admission carries the latency; the safety-net
-            # poll scales with the solver's own staleness bound, or pending
-            # jobs re-reconcile thousands of times over their hours-long
-            # quota waits.
-            gang_requeue_seconds=c.sim(c.resolve_seconds),
-        )
-        register_all(mgr)
-        v2 = TrainJobManager(facade, resync_period=c.sim(c.resync_seconds))
+        pairs: List[Tuple[OperatorManager, TrainJobManager]] = []
+        for k in range(replicas):
+            if f"soak-op-{k}" in self._dead_replicas:
+                continue  # killed earlier; a failover doesn't resurrect it
+            mgr = OperatorManager(
+                facade, gang_enabled=True,
+                reconciles_per_tick=self._op_cfg.controller_threads,
+                resync_period=c.sim(c.resync_seconds),
+                # Event-driven admission carries the latency; the safety-net
+                # poll scales with the solver's own staleness bound, or
+                # pending jobs re-reconcile thousands of times over their
+                # hours-long quota waits.
+                gang_requeue_seconds=c.sim(c.resolve_seconds),
+                operator_shards=replicas,
+                shard_takeover_grace=c.sim(c.shard_grace_seconds),
+                # Stable identities: the post-failover rebuild resumes the
+                # replicated shard leases instead of fighting them.
+                identity=f"soak-op-{k}",
+            )
+            register_all(mgr)
+            v2 = TrainJobManager(
+                facade, resync_period=c.sim(c.resync_seconds),
+                namespace_gate=(
+                    mgr.owns_namespace if mgr.shard_elector is not None
+                    else None
+                ),
+            )
+            pairs.append((mgr, v2))
         facade.api.enabled = True
         api = cluster.api
+        self.live_pairs = list(pairs)
 
         def accumulators() -> Dict[str, Tuple[int, int]]:
             out = {
                 "events": (api.event_count(), api.event_cap()),
                 "timelines": (api.timelines.count(), api.timelines.max_jobs),
                 "wal_ring": (store.wal_ring_len(), store.wal_ring),
-                "workqueue": (len(mgr.queue), c.workqueue_bound),
+                "workqueue": (
+                    sum(len(m.queue) for m, _ in self.live_pairs),
+                    c.workqueue_bound,
+                ),
             }
             if self.standby is not None and not self.standby.promoted:
                 out["standby_wal_ring"] = (
@@ -549,12 +602,22 @@ class SoakHarness:
                 )
             return out
 
+        def expectations() -> Dict[str, float]:
+            out: Dict[str, float] = {}
+            for m, _ in self.live_pairs:
+                out.update(m.unfulfilled_expectations())
+            return out
+
         sources = FleetSources(
             journal_bytes=store.journal_bytes,
             journal_bound=lambda: store.compact_max_bytes,
-            expectations=mgr.unfulfilled_expectations,
+            expectations=expectations,
             accumulators=accumulators,
             replication_lag=standby_lag,
+            shards=(
+                (lambda: shard_feed([m for m, _ in self.live_pairs]))
+                if replicas > 1 else None
+            ),
         )
         auditor = InvariantAuditor(
             api, cluster.clock.now, sources=sources,
@@ -572,7 +635,7 @@ class SoakHarness:
             cluster.schedule_after(c.sim(c.compact_check_seconds), compact_tick)
 
         cluster.schedule_after(c.sim(c.compact_check_seconds), compact_tick)
-        return facade, mgr, v2, auditor, collector
+        return facade, pairs, auditor, collector
 
     def _build_primary(self) -> None:
         c = self.cfg
@@ -595,14 +658,43 @@ class SoakHarness:
             self.clock, store, f"{self.state_dir}/standby", c)
         self.cluster = cluster
         self.store = store
-        (self.facade, self.mgr, self.v2, self.auditor,
+        (self.facade, self.pairs, self.auditor,
          self.collector) = self._build_stack(
             cluster, store, standby_lag=self.standby.lag)
         for obj in wl.tenancy_objects(c.team_quota_chips, c.prod_quota_chips):
             cluster.api.create(obj)
-        self.orch.attach(cluster, cluster.kubelet, victims=[self.mgr._watch])
+        self.orch.attach(cluster, cluster.kubelet,
+                         victims=[m._watch for m, _ in self.pairs])
         self.tracker = JobTracker(cluster.api)
         self.node_count = c.tpu_slices * 4 + c.cpu_nodes
+
+    # The submission/reporting pair: always the first LIVE replica (the
+    # sixth disruption class may have killed earlier ones).
+    @property
+    def mgr(self) -> OperatorManager:
+        return self.live_pairs[0][0]
+
+    @property
+    def v2(self):
+        return self.live_pairs[0][1]
+
+    def _kill_replica(self, pick: str) -> None:
+        """The sixth orchestrator action: SIGKILL one operator replica
+        (HostChaos seam semantics — ticker and watch detached, nothing
+        released; its membership + shard leases simply stop renewing and
+        survivors adopt the shards at lease expiry). Deterministic victim:
+        the action's arg indexes the live list, skipping the last survivor."""
+        if len(self.live_pairs) <= 1:
+            return
+        k = int(pick) % len(self.live_pairs)
+        mgr, v2 = self.live_pairs.pop(k)
+        self._dead_replicas.add(mgr.identity)
+        mgr.kill()
+        self.facade.remove_ticker(v2.tick)
+        self.cluster.api.unwatch(v2._watch)
+        log.info("soak: replica %s KILLED (%d shards stranded: %s)",
+                 mgr.identity, len(mgr.owned_shards),
+                 sorted(mgr.owned_shards))
 
     # -- submission ------------------------------------------------------
 
@@ -623,12 +715,14 @@ class SoakHarness:
             self._retry(lambda: self.v2.submit(job), arrival.name)
             self._v2_live.append(arrival.name)
             self.tracker.track(arrival.name, "v2", arrival.queue,
-                               arrival.priority, now)
+                               arrival.priority, now,
+                               namespace=arrival.namespace)
         else:
             job = wl.build_v1_job(arrival, ttl)
             self._retry(lambda: self.mgr.submit(job), arrival.name)
             self.tracker.track(arrival.name, arrival.kind, arrival.queue,
-                               arrival.priority, now)
+                               arrival.priority, now,
+                               namespace=arrival.namespace)
         metrics.soak_arrivals.inc(arrival.kind)
 
     def _janitor(self) -> None:
@@ -648,11 +742,17 @@ class SoakHarness:
             if now - rec.finished < ttl:
                 keep.append(name)
                 continue
-            api.try_delete("TrainJob", "default", name)
-            api.try_delete("TrainingRuntime", "default", f"{name}-rt")
+            api.try_delete("TrainJob", rec.namespace, name)
+            api.try_delete("TrainingRuntime", rec.namespace, f"{name}-rt")
         self._v2_live = keep
 
     # -- disruption bookkeeping ------------------------------------------
+
+    def _arrival_namespaces(self) -> List[str]:
+        n = self.cfg.namespaces
+        if n <= 1:
+            return ["default"]
+        return [f"soak-ns-{k}" for k in range(n)]
 
     def _open_for_jobs(self, tier: str, names, t: float) -> None:
         open_jobs = {d.job for d in self.disruptions if d.t_close is None}
@@ -692,7 +792,15 @@ class SoakHarness:
                 )
                 self._open_for_nodes(tier, dead)
             elif tier == "pod" and action == "kill":
-                pod = api.try_get("Pod", "default", target)
+                # Pod names are soak-unique but the kill log carries no
+                # namespace; probe the soak's own (small, fixed) namespace
+                # set instead of scanning the whole fleet's pod list per
+                # kill — at 10k nodes the scan was the hot path.
+                pod = None
+                for ns in self._arrival_namespaces():
+                    pod = api.try_get("Pod", ns, target)
+                    if pod is not None:
+                        break
                 if pod is not None:
                     jname = pod.metadata.labels.get(capi.JOB_NAME_LABEL)
                     if jname:
@@ -758,14 +866,14 @@ class SoakHarness:
         old_kubelet = self.cluster.kubelet
         self.cluster = s_cluster
         self.store = self.standby.store
-        (self.facade, self.mgr, self.v2, self.auditor,
+        (self.facade, self.pairs, self.auditor,
          self.collector) = self._build_stack(s_cluster, self.standby.store)
         # Worker-host death is external state: re-silence dead nodes on
         # the new kubelet before its first heartbeat (orchestrator.attach
         # replays the dead set it tracked on the old kubelet).
         self.orch.kubelet = old_kubelet
         self.orch.attach(s_cluster, s_cluster.kubelet,
-                         victims=[self.mgr._watch])
+                         victims=[m._watch for m, _ in self.pairs])
         self.tracker.rebind(s_cluster.api)
         # Converge until the promoted manager's first acknowledged write.
         mttr_sim = None
@@ -831,6 +939,9 @@ class SoakHarness:
             log_from = len(self.orch.log)
             signals = self.orch.run_due(now)
             self._open_disruptions(log_from)
+            for sig in signals:
+                if sig.startswith("replica_kill:"):
+                    self._kill_replica(sig.split(":", 1)[1])
             if "failover" in signals:
                 self._do_failover()
             version_before = self.cluster.api.version()
@@ -898,7 +1009,7 @@ class SoakHarness:
             "timelines": api.timelines.count(),
             "journal_bytes": self.store.journal_bytes(),
             "wal_ring": self.store.wal_ring_len(),
-            "workqueue": len(self.mgr.queue),
+            "workqueue": sum(len(m.queue) for m, _ in self.live_pairs),
             "violations": len(self.auditor.last_violations),
             "audits": self.auditor.audits,
             "disruptions": len(self.disruptions),
@@ -1009,6 +1120,20 @@ class SoakHarness:
                 "records_applied": self.standby.applied,
                 "final_lag_records": self.standby.lag_records,
             },
+            **({"shards": {
+                "replicas": c.operator_replicas,
+                "survivors": len(self.live_pairs),
+                "handoffs": sum(
+                    m.shard_elector.handoffs for m, _ in self.live_pairs
+                ),
+                "rebalances": sum(
+                    m.shard_elector.rebalances for m, _ in self.live_pairs
+                ),
+                "owned": {
+                    m.identity: sorted(m.owned_shards)
+                    for m, _ in self.live_pairs
+                },
+            }} if c.operator_replicas > 1 else {}),
         }
 
     def _by_kind(self) -> Dict[str, Dict[str, int]]:

@@ -76,6 +76,7 @@ class ChaosOrchestrator:
         compression: float = 1.0,
         node_recover_s: Optional[float] = None,
         failovers: Optional[int] = None,
+        replica_kills: int = 0,
     ):
         self.seed = seed
         self.intensity = dict(intensity)
@@ -104,7 +105,7 @@ class ChaosOrchestrator:
         self.nodes: Optional[NodeChaos] = None
         self.api_chaos: Optional[APIChaos] = None
         self.wire: Optional[WireChaos] = None
-        self._build_schedule(failovers)
+        self._build_schedule(failovers, replica_kills)
 
     # -- schedule construction (pure function of seed + config) ---------
 
@@ -119,7 +120,8 @@ class ChaosOrchestrator:
     def _push(self, t: float, tier: str, action: str, arg: Optional[str] = None):
         heapq.heappush(self._actions, (t, next(self._seq), tier, action, arg))
 
-    def _build_schedule(self, failovers: Optional[int]) -> None:
+    def _build_schedule(self, failovers: Optional[int],
+                        replica_kills: int = 0) -> None:
         scale = self.compression
         if self.intensity.get("pod", 0.0) > 0:
             rng = random.Random(derive_seed(self.seed, "sched-pod"))
@@ -159,6 +161,18 @@ class ChaosOrchestrator:
                 t = self.sim_seconds * (frac + rng.uniform(-0.08, 0.08))
                 self._push(min(max(t, 1.0), self.sim_seconds * 0.9),
                            "host", "failover")
+        # The sixth disruption class (sharded operator fleets): kill an
+        # operator REPLICA mid-soak — the HostChaos seam one layer up from
+        # the control-plane host. Scheduled like the failover (mid-soak,
+        # jittered), executed by the harness (it owns the manager objects);
+        # the arg deterministically indexes the live replica list.
+        if replica_kills > 0:
+            rng = random.Random(derive_seed(self.seed, "sched-replica"))
+            for k in range(int(replica_kills)):
+                frac = (k + 1) / (replica_kills + 1)
+                t = self.sim_seconds * (frac + rng.uniform(-0.08, 0.08))
+                self._push(min(max(t, 1.0), self.sim_seconds * 0.9),
+                           "replica", "kill", str(rng.randrange(16)))
         self.wire = WireChaos(
             seed=derive_seed(self.seed, "wire"),
             error_rate=min(0.25, WIRE_ERROR_RATE * self.intensity.get("wire", 0.0)),
@@ -289,6 +303,9 @@ class ChaosOrchestrator:
             elif tier == "host" and action == "failover":
                 self._record("host", "failover", "primary")
                 signals.append("failover")
+            elif tier == "replica" and action == "kill":
+                self._record("replica", "kill", arg)
+                signals.append(f"replica_kill:{arg}")
         return signals
 
     def _slice_hosts(self, slice_id: str) -> List[str]:

@@ -91,10 +91,15 @@ class Arrival:
     duration: float
     queue: str
     priority: str
+    # Sharded-operator soaks spread arrivals across namespaces (reconcile
+    # ownership partitions by namespace hash); the default single-namespace
+    # shape is byte-identical to the pre-shard trace.
+    namespace: str = "default"
 
     def key(self) -> tuple:
         return (round(self.t, 6), self.kind, self.name,
-                round(self.duration, 6), self.queue, self.priority)
+                round(self.duration, 6), self.queue, self.priority,
+                self.namespace)
 
 
 @dataclass
@@ -128,10 +133,14 @@ def build_arrival_trace(
     sim_seconds: float,
     arrival_per_minute: float,
     compression: float = 1.0,
+    namespaces: int = 1,
 ) -> SoakTrace:
     """Poisson arrivals at `arrival_per_minute` over `sim_seconds`, each
     with a truncated-Pareto duration divided by `compression`. Pure
-    function of its arguments — the replay test depends on it."""
+    function of its arguments — the replay test depends on it.
+    `namespaces` > 1 round-robins arrivals across `soak-ns-{k}` namespaces
+    (deterministically, by arrival index) so sharded-operator soaks load
+    every reconcile shard; 1 keeps the single-namespace default."""
     rng = random.Random(seed)
     rate = arrival_per_minute / 60.0
     trace = SoakTrace()
@@ -161,6 +170,9 @@ def build_arrival_trace(
         trace.arrivals.append(Arrival(
             t=t, kind=kind, name=f"soak-{kind}-{i:05d}", duration=dur,
             queue=queue, priority=priority,
+            namespace=(
+                "default" if namespaces <= 1 else f"soak-ns-{i % namespaces}"
+            ),
         ))
         i += 1
     return trace
@@ -213,7 +225,7 @@ def build_v1_job(arrival: Arrival, ttl: int):
         }[a.kind]
         chips = 4 * workers
         return JAXJob(
-            metadata=ObjectMeta(name=a.name),
+            metadata=ObjectMeta(name=a.name, namespace=a.namespace),
             replica_specs={"Worker": ReplicaSpec(
                 replicas=workers, template=_tpu_template(a.duration),
                 restart_policy=capi.RestartPolicy.EXIT_CODE,
@@ -226,7 +238,7 @@ def build_v1_job(arrival: Arrival, ttl: int):
         )
     if a.kind == "elastic":
         return PyTorchJob(
-            metadata=ObjectMeta(name=a.name),
+            metadata=ObjectMeta(name=a.name, namespace=a.namespace),
             replica_specs={"Worker": ReplicaSpec(
                 replicas=2, template=_cpu_template(a.duration, name="pytorch"),
                 restart_policy=capi.RestartPolicy.EXIT_CODE,
@@ -236,7 +248,7 @@ def build_v1_job(arrival: Arrival, ttl: int):
         )
     if a.kind == "mpi":
         return MPIJob(
-            metadata=ObjectMeta(name=a.name),
+            metadata=ObjectMeta(name=a.name, namespace=a.namespace),
             replica_specs={
                 "Launcher": ReplicaSpec(
                     replicas=1,
@@ -254,7 +266,7 @@ def build_v1_job(arrival: Arrival, ttl: int):
         )
     if a.kind == "cpu":
         return TFJob(
-            metadata=ObjectMeta(name=a.name),
+            metadata=ObjectMeta(name=a.name, namespace=a.namespace),
             replica_specs={"Worker": ReplicaSpec(
                 replicas=2, template=_cpu_template(a.duration, name="tensorflow"),
                 restart_policy=capi.RestartPolicy.EXIT_CODE,
@@ -290,7 +302,7 @@ def build_v2_job(arrival: Arrival):
 
     a = arrival
     runtime = TrainingRuntime(
-        metadata=ObjectMeta(name=f"{a.name}-rt"),
+        metadata=ObjectMeta(name=f"{a.name}-rt", namespace=a.namespace),
         spec=TrainingRuntimeSpec(
             ml_policy=MLPolicy(
                 num_nodes=2,
@@ -305,7 +317,7 @@ def build_v2_job(arrival: Arrival):
         ),
     )
     job = TrainJob(
-        metadata=ObjectMeta(name=a.name),
+        metadata=ObjectMeta(name=a.name, namespace=a.namespace),
         runtime_ref=RuntimeRef(kind=TrainingRuntime.KIND, name=f"{a.name}-rt"),
         labels={QUEUE_LABEL: a.queue, PRIORITY_CLASS_LABEL: a.priority},
     )

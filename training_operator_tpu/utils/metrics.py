@@ -743,3 +743,27 @@ soak_wire_faults = registry.counter(
     "Wire-tier faults injected at the in-process operator boundary, by kind",
     ("kind",),
 )
+# Operator scale-out (controllers/leader.py ShardElector + the follower-read
+# client): shard ownership per replica, how shards changed hands (takeover
+# of a dead holder's expired lease vs voluntary rebalance release), and the
+# bounded staleness observed on reads a client served from a warm standby.
+shard_owned = registry.gauge(
+    "training_shard_owned",
+    "Reconcile shards currently owned by this replica",
+    ("replica",),
+)
+shard_handoffs = registry.counter(
+    "training_shard_handoffs_total",
+    "Shards adopted by taking over a dead replica's expired lease",
+    ("replica",),
+)
+shard_rebalances = registry.counter(
+    "training_shard_rebalances_total",
+    "Shards voluntarily released toward a rebalanced desired owner",
+    ("replica",),
+)
+read_staleness_seconds = registry.histogram(
+    "training_read_staleness_seconds",
+    "Bounded staleness (X-Training-Staleness) of reads served by a standby",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+)
