@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast test-wire test-chaos test-fleet test-tenancy test-failover test-shards soak-smoke lint bench bench-quick bench-solver bench-wire bench-wire-v2 bench-wire-resume bench-observe bench-audit bench-node-chaos bench-tenancy bench-failover bench-shards bench-soak dryrun operator-demo ha-demo native clean
+.PHONY: test test-fast test-wire test-chaos test-fleet test-tenancy test-failover test-shards soak-smoke lint lockcheck-report bench bench-quick bench-solver bench-wire bench-wire-v2 bench-wire-resume bench-observe bench-audit bench-lockcheck bench-node-chaos bench-tenancy bench-failover bench-shards bench-soak dryrun operator-demo ha-demo native clean
 
 test:            ## full suite (no hardware needed; ~10 min)
 	$(PY) -m pytest tests/ -q
@@ -51,13 +51,20 @@ test-shards:     ## operator scale-out lane (shard leases, handoff, follower rea
 soak-smoke:      ## compressed-hour five-tier soak smoke (~90s, `not slow`)
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_soak.py -q -m "not slow"
 
-lint:            ## project code lint: AST discipline rules + ruff (if present)
+lint:            ## project code lint: AST discipline rules (CL001-CL011) + ruff (if present)
 	$(PY) -m training_operator_tpu.analysis.codelint training_operator_tpu
+	$(PY) -m training_operator_tpu.analysis.lockcheck training_operator_tpu
 	@if command -v ruff >/dev/null 2>&1; then \
 	  ruff check training_operator_tpu; \
 	else \
 	  echo "ruff not installed; skipping (config pinned in pyproject.toml)"; \
 	fi
+
+# The inferred lock->guarded-field map and static lock-order graph as
+# JSON — the reviewable artifact behind CL010/CL011 (an empty
+# "order_edges" means no class nests two owned locks lexically).
+lockcheck-report:  ## lock ownership + order-graph JSON from the static analyzer
+	$(PY) -m training_operator_tpu.analysis.lockcheck --report training_operator_tpu
 
 bench:           ## headline benchmark (runs the trainer block on TPU if present)
 	$(PY) bench.py
@@ -129,6 +136,13 @@ bench-observe:   ## observability-overhead block (one JSON line)
 # violation fails the lane.
 bench-audit:     ## auditor-overhead block (one JSON line + BENCH_SELF_AUDIT artifact)
 	JAX_PLATFORMS=cpu $(PY) bench.py --audit-only
+
+# Lock-order witness on vs off over the same 120-job gang burst (the
+# bench-audit method): self-timed _note_acquire share decides the <2%
+# budget; the on-arm runs with witness fail-fast, so a single
+# acquisition-order cycle fails the lane.
+bench-lockcheck: ## witness-overhead block (one JSON line + BENCH_SELF_LOCKCHECK artifact)
+	JAX_PLATFORMS=cpu $(PY) bench.py --lockcheck-only
 
 # Kill the primary host mid 120-job burst on real sockets: standby tails
 # the WAL, auto-promotes on lease expiry, converges the burst under the

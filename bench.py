@@ -39,9 +39,17 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import random
 import sys
 import time
+
+# The lock-order witness is OFF in benches unless the --lockcheck arm is
+# requested; utils/locks.py samples the env once at import, and the
+# package imports right below construct module-level locks, so the flag
+# must be set before them.
+if "--lockcheck" in sys.argv or "--lockcheck-only" in sys.argv:
+    os.environ.setdefault("TRAINING_LOCKCHECK", "1")
 
 import training_operator_tpu.api.common as capi
 from training_operator_tpu.api.common import Container, JobConditionType, PodTemplateSpec, ReplicaSpec
@@ -1250,6 +1258,100 @@ def run_audit_overhead(n_jobs: int = 120, pairs: int = 5, seed: int = 11):
         },
         "burst_audit": audited.get("audit"),
         "violations": (audited.get("audit") or {}).get("violations", 0),
+        "overhead_pct": round(100 * direct_share, 3),
+        "under_2pct": direct_share < 0.02,
+    }
+
+
+def run_lockcheck_overhead(n_jobs: int = 120, pairs: int = 5, seed: int = 11):
+    """The `lockcheck` bench block (the run_audit_overhead method, applied
+    to the runtime lock-order witness): the SAME 120-job gang burst with
+    the witness off vs on, overhead reported two ways —
+
+    - direct: every `_note_acquire` call self-timed during one witnessed
+      burst; `overhead_pct` is that time as a share of the burst wall.
+      Deterministic and conservative (probe cost charged to the witness).
+      This is the number the <2% acceptance budget reads.
+    - wall pairs: alternating off/on pairs, median per-pair ratio with
+      spread. The off-arm is wrapper-resident (locks were constructed
+      under TRAINING_LOCKCHECK=1, so disabling leaves one flag check per
+      acquire) — an upper bound on true production, where the factories
+      return raw primitives outright.
+
+    The witnessed legs run with witness fail-fast, so the block doubles as
+    the lock-order regression gate: one acquisition-order cycle anywhere
+    in the burst raises out of the acquire and fails the bench."""
+    from training_operator_tpu.utils import locks as _locks
+
+    if not _locks.lockcheck_enabled():
+        raise SystemExit("run_lockcheck_overhead needs TRAINING_LOCKCHECK=1 "
+                         "at process start (use --lockcheck/--lockcheck-only)")
+    specs = build_workload(n_jobs, seed)
+
+    def leg(check):
+        _locks.enable(check)
+        try:
+            t0 = time.perf_counter()
+            out = run_burst(specs, TPUPacker())
+            return time.perf_counter() - t0, out
+        finally:
+            _locks.enable(True)
+
+    _locks.reset_witness()
+    _locks.set_fail_fast(True)
+    try:
+        leg(True)  # warmup: codec + placer compiles land outside the measurement
+
+        counters = {"calls": 0, "time": 0.0}
+        orig_note = _locks._note_acquire
+
+        def probe(name):
+            t0 = time.perf_counter()
+            try:
+                return orig_note(name)
+            finally:
+                counters["calls"] += 1
+                counters["time"] += time.perf_counter() - t0
+
+        _locks._note_acquire = probe
+        try:
+            direct_wall, _ = leg(True)
+        finally:
+            _locks._note_acquire = orig_note
+        direct_share = counters["time"] / direct_wall if direct_wall > 0 else 0.0
+
+        off, on, ratios = [], [], []
+        for i in range(max(1, pairs)):
+            if i % 2 == 0:
+                d, _ = leg(False)
+                e, _ = leg(True)
+            else:
+                e, _ = leg(True)
+                d, _ = leg(False)
+            off.append(d)
+            on.append(e)
+            ratios.append(e / d if d > 0 else 1.0)
+        ratios.sort()
+        violations = _locks.witness_violations()
+    finally:
+        _locks.set_fail_fast(False)
+    return {
+        "jobs": n_jobs,
+        "pairs": pairs,
+        "direct": {
+            "tracked_acquisitions": counters["calls"],
+            "witness_time_s": round(counters["time"], 4),
+            "burst_wall_s": round(direct_wall, 3),
+            "share_pct": round(100 * direct_share, 3),
+        },
+        "wall_pairs": {
+            "disabled_wall_s": [round(v, 3) for v in off],
+            "enabled_wall_s": [round(v, 3) for v in on],
+            "pair_ratios": [round(r, 4) for r in ratios],  # sorted above
+            "median_pair_ratio": round(ratios[len(ratios) // 2], 4),
+        },
+        "order_graph_nodes": len(_locks.order_graph()),
+        "violations": len(violations),
         "overhead_pct": round(100 * direct_share, 3),
         "under_2pct": direct_share < 0.02,
     }
@@ -2741,6 +2843,18 @@ def main():
                     help="burst size for the audit-overhead block")
     ap.add_argument("--audit-out", default="BENCH_SELF_AUDIT_r10.json",
                     help="artifact path for --audit-only")
+    ap.add_argument("--lockcheck", action="store_true",
+                    help="run the whole bench under the runtime lock-order "
+                         "witness (TRAINING_LOCKCHECK=1; off by default in "
+                         "benches)")
+    ap.add_argument("--lockcheck-only", action="store_true",
+                    help="run only the witness-overhead block (on/off over "
+                         "the same 120-job burst, run_audit_overhead "
+                         "method) and write --lockcheck-out")
+    ap.add_argument("--lockcheck-jobs", type=int, default=120,
+                    help="burst size for the lockcheck-overhead block")
+    ap.add_argument("--lockcheck-out", default="BENCH_SELF_LOCKCHECK_r16.json",
+                    help="artifact path for --lockcheck-only")
     ap.add_argument("--no-observe", action="store_true",
                     help="skip the observability-overhead block")
     ap.add_argument("--observe-only", action="store_true",
@@ -2829,6 +2943,23 @@ def main():
         }
         print(json.dumps(doc))
         with open(args.audit_out, "w") as f:
+            json.dump(doc, f, indent=1)
+        return
+
+    if args.lockcheck_only:
+        block = run_lockcheck_overhead(args.lockcheck_jobs)
+        doc = {
+            "metric": "lockcheck_overhead_pct",
+            "value": block["overhead_pct"],
+            "unit": "% of burst wall spent in the lock-order witness "
+                    "(direct self-timed _note_acquire share; wall_pairs = "
+                    "on/off corroboration with spread; witnessed legs run "
+                    "fail-fast, zero violations required)",
+            "vs_baseline": None,
+            "lockcheck": block,
+        }
+        print(json.dumps(doc))
+        with open(args.lockcheck_out, "w") as f:
             json.dump(doc, f, indent=1)
         return
 

@@ -18,6 +18,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # CPU (no backend has initialized yet at conftest time). Scrub the site dir
 # from the path/env so pytest-spawned subprocesses (the real-process e2e
 # tier) start clean.
+# The runtime lock-order witness (utils/locks.py) defaults ON for the test
+# lanes so every chaos/soak leg runs under acquisition-order checking.
+# Must be set before the package imports: locks.py samples the env once
+# at import time. Benches opt in explicitly via --lockcheck instead.
+os.environ.setdefault("TRAINING_LOCKCHECK", "1")
+
 sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
 os.environ["PYTHONPATH"] = os.pathsep.join(
     p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)

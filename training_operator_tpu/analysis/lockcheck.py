@@ -1,0 +1,736 @@
+"""Static lock/ownership analyzer — the compile-time half of the
+concurrency-discipline plane (utils/locks.py is the runtime half).
+
+The control plane is multi-threaded across ~a dozen modules (store,
+apiserver, the four wire modules, replication tailer, metrics, timelines,
+chaos), and "which fields may be touched off the owning thread" must be
+checkable, not tribal. One AST pass over the tree infers, per class (and
+per module for module-level locks):
+
+  - the set of locks the scope owns (attributes assigned from
+    `TrackedLock`/`TrackedRLock`/`TrackedCondition` — or the raw
+    `threading` primitives CL008 is busy rejecting), with Condition
+    attributes resolved to the lock they share;
+  - the lock -> guarded-field map: fields written at least once inside a
+    `with self._lock:` block, attributed to the innermost owned lock;
+  - the static lock-order graph: lock B acquired lexically inside a
+    `with A:` body, resolved across `self.helper()` calls one level deep;
+  - thread entry points (Thread targets, ticker/callback registrations,
+    `do_*` HTTP handler methods) — the signal that a class's fields are
+    actually reachable from more than one thread.
+
+Rules (ERROR; `make lint` runs this after codelint):
+
+  CL008 raw-lock-outside-locks-module    `threading.Lock()/RLock()/
+        Condition()` constructed anywhere but utils/locks.py. Every lock
+        goes through the tracked factories so the runtime witness can see
+        it and the order-class catalog stays one greppable file.
+  CL009 blocking-call-under-lock    wire I/O (`request`/`getresponse`/
+        `urlopen`/socket verbs), `os.fsync`, `subprocess.*`, `time.sleep`,
+        or a no-timeout `.wait()` reached while a lock is held (directly
+        or via a helper called one level deep under the lock). A blocked
+        lock holder stalls every thread behind it — the PR 15
+        read/write-token coupling class.
+  CL010 static-lock-order-cycle    the per-file acquisition graph contains
+        a cycle (lock A taken under B somewhere, B under A elsewhere):
+        a potential deadlock the runtime witness would only catch when
+        the interleaving actually happens.
+  CL011 guarded-field-write-outside-lock    a field written under a lock
+        everywhere else is written WITHOUT it in a class with thread
+        entry points (the PR 2 `RemoteRuntime._timers` heap-race class).
+        `__init__`-time writes are exempt — no second thread exists yet.
+
+Exemptions are in-file pragmas, one reviewed line of code each:
+
+    some_call()  # lockcheck: allow CL009 — journal order IS write order
+
+The pragma may sit on the flagged line or alone on the line above; the
+reason (after an em/en dash or ':') is MANDATORY — a bare pragma is itself
+a finding. `python -m training_operator_tpu.analysis.lockcheck --report`
+emits the inferred lock->field map and the order graph as JSON for review
+(`make lockcheck-report`).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from training_operator_tpu.analysis.codelint import Finding, _package_rel
+
+# The one module allowed to construct raw threading primitives (CL008) —
+# it IS the factory seam the rule funnels everyone through.
+LOCKS_MODULE_SUFFIX = "utils/locks.py"
+
+RAW_LOCK_CTORS = ("Lock", "RLock", "Condition")
+TRACKED_CTORS = ("TrackedLock", "TrackedRLock", "TrackedCondition")
+
+# Attribute-call verbs that block the calling thread (CL009). Socket and
+# http.client I/O, durability fsync, and the subprocess family; `run` &co
+# are matched only on a literal `subprocess` receiver (too generic
+# otherwise).
+BLOCKING_ATTR_CALLS = (
+    "fsync", "request", "getresponse", "urlopen", "sendall", "recv",
+    "create_connection",
+)
+SUBPROCESS_VERBS = ("run", "call", "check_call", "check_output", "Popen",
+                    "communicate")
+
+# Mutating container verbs: a call `self.field.append(...)` counts as a
+# write to `field` for the guarded-field map.
+MUTATING_METHODS = (
+    "append", "appendleft", "extend", "add", "update", "setdefault", "pop",
+    "popleft", "popitem", "remove", "discard", "clear", "insert",
+    "move_to_end",
+)
+
+# Callback-registration verbs whose `self.<m>` argument marks `m` (and the
+# class) as reachable from another thread (codelint CL001/CL003 lineage:
+# tickers and timers run on the cluster loop, watch callbacks on the
+# session thread).
+CALLBACK_REGISTRARS = (
+    "add_ticker", "schedule_after", "subscribe", "attach", "register",
+    "add_done_callback", "pre_disrupt",
+)
+
+_PRAGMA_RE = re.compile(
+    r"#\s*lockcheck:\s*allow\s+(CL\d{3})(?:\s*(?:[—–:-]|--)\s*(.*\S))?\s*$"
+)
+
+
+# -- pragma allowlist ------------------------------------------------------
+
+
+class _Allowlist:
+    """Per-file `# lockcheck: allow CLxxx — reason` pragmas. A finding on
+    line L is suppressed by a pragma on L or on a standalone comment line
+    immediately above. Pragmas without a reason are findings themselves —
+    every exemption is a reviewed, justified line."""
+
+    def __init__(self, path: str, source: str):
+        self.entries: Dict[int, Tuple[str, Optional[str]]] = {}
+        self.bare: List[Tuple[int, str]] = []
+        for i, line in enumerate(source.splitlines(), start=1):
+            m = _PRAGMA_RE.search(line)
+            if not m:
+                continue
+            rule, reason = m.group(1), m.group(2)
+            if not reason:
+                self.bare.append((i, rule))
+                continue
+            self.entries[i] = (rule, reason)
+            # A standalone pragma comment covers the next line.
+            if line.lstrip().startswith("#"):
+                self.entries[i + 1] = (rule, reason)
+
+    def allows(self, line: int, rule: str) -> bool:
+        for probe in (line, line - 1):
+            entry = self.entries.get(probe)
+            if entry and entry[0] == rule:
+                return True
+        return False
+
+    def findings(self, path: str) -> List[Finding]:
+        return [
+            Finding(path, line, "CL000",
+                    f"allowlist pragma for {rule} carries no reason; write "
+                    f"`# lockcheck: allow {rule} — <why this is safe>`")
+            for line, rule in self.bare
+        ]
+
+
+# -- per-scope lock model --------------------------------------------------
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """`self.X` -> 'X' (None otherwise)."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _is_raw_lock_ctor(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if (isinstance(f, ast.Attribute) and f.attr in RAW_LOCK_CTORS
+            and isinstance(f.value, ast.Name) and f.value.id == "threading"):
+        return f.attr
+    return None
+
+
+def _is_tracked_ctor(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name) and f.id in TRACKED_CTORS:
+        return f.id
+    if isinstance(f, ast.Attribute) and f.attr in TRACKED_CTORS:
+        return f.attr
+    return None
+
+
+def _lock_ctor_kind(value: ast.AST) -> Optional[Tuple[str, Optional[str]]]:
+    """If `value` constructs a lock, return (kind, shared_lock_attr) where
+    kind in {lock, rlock, cond} and shared_lock_attr is the `self.Y` a
+    Condition was built over (None otherwise)."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = _is_raw_lock_ctor(value) or _is_tracked_ctor(value)
+    if name is None:
+        return None
+    kind = {"Lock": "lock", "TrackedLock": "lock",
+            "RLock": "rlock", "TrackedRLock": "rlock",
+            "Condition": "cond", "TrackedCondition": "cond"}[name]
+    shared = None
+    if kind == "cond":
+        args = list(value.args) + [k.value for k in value.keywords
+                                   if k.arg == "lock"]
+        if args:
+            shared = _self_attr(args[0])
+    return kind, shared
+
+
+@dataclass
+class _ScopeModel:
+    """Lock model for one class (or the module top level)."""
+
+    qualname: str                       # 'Class' or '<module>'
+    lock_attrs: Dict[str, str] = field(default_factory=dict)   # attr -> kind
+    cond_alias: Dict[str, str] = field(default_factory=dict)   # cond -> lock
+    # field -> {lock names it was written under}
+    writes_under: Dict[str, Set[str]] = field(default_factory=dict)
+    # field -> [(line, method)] writes with NO owned lock held (non-init)
+    writes_outside: Dict[str, List[Tuple[int, str]]] = field(default_factory=dict)
+    # (held_lock, acquired_lock) -> line of first observation
+    order_edges: Dict[Tuple[str, str], int] = field(default_factory=dict)
+    # (line, description, lock) blocking-call candidates
+    blocking: List[Tuple[int, str, str]] = field(default_factory=list)
+    entry_points: Set[str] = field(default_factory=set)
+
+    def resolve(self, attr: str) -> str:
+        return self.cond_alias.get(attr, attr)
+
+    def guarded_fields(self) -> Dict[str, str]:
+        """field -> lock, for fields written under exactly one lock."""
+        return {
+            f: next(iter(ls))
+            for f, ls in sorted(self.writes_under.items())
+            if len(ls) == 1
+        }
+
+
+class _FileAnalysis:
+    """One file's lock model + findings."""
+
+    def __init__(self, path: str, rel: str, tree: ast.Module, source: str):
+        self.path = path
+        self.rel = rel
+        self.tree = tree
+        self.allow = _Allowlist(path, source)
+        self.scopes: List[_ScopeModel] = []
+        self.raw_ctors: List[Tuple[int, str]] = []
+        self._collect()
+
+    # -- collection -------------------------------------------------------
+
+    def _collect(self) -> None:
+        module_scope = _ScopeModel("<module>")
+        module_body: List[ast.stmt] = []
+        for node in self.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self.scopes.append(self._collect_class(node))
+            else:
+                module_body.append(node)
+        # Module-level locks (wire.py's codec/event-bytes locks): names
+        # assigned from a lock ctor, acquired via bare `with _name:`.
+        for node in module_body:
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                got = _lock_ctor_kind(node.value)
+                if got:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            module_scope.lock_attrs[t.id] = got[0]
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                ctor = _is_raw_lock_ctor(node)
+                if ctor:
+                    self.raw_ctors.append((node.lineno, ctor))
+        if module_scope.lock_attrs:
+            self._walk_functions(
+                module_scope, self.tree.body, module_is_scope=True
+            )
+        self.scopes.append(module_scope)
+
+    def _collect_class(self, cls: ast.ClassDef) -> _ScopeModel:
+        model = _ScopeModel(cls.name)
+        methods = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        # Nested classes (the wire_server request-handler factories) fold
+        # into the parent model: same threading story, same file.
+        for inner in [n for n in cls.body if isinstance(n, ast.ClassDef)]:
+            for n in inner.body:
+                if (isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and n.name not in methods):
+                    methods[n.name] = n
+        # Pass 1: lock attributes + condition aliases + entry points.
+        for m in methods.values():
+            for node in ast.walk(m):
+                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                    got = _lock_ctor_kind(node.value)
+                    if got is None:
+                        continue
+                    kind, shared = got
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr:
+                            model.lock_attrs[attr] = kind
+                            if kind == "cond" and shared:
+                                model.cond_alias[attr] = shared
+                if isinstance(node, ast.Call):
+                    self._note_entry_points(model, node)
+        for name in methods:
+            if name.startswith("do_") or name in ("handle", "handle_one_request"):
+                model.entry_points.add(name)
+        # Pass 2: walk each method with a held-lock stack.
+        helper_calls: List[Tuple[List[str], str]] = []
+        for name, m in methods.items():
+            self._walk_stmts(
+                model, m.body, held=[], method=name,
+                helper_calls=helper_calls,
+            )
+        # One-level helper resolution: a helper's OWN top-level effects
+        # (lock acquisitions, blocking calls) also happen under every lock
+        # its caller held at the call site.
+        helper_effects = {
+            name: self._helper_effects(model, m)
+            for name, m in methods.items()
+        }
+        for held, helper in helper_calls:
+            effects = helper_effects.get(helper)
+            if not effects:
+                continue
+            acquired, blocking = effects
+            for a in held:
+                for b, line in acquired:
+                    if a != b:
+                        model.order_edges.setdefault((a, b), line)
+            for line, desc in blocking:
+                model.blocking.append(
+                    (line, f"{desc} (in {helper}(), reached under lock)",
+                     held[-1])
+                )
+        return model
+
+    def _note_entry_points(self, model: _ScopeModel, call: ast.Call) -> None:
+        f = call.func
+        # threading.Thread(target=self.m) / Thread(target=self.m)
+        is_thread = (
+            (isinstance(f, ast.Attribute) and f.attr == "Thread")
+            or (isinstance(f, ast.Name) and f.id == "Thread")
+        )
+        if is_thread:
+            for k in call.keywords:
+                if k.arg == "target":
+                    attr = _self_attr(k.value)
+                    model.entry_points.add(attr or "<thread>")
+            return
+        verb = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if verb in CALLBACK_REGISTRARS:
+            for arg in list(call.args) + [k.value for k in call.keywords]:
+                attr = _self_attr(arg)
+                if attr:
+                    model.entry_points.add(attr)
+                elif isinstance(arg, ast.Lambda):
+                    model.entry_points.add("<lambda>")
+
+    def _with_locks(self, model: _ScopeModel, node: ast.With,
+                    module_scope: bool = False) -> List[str]:
+        out = []
+        for item in node.items:
+            expr = item.context_expr
+            attr = _self_attr(expr)
+            if attr is None and module_scope and isinstance(expr, ast.Name):
+                attr = expr.id
+            if attr is not None and model.resolve(attr) in model.lock_attrs:
+                out.append(model.resolve(attr))
+        return out
+
+    def _walk_stmts(self, model: _ScopeModel, body: Sequence[ast.stmt],
+                    held: List[str], method: str,
+                    helper_calls: List[Tuple[List[str], str]],
+                    module_scope: bool = False) -> None:
+        for node in body:
+            if isinstance(node, ast.With):
+                got = self._with_locks(model, node, module_scope)
+                for b in got:
+                    for a in held:
+                        if a != b:
+                            model.order_edges.setdefault((a, b), node.lineno)
+                self._walk_stmts(model, node.body, held + got, method,
+                                 helper_calls, module_scope)
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Nested defs (handler closures) are their own call-time
+                # scope: locks held NOW are not held when they run.
+                self._walk_stmts(model, node.body, [], f"{method}.{node.name}",
+                                 helper_calls, module_scope)
+                continue
+            if isinstance(node, ast.ClassDef):
+                continue
+            self._scan_expr(model, node, held, method, helper_calls)
+            for fld in ("body", "orelse", "finalbody", "handlers"):
+                sub = getattr(node, fld, None)
+                if not sub:
+                    continue
+                if fld == "handlers":
+                    for h in sub:
+                        self._walk_stmts(model, h.body, held, method,
+                                         helper_calls, module_scope)
+                else:
+                    self._walk_stmts(model, sub, held, method,
+                                     helper_calls, module_scope)
+
+    def _scan_expr(self, model: _ScopeModel, stmt: ast.stmt, held: List[str],
+                   method: str,
+                   helper_calls: List[Tuple[List[str], str]]) -> None:
+        """Field writes, blocking calls, and helper calls in one statement
+        (its own expressions only — nested stmt bodies recurse through
+        _walk_stmts with their own held-lock context)."""
+        in_init = method in ("__init__", "__post_init__", "__init_subclass__")
+        own_locks_held = [h for h in held if h in model.lock_attrs]
+        # Writes: assignment / augassign / subscript-store / mutating call.
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            base = t
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            attr = _self_attr(base)
+            if attr is None or attr in model.lock_attrs:
+                continue
+            self._note_write(model, attr, stmt.lineno, method, in_init,
+                             own_locks_held)
+        for node in _expr_nodes(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            # self.field.mutator(...) counts as a write to field.
+            if isinstance(f, ast.Attribute) and f.attr in MUTATING_METHODS:
+                attr = _self_attr(f.value)
+                if attr and attr not in model.lock_attrs:
+                    self._note_write(model, attr, node.lineno, method,
+                                     in_init, own_locks_held)
+            # self.helper(...) for one-level resolution.
+            if held:
+                attr = _self_attr(f) if isinstance(f, ast.Attribute) else None
+                if attr:
+                    helper_calls.append((list(held), attr))
+                desc = _blocking_desc(node)
+                if desc:
+                    model.blocking.append((node.lineno, desc, held[-1]))
+
+    def _note_write(self, model: _ScopeModel, attr: str, line: int,
+                    method: str, in_init: bool,
+                    own_locks_held: List[str]) -> None:
+        if own_locks_held:
+            model.writes_under.setdefault(attr, set()).add(own_locks_held[-1])
+        elif not in_init:
+            model.writes_outside.setdefault(attr, []).append((line, method))
+
+    def _helper_effects(self, model: _ScopeModel, fn) -> Optional[
+            Tuple[List[Tuple[str, int]], List[Tuple[int, str]]]]:
+        """(locks acquired, blocking calls) at a method's top level — what
+        a caller holding a lock inherits from calling it."""
+        acquired: List[Tuple[str, int]] = []
+        blocking: List[Tuple[int, str]] = []
+
+        def walk(body: Sequence[ast.stmt]) -> None:
+            for node in body:
+                if isinstance(node, ast.With):
+                    for b in self._with_locks(model, node):
+                        acquired.append((b, node.lineno))
+                    walk(node.body)
+                    continue
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                for sub in _expr_nodes(node):
+                    if isinstance(sub, ast.Call):
+                        desc = _blocking_desc(sub)
+                        if desc:
+                            blocking.append((sub.lineno, desc))
+                for fld in ("body", "orelse", "finalbody"):
+                    if getattr(node, fld, None):
+                        walk(getattr(node, fld))
+                for h in getattr(node, "handlers", []) or []:
+                    walk(h.body)
+
+        walk(fn.body)
+        if acquired or blocking:
+            return acquired, blocking
+        return None
+
+    def _walk_functions(self, model: _ScopeModel, body: Sequence[ast.stmt],
+                        module_is_scope: bool) -> None:
+        """Module-scope pass: every top-level function walked against the
+        module's lock names (class methods were handled per class)."""
+        helper_calls: List[Tuple[List[str], str]] = []
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_stmts(model, node.body, [], node.name,
+                                 helper_calls, module_scope=True)
+
+    # -- findings ---------------------------------------------------------
+
+    def findings(self) -> List[Finding]:
+        out: List[Finding] = list(self.allow.findings(self.path))
+        in_locks_module = self.rel.endswith(LOCKS_MODULE_SUFFIX)
+        if not in_locks_module:
+            for line, ctor in self.raw_ctors:
+                out.append(Finding(
+                    self.path, line, "CL008",
+                    f"raw threading.{ctor}() outside utils/locks.py; use "
+                    f"locks.Tracked{'Lock' if ctor == 'Lock' else ctor} so "
+                    f"the runtime witness can see it",
+                ))
+        for model in self.scopes:
+            prefix = "" if model.qualname == "<module>" else f"{model.qualname}."
+            for (line, desc, lock) in model.blocking:
+                out.append(Finding(
+                    self.path, line, "CL009",
+                    f"blocking {desc} while holding {prefix}{lock} stalls "
+                    f"every thread queued on that lock",
+                ))
+            for cycle in _cycles(model.order_edges):
+                line = min(
+                    model.order_edges.get((a, b), 1 << 30)
+                    for a, b in zip(cycle, cycle[1:] + cycle[:1])
+                    if (a, b) in model.order_edges
+                )
+                out.append(Finding(
+                    self.path, line, "CL010",
+                    f"lock-order cycle {' -> '.join(prefix + c for c in cycle)}"
+                    f" -> {prefix}{cycle[0]}: opposite acquisition orders "
+                    f"deadlock under the right interleaving",
+                ))
+            if not model.entry_points:
+                continue
+            guarded = model.guarded_fields()
+            for fld, sites in sorted(model.writes_outside.items()):
+                lock = guarded.get(fld)
+                if lock is None:
+                    continue
+                for line, method in sites:
+                    out.append(Finding(
+                        self.path, line, "CL011",
+                        f"write to {prefix}{fld} outside {prefix}{lock} "
+                        f"(guarded everywhere else; class has thread entry "
+                        f"points {sorted(model.entry_points)})",
+                    ))
+        kept: List[Finding] = []
+        seen: Set[Tuple[int, str]] = set()
+        for f in out:
+            if f.rule_id != "CL000" and self.allow.allows(f.line, f.rule_id):
+                continue
+            # Dedup (line, rule): a blocking call both inside a helper and
+            # directly under a lock reports once.
+            key = (f.line, f.rule_id)
+            if key in seen:
+                continue
+            seen.add(key)
+            kept.append(f)
+        kept.sort(key=lambda f: (f.line, f.rule_id))
+        return kept
+
+    # -- report -----------------------------------------------------------
+
+    def report(self) -> Dict[str, Any]:
+        classes: Dict[str, Any] = {}
+        edges: List[Dict[str, Any]] = []
+        for model in self.scopes:
+            if not (model.lock_attrs or model.entry_points):
+                continue
+            lock_to_fields: Dict[str, List[str]] = {}
+            for fld, lock in model.guarded_fields().items():
+                lock_to_fields.setdefault(lock, []).append(fld)
+            classes[model.qualname] = {
+                "locks": {a: k for a, k in sorted(model.lock_attrs.items())},
+                "condition_aliases": dict(sorted(model.cond_alias.items())),
+                "guarded_fields": {
+                    k: sorted(v) for k, v in sorted(lock_to_fields.items())
+                },
+                "entry_points": sorted(model.entry_points),
+            }
+            for (a, b), line in sorted(model.order_edges.items()):
+                edges.append({
+                    "scope": model.qualname, "held": a, "acquired": b,
+                    "line": line,
+                })
+        return {"classes": classes, "order_edges": edges}
+
+
+def _cycles(edges: Dict[Tuple[str, str], int]) -> List[List[str]]:
+    """Elementary cycles in the (small) per-file order graph, deduplicated
+    by node set, smallest-first rotation for stable reporting."""
+    adj: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+    seen: Set[frozenset] = set()
+    out: List[List[str]] = []
+
+    def dfs(start: str, node: str, path: List[str]) -> None:
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start and len(path) > 1:
+                key = frozenset(path)
+                if key not in seen:
+                    seen.add(key)
+                    rot = path.index(min(path))
+                    out.append(path[rot:] + path[:rot])
+            elif nxt not in path and nxt > start:
+                # Only explore nodes ordered after `start`: each cycle is
+                # found exactly once, from its smallest node.
+                dfs(start, nxt, path + [nxt])
+
+    for start in sorted(adj):
+        dfs(start, start, [start])
+    return out
+
+
+def _expr_nodes(stmt: ast.stmt) -> Iterator[ast.AST]:
+    """The statement's OWN expression subtree: header expressions only
+    (nested statement bodies carry a different held-lock context), and no
+    descent into lambdas / nested defs (they run later, locks released)."""
+    stack = [c for c in ast.iter_child_nodes(stmt)
+             if not isinstance(c, (ast.stmt, ast.excepthandler))]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _blocking_desc(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        if f.id == "urlopen":
+            return "urlopen()"
+        return None
+    if not isinstance(f, ast.Attribute):
+        return None
+    recv = f.value
+    if isinstance(recv, ast.Name) and recv.id == "subprocess" \
+            and f.attr in SUBPROCESS_VERBS:
+        return f"subprocess.{f.attr}()"
+    if f.attr == "sleep" and isinstance(recv, ast.Name) \
+            and recv.id in ("time", "_time", "_t"):
+        return "time.sleep()"
+    if f.attr in BLOCKING_ATTR_CALLS:
+        return f".{f.attr}()"
+    if f.attr == "wait" and not call.args and not any(
+            k.arg == "timeout" for k in call.keywords):
+        return "no-timeout .wait()"
+    return None
+
+
+# -- entry points ----------------------------------------------------------
+
+
+def analyze_source(path: str, source: str,
+                   package_rel: Optional[str] = None) -> _FileAnalysis:
+    rel = (package_rel if package_rel is not None else path).replace(os.sep, "/")
+    tree = ast.parse(source, filename=path)
+    return _FileAnalysis(path, rel, tree, source)
+
+
+def check_source(path: str, source: str,
+                 package_rel: Optional[str] = None) -> List[Finding]:
+    try:
+        fa = analyze_source(path, source, package_rel)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "CL000", f"syntax error: {e.msg}")]
+    return fa.findings()
+
+
+def _iter_files(paths: Sequence[str]) -> Iterator[Tuple[str, str]]:
+    for root in paths:
+        if os.path.isfile(root):
+            files, base = [root], os.path.dirname(root)
+        else:
+            base = root
+            files = []
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                files.extend(
+                    os.path.join(dirpath, f) for f in sorted(filenames)
+                    if f.endswith(".py")
+                )
+        for f in sorted(files):
+            yield f, base
+
+
+def check_paths(paths: Sequence[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for f, base in _iter_files(paths):
+        with open(f, encoding="utf-8") as fh:
+            src = fh.read()
+        findings.extend(check_source(f, src, package_rel=_package_rel(f, base)))
+    return findings
+
+
+def report_paths(paths: Sequence[str]) -> Dict[str, Any]:
+    """The `--report` JSON: per-file lock->field maps + the merged
+    acquisition-order graph (`make lockcheck-report`)."""
+    files: Dict[str, Any] = {}
+    merged_edges: List[Dict[str, Any]] = []
+    for f, base in _iter_files(paths):
+        with open(f, encoding="utf-8") as fh:
+            src = fh.read()
+        rel = _package_rel(f, base)
+        try:
+            fa = analyze_source(f, src, package_rel=rel)
+        except SyntaxError:
+            continue
+        rep = fa.report()
+        if rep["classes"]:
+            files[rel] = rep["classes"]
+        for e in rep["order_edges"]:
+            merged_edges.append({**e, "file": rel})
+    return {"files": files, "order_edges": merged_edges}
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = list(argv if argv is not None else sys.argv[1:])
+    want_report = "--report" in args
+    if want_report:
+        args.remove("--report")
+    if not args:
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        args = [pkg_root]
+    if want_report:
+        print(json.dumps(report_paths(args), indent=1, sort_keys=True))
+        return 0
+    findings = check_paths(args)
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"lockcheck: {len(findings)} finding(s)")
+        return 1
+    print("lockcheck: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

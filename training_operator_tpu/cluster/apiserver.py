@@ -37,6 +37,7 @@ from typing import Any, Callable, Deque, Dict, Iterable, List, Optional, Tuple
 from training_operator_tpu.cluster.objects import Event
 from training_operator_tpu.observe.timeline import TimelineStore
 from training_operator_tpu.utils import metrics
+from training_operator_tpu.utils.locks import TrackedCondition, TrackedRLock
 
 # Default event-retention cap (see APIServer._event_cap). Sized to hold
 # every event of a 1k-job burst several times over; long-lived hosts and
@@ -192,12 +193,12 @@ class APIServer:
         # dropped record starts a fresh count, exactly like an expired k8s
         # Event recurring.
         self._event_cap = DEFAULT_EVENT_CAP
-        self._lock = threading.RLock()
+        self._lock = TrackedRLock("apiserver")
         # Signalled on every watch push; wait_and_drain blocks on it so a
         # cross-thread watch consumer (the HTTP long-poll handler) parks on
         # a condition instead of spinning. Shares the store lock: a waiter
         # holding the condition atomically releases the lock while blocked.
-        self._watch_cond = threading.Condition(self._lock)
+        self._watch_cond = TrackedCondition(self._lock, name="apiserver")
         # Durability sink (cluster/store.py HostStore): called inside the
         # lock after every mutation, so the journal order IS the write
         # order. None = volatile store (tests, standalone role).

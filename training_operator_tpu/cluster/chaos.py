@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Tuple
 from training_operator_tpu.cluster.apiserver import ConflictError
 from training_operator_tpu.cluster.objects import PodPhase
 from training_operator_tpu.cluster.runtime import Cluster, SimKubelet
+from training_operator_tpu.utils.locks import TrackedLock
 
 
 class ChaosMonkey:
@@ -535,7 +536,7 @@ class WireChaos:
         self.reset_rate = reset_rate
         self.reap_rate = reap_rate
         self.injected: Dict[str, int] = {"error": 0, "reset": 0, "reap": 0}
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("wire_chaos")
 
     @classmethod
     def from_spec(cls, spec: str) -> "WireChaos":

@@ -63,6 +63,7 @@ from training_operator_tpu.cluster import wire
 from training_operator_tpu.cluster.apiserver import APIServer
 from training_operator_tpu.cluster.objects import Event
 from training_operator_tpu.utils import metrics
+from training_operator_tpu.utils.locks import TrackedCondition, TrackedLock
 
 log = logging.getLogger(__name__)
 
@@ -146,7 +147,7 @@ class HostStore:
         self.compact_every = compact_every
         self.compact_max_bytes = compact_max_bytes
         self.fsync_per_record = fsync_per_record
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("store")
         self._journal_fh = None
         self._gen = 0
         self._records_since_snapshot = 0
@@ -163,7 +164,7 @@ class HostStore:
         self._wal_floor = 0  # newest seq NOT retained (0 = nothing evicted)
         # Signalled on every WAL append so GET /wal can long-poll instead
         # of spinning; shares the store lock (waiters release it atomically).
-        self._wal_cond = threading.Condition(self._lock)
+        self._wal_cond = TrackedCondition(self._lock, name="store")
         # Torn trailing records found during replay: path -> byte offset of
         # the last whole record. Physically truncated lazily by attach()
         # (the next append), NOT during replay — replay stays read-only, so
@@ -386,6 +387,10 @@ class HostStore:
                 fh.write(line)
                 fh.flush()
                 if self.fsync_per_record:
+                    # Write-ahead contract: the fsync must complete under the
+                    # store lock or an acked write could be reordered past a
+                    # crash (fsync_per_record is off in every latency lane).
+                    # lockcheck: allow CL009 — journal order IS the write order
                     os.fsync(fh.fileno())
             except (OSError, ValueError) as e:
                 # ValueError: write on a closed fd. The sink is write-ahead,

@@ -45,6 +45,7 @@ from training_operator_tpu.api import jobs as jobs_api
 from training_operator_tpu.cluster import objects as cluster_objects
 from training_operator_tpu.runtime import api as runtime_api
 from training_operator_tpu.tenancy import api as tenancy_api
+from training_operator_tpu.utils.locks import TrackedLock
 from training_operator_tpu.utils import metrics
 
 # kind string -> class, for every kind the APIServer can store (plus Event,
@@ -79,7 +80,7 @@ KIND_REGISTRY: Dict[str, type] = {
 # the compile counter stays exact.
 _ENCODERS: Dict[type, Callable[[Any], Dict[str, Any]]] = {}
 _DECODERS: Dict[type, Callable[[Dict[str, Any]], Any]] = {}
-_codec_lock = threading.Lock()
+_codec_lock = TrackedLock("wire.codec")
 
 # Resolved type hints are cached per class: get_type_hints re-evaluates the
 # stringified `from __future__ import annotations` annotations on every call.
@@ -457,7 +458,7 @@ def encode_watch_event(ev) -> Dict[str, Any]:
     }
 
 
-_event_bytes_lock = threading.Lock()
+_event_bytes_lock = TrackedLock("wire.event_bytes")
 
 
 def encode_watch_event_bytes(ev) -> bytes:

@@ -20,6 +20,7 @@ import time as _time
 from typing import Any, Callable, List, Optional, Tuple
 
 from training_operator_tpu.cluster.runtime import Clock
+from training_operator_tpu.utils.locks import TrackedLock
 from training_operator_tpu.cluster.wire_transport import (
     ApiServerError,
     ApiUnavailableError,
@@ -123,7 +124,7 @@ class RemoteRuntime:
         # delays or drops requeue timers. All heap mutation goes through
         # this lock; timer callbacks run OUTSIDE it (a callback that
         # schedules again must not deadlock).
-        self._timers_lock = threading.Lock()
+        self._timers_lock = TrackedLock("wire_runtime.timers")
 
     def add_ticker(self, fn: Callable[[], None]) -> None:
         self._tickers.append(fn)

@@ -40,6 +40,7 @@ from training_operator_tpu.cluster.apiserver import (
 )
 from training_operator_tpu.cluster.objects import Event
 from training_operator_tpu.cluster.wire_transport import seg_ns
+from training_operator_tpu.utils.locks import TrackedLock
 from training_operator_tpu.utils import metrics
 
 log = logging.getLogger(__name__)
@@ -130,7 +131,7 @@ class _ResumeRing:
         # chain" (resumable) instead of "knowledge predates this ring"
         # (too old). See seed()/_kind_floor().
         self._seeded = False
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("wire_server.ring")
 
     def accept_epochs(self, ancestors) -> None:
         """Extend the accepted-epoch chain (standby bootstrap: the
@@ -325,7 +326,7 @@ class ApiHTTPServer:
             )
         # watch_id -> (WatchQueue, last_access_monotonic)
         self._sessions: Dict[str, List[Any]] = {}
-        self._sessions_lock = threading.Lock()
+        self._sessions_lock = TrackedLock("wire_server.sessions")
         # Delta-resume ring: subscribe BEFORE any client can, so the ring
         # misses nothing a session could have observed.
         self._ring = _ResumeRing(api, size=resume_ring_size)
@@ -357,7 +358,7 @@ class ApiHTTPServer:
         # dead versions age out, no invalidation hooks needed.
         self._body_cache: "OrderedDict[Tuple[str, str, str, int], bytes]" = OrderedDict()
         self._body_cache_max = 16384
-        self._body_lock = threading.Lock()
+        self._body_lock = TrackedLock("wire_server.bodies")
         # Projected-body LRU, alongside (not inside) the full-body cache:
         # keyed by the same frozen (kind, ns, name, rv) identity PLUS the
         # canonical field-path tuple, so projected LISTs (`fields=`) get the
@@ -470,7 +471,7 @@ class ApiHTTPServer:
                 # serving, which is exactly wrong for SIGKILL simulation
                 # (ApiHTTPServer.kill severs these too).
                 self._live_conns = set()
-                self._conn_lock = threading.Lock()
+                self._conn_lock = TrackedLock("wire_server.conns")
 
             def process_request(self, request, client_address):
                 with self._conn_lock:

@@ -36,6 +36,7 @@ from training_operator_tpu.cluster.apiserver import (
 )
 from training_operator_tpu.cluster.objects import Event
 from training_operator_tpu.utils import metrics
+from training_operator_tpu.utils.locks import TrackedLock
 
 log = logging.getLogger(__name__)
 
@@ -98,7 +99,7 @@ class RemoteTimelines:
         self._buf: Dict[Tuple[str, str], Dict[str, Any]] = {}
         self._buffered = 0
         self._last_flush = _time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("wire_transport.timeline_buf")
 
     def now(self) -> float:
         return _time.time()
@@ -359,7 +360,7 @@ class _WriteCoalescer:
         self._events: List[bytes] = []
         self._merged = 0  # last-write-wins drops since the last report
         self._oldest: Optional[float] = None
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("wire_transport.coalescer")
 
     def __len__(self) -> int:
         return len(self._buf) + len(self._events)
@@ -619,7 +620,7 @@ class RemoteAPIServer:
         # thread's sockets directly).
         self._addr_idx = 0
         self._addr_gen = 0
-        self._addr_lock = threading.Lock()
+        self._addr_lock = TrackedLock("wire_transport.addr")
         # Follower reads: the read channels ("read" + "watch") speak to
         # their own address — the first address that isn't the write
         # primary — with their own rotation generation, so a dead standby

@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from training_operator_tpu.cluster import wire
 from training_operator_tpu.cluster.apiserver import NotFoundError
+from training_operator_tpu.utils.locks import TrackedLock, TrackedRLock
 from training_operator_tpu.cluster.wire_transport import (
     ApiServerError,
     ApiUnavailableError,
@@ -155,7 +156,7 @@ class _SharedWatch:
         # (their knowledge came from post-subscribe LIST primes).
         self._epoch: Optional[str] = None
         self._base = 0
-        self._lock = threading.RLock()
+        self._lock = TrackedRLock("wire_watch.session")
 
     # -- subscriber management --------------------------------------------
 
@@ -408,7 +409,7 @@ class CachedReadAPI:
         self._q.overflow_limit = 8192  # standby-safe: see RemoteWatchQueue
         # Parallel reconcile workers (OperatorManager parallel_reconciles)
         # list concurrently; mirror mutation must be atomic.
-        self._cache_lock = threading.Lock()
+        self._cache_lock = TrackedLock("wire_watch.cache")
 
     # -- cached reads ------------------------------------------------------
 

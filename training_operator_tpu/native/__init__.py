@@ -21,8 +21,10 @@ from typing import Optional
 
 import numpy as np
 
+from training_operator_tpu.utils.locks import TrackedLock
+
 _SOURCE = Path(__file__).with_name("dataio.cpp")
-_lock = threading.Lock()
+_lock = TrackedLock("native.build")
 _lib: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
 

@@ -36,6 +36,8 @@ from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from training_operator_tpu.utils.locks import TrackedLock
+
 # Process-wide master switch, consulted by every record/mark call. Module
 # attribute (not config) so the bench and tests can flip it without
 # plumbing; per-store `enabled` composes with it.
@@ -135,7 +137,7 @@ class TimelineStore:
         self.max_spans = max_spans
         self.enabled = True
         self._jobs: "OrderedDict[tuple, JobTimeline]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("timeline")
 
     def set_clock(self, now_fn) -> None:
         self._now = now_fn

@@ -68,7 +68,7 @@ from training_operator_tpu.observe.invariants import (
 )
 from training_operator_tpu.soak import workload as wl
 from training_operator_tpu.soak.orchestrator import ChaosOrchestrator
-from training_operator_tpu.utils import metrics
+from training_operator_tpu.utils import locks, metrics
 
 log = logging.getLogger(__name__)
 
@@ -547,6 +547,29 @@ class SoakHarness:
         from training_operator_tpu.__main__ import shard_feed, wire_cluster_services
         from training_operator_tpu.observe import FleetCollector
         from training_operator_tpu.runtime.controller import TrainJobManager
+
+        # The witness order graph is process-global; edges learned against
+        # the torn-down primary stack would be stale evidence against the
+        # standby's fresh lock instances. Reset per build (the per-pair
+        # exception registry survives — exemptions are code, not state).
+        locks.reset_witness()
+        if locks.lockcheck_enabled():
+            from training_operator_tpu.cluster.objects import Event
+
+            def _witness_event(v: Dict[str, Any]) -> None:
+                cluster.api.record_event(Event(
+                    object_kind="Cluster",
+                    object_name="lock-witness",
+                    event_type="Warning",
+                    reason="LockOrderViolation",
+                    message=(
+                        f"lock-order cycle {'->'.join(v['cycle'])} closed by "
+                        f"{v['pair']} on thread {v['thread']}"
+                    ),
+                    timestamp=cluster.clock.now(),
+                ))
+
+            locks.set_violation_sink(_witness_event)
 
         c = self.cfg
         replicas = max(1, int(c.operator_replicas))

@@ -1,0 +1,443 @@
+"""Lock discipline, runtime half: the lock-order witness.
+
+Every lock in the control plane is constructed through this module
+(codelint CL008 rejects raw `threading.Lock()` anywhere else — the CL005
+"one declaration site" pattern applied to concurrency). The factories are
+deliberately cheap in both modes:
+
+  - `TRAINING_LOCKCHECK` unset/0 (production, benches unless --lockcheck):
+    `TrackedLock()` returns a *raw* `threading.Lock` — one module-level
+    flag check, no wrapper allocation, zero per-acquire overhead.
+  - `TRAINING_LOCKCHECK=1` (the default in tests and the chaos/soak
+    lanes, set in tests/conftest.py): the factories return witness
+    wrappers that record, per thread, the set of locks currently held and
+    maintain a process-global acquisition-order graph (lockdep/FreeBSD
+    witness style). The first time an edge A->B closes a cycle against
+    the recorded order, the witness reports ONCE per edge-pair — with the
+    stack digest of both conflicting acquisition sites — via
+    `training_lock_order_violations_total{pair}`, the optional violation
+    sink (the soak harness points it at a Warning Event), and, under
+    `set_fail_fast(True)`, an `InvariantViolationError` raised out of the
+    acquire, turning every chaos tier into a lock-order regression test.
+
+Order classes are NAMES, not lock instances: every `HostStore._lock`
+shares the class "store", so an ordering observed between any store and
+any apiserver generalizes — exactly what makes the graph meaningful when
+open item 1 instantiates the store machinery per shard.
+
+The graph, the reported-pair set, and the order-exception registry are
+process-global mutable state (the CL006 re-registration lesson): exception
+registration is idempotent under pytest re-imports, and the soak harness
+calls `reset_witness()` between stack rebuilds so edges from a torn-down
+deployment shape can't condemn the next one.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
+
+__all__ = [
+    "TrackedLock", "TrackedRLock", "TrackedCondition",
+    "enable", "lockcheck_enabled", "set_fail_fast", "fail_fast_enabled",
+    "reset_witness", "witness_violations", "order_graph",
+    "register_order_exception", "order_exceptions", "set_violation_sink",
+    "acquisitions",
+]
+
+# Module-level enable flag, captured from the environment at import. The
+# factories read it per call, so tests/benches can flip it with enable();
+# locks constructed before the flip keep their mode (a raw Lock cannot
+# retroactively grow a witness).
+_ENABLED = os.environ.get("TRAINING_LOCKCHECK", "") not in ("", "0")
+_FAIL_FAST = os.environ.get("TRAINING_LOCKCHECK_FAILFAST", "") not in ("", "0")
+
+# The witness's own meta-lock. Deliberately a RAW lock: it guards the graph
+# itself and must never appear in it (it nests inside arbitrary tracked
+# acquires by design).
+_meta = threading.Lock()
+
+# Per-thread stack of held order-class names, in acquisition order.
+_tls = threading.local()
+
+# name -> set of names acquired at least once while `name` was held.
+_adj: Dict[str, set] = {}
+# (held, acquired) -> human-readable site + stack digest of the FIRST
+# observation of that edge (the evidence half of a later cycle report).
+_edge_sites: Dict[Tuple[str, str], str] = {}
+# Edge pairs already reported (once-per-incident: a hot inverted pair must
+# not melt the metric family or spam the sink).
+_reported: set = set()
+# Violations observed this process (cleared by reset_witness).
+_violations: List[Dict[str, Any]] = []
+# frozenset({a, b}) -> reason. Sanctioned inversions (idempotent to
+# re-register; survives reset_witness unless clear_exceptions=True).
+_order_exceptions: Dict[FrozenSet[str], str] = {}
+# Optional callable(violation_dict): the soak harness points this at a
+# Warning Event on the live store.
+_sink: Optional[Callable[[Dict[str, Any]], None]] = None
+# Tracked acquisitions observed (enabled mode only) — the denominator the
+# lockcheck bench reports next to its overhead share.
+_acquisitions = 0
+
+
+def enable(flag: bool = True) -> None:
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+def lockcheck_enabled() -> bool:
+    return _ENABLED
+
+
+def set_fail_fast(flag: bool = True) -> None:
+    global _FAIL_FAST
+    _FAIL_FAST = bool(flag)
+
+
+def fail_fast_enabled() -> bool:
+    return _FAIL_FAST
+
+
+def set_violation_sink(fn: Optional[Callable[[Dict[str, Any]], None]]) -> None:
+    global _sink
+    _sink = fn
+
+
+def acquisitions() -> int:
+    return _acquisitions
+
+
+def witness_violations() -> List[Dict[str, Any]]:
+    with _meta:
+        return [dict(v) for v in _violations]
+
+
+def order_graph() -> Dict[str, List[str]]:
+    """Copy of the observed acquisition-order graph (for report/tests)."""
+    with _meta:
+        return {a: sorted(bs) for a, bs in _adj.items()}
+
+
+def register_order_exception(a: str, b: str, reason: str) -> None:
+    """Sanction the {a, b} ordering pair. Idempotent: re-registration (the
+    pytest re-import case) updates the reason instead of erroring."""
+    if not reason or not reason.strip():
+        raise ValueError("order exception requires a reason")
+    with _meta:
+        _order_exceptions[frozenset((a, b))] = reason.strip()
+
+
+def order_exceptions() -> Dict[Tuple[str, ...], str]:
+    with _meta:
+        return {tuple(sorted(k)): v for k, v in _order_exceptions.items()}
+
+
+def reset_witness(clear_exceptions: bool = False) -> None:
+    """Drop the observed graph, reported pairs, and violation log. The
+    soak harness calls this between stack rebuilds: a promotion tears one
+    deployment shape down and builds another, and edges from the dead
+    shape must not combine with the new one into phantom cycles. Order
+    exceptions are declarations, not observations — kept unless asked."""
+    global _acquisitions
+    with _meta:
+        _adj.clear()
+        _edge_sites.clear()
+        _reported.clear()
+        del _violations[:]
+        _acquisitions = 0
+        if clear_exceptions:
+            _order_exceptions.clear()
+
+
+# -- witness core ----------------------------------------------------------
+
+
+def _held() -> List[str]:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _site() -> str:
+    """file:line digest of the acquisition site (innermost frame outside
+    this module), plus a short hash of the whole stack so two distinct
+    paths to the same line stay distinguishable in a report."""
+    stack = traceback.extract_stack()
+    frames = [f for f in stack if not f.filename.endswith("locks.py")]
+    tail = frames[-1] if frames else stack[0]
+    digest = f"{abs(hash(tuple((f.filename, f.lineno) for f in frames))) & 0xFFFFFFFF:08x}"
+    fname = tail.filename.rsplit(os.sep, 1)[-1]
+    return f"{fname}:{tail.lineno}#{digest}"
+
+
+def _reaches(src: str, dst: str) -> Optional[List[str]]:
+    """Path src -> ... -> dst in the order graph (callers hold _meta)."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in _adj.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+_EMPTY: frozenset = frozenset()
+
+
+def _note_acquire(name: str) -> None:
+    global _acquisitions
+    if not _ENABLED:
+        # Wrapper-resident disabled mode (the bench's off-arm): locks
+        # constructed while the witness was on stay wrappers, but pay only
+        # this flag check per acquire. Toggle only with no locks held —
+        # skipped acquires must not unbalance the held stack.
+        return
+    held = _held()
+    # Unguarded counter bump: a stats denominator, not an invariant —
+    # losing the odd increment to a race beats taking _meta per acquire.
+    _acquisitions += 1
+    if not held:
+        held.append(name)
+        return
+    # Steady-state fast path, no _meta: every (held, name) edge already in
+    # the graph. Dict/set reads ride the GIL; a stale miss only means one
+    # redundant trip through the slow path below.
+    if all(a == name or name in _adj.get(a, _EMPTY) for a in held):
+        held.append(name)
+        return
+    fired: List[Dict[str, Any]] = []
+    site = None
+    with _meta:
+        for a in held:
+            if a == name:
+                continue
+            succ = _adj.setdefault(a, set())
+            if name in succ:
+                continue
+            if site is None:
+                site = _site()
+            succ.add(name)
+            _edge_sites[(a, name)] = site
+            # Incremental cycle check: the new edge a->name closes a
+            # cycle iff `a` was already reachable FROM `name`.
+            back = _reaches(name, a)
+            if back is None:
+                continue
+            pair = (a, name)
+            if pair in _reported or (name, a) in _reported:
+                continue
+            if frozenset((a, name)) in _order_exceptions:
+                continue
+            _reported.add(pair)
+            cycle = back + [name]
+            v = {
+                "pair": f"{a}->{name}",
+                "cycle": cycle,
+                "site": site,
+                "other_sites": {
+                    f"{x}->{y}": _edge_sites.get((x, y), "?")
+                    for x, y in zip(cycle, cycle[1:])
+                },
+                "thread": threading.current_thread().name,
+            }
+            _violations.append(v)
+            fired.append(v)
+    held.append(name)
+    if not fired:
+        return
+    # Report OUTSIDE _meta: the metric/sink paths take tracked locks of
+    # their own, and _meta must never nest around one.
+    from training_operator_tpu.utils import metrics
+
+    for v in fired:
+        metrics.lock_order_violations.inc(v["pair"])
+        sink = _sink
+        if sink is not None:
+            try:
+                sink(v)
+            except Exception:
+                pass
+    if _FAIL_FAST:
+        from training_operator_tpu.observe.invariants import (
+            InvariantViolationError,
+        )
+
+        # The wrapper releases the inner lock when we raise; the held
+        # entry just pushed must unwind with it or it haunts every later
+        # acquisition on this thread as a phantom edge source.
+        _note_release(name)
+        raise InvariantViolationError(
+            "; ".join(
+                f"lock-order cycle {' -> '.join(v['cycle'])} at {v['site']}"
+                for v in fired
+            )
+        )
+
+
+def _note_release(name: str) -> None:
+    held = _held()  # tolerate disabled-mode acquires: absent names no-op
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == name:
+            del held[i]
+            return
+
+
+class _WitnessLock:
+    """Witness wrapper over threading.Lock. Implements the Condition
+    integration protocol (_release_save/_acquire_restore/_is_owned) so a
+    `TrackedCondition` keeps the held-set honest across wait()."""
+
+    __slots__ = ("_inner", "name", "_owner")
+
+    def __init__(self, name: str):
+        self._inner = threading.Lock()
+        self.name = name
+        self._owner: Optional[int] = None
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            try:
+                _note_acquire(self.name)
+            except BaseException:
+                self._inner.release()
+                raise
+            self._owner = threading.get_ident()
+        return ok
+
+    def release(self) -> None:
+        self._owner = None
+        _note_release(self.name)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> "_WitnessLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    # Condition protocol ---------------------------------------------------
+
+    def _release_save(self):
+        self._owner = None
+        _note_release(self.name)
+        self._inner.release()
+
+    def _acquire_restore(self, state) -> None:
+        self._inner.acquire()
+        _note_acquire(self.name)
+        self._owner = threading.get_ident()
+
+    def _is_owned(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    def __repr__(self) -> str:
+        return f"<TrackedLock {self.name} held={self._inner.locked()}>"
+
+
+class _WitnessRLock:
+    """Witness wrapper over threading.RLock: only the OUTERMOST acquire
+    notes the witness (reentry cannot change ordering)."""
+
+    __slots__ = ("_inner", "name", "_owner", "_count")
+
+    def __init__(self, name: str):
+        self._inner = threading.RLock()
+        self.name = name
+        self._owner: Optional[int] = None
+        self._count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        me = threading.get_ident()
+        if self._owner == me:
+            self._inner.acquire()
+            self._count += 1
+            return True
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            try:
+                _note_acquire(self.name)
+            except BaseException:
+                self._inner.release()
+                raise
+            self._owner = me
+            self._count = 1
+        return ok
+
+    def release(self) -> None:
+        if self._owner != threading.get_ident():
+            raise RuntimeError("cannot release un-acquired lock")
+        self._count -= 1
+        if self._count == 0:
+            self._owner = None
+            _note_release(self.name)
+        self._inner.release()
+
+    def __enter__(self) -> "_WitnessRLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    # Condition protocol ---------------------------------------------------
+
+    def _release_save(self):
+        count = self._count
+        self._owner = None
+        self._count = 0
+        _note_release(self.name)
+        return (self._inner._release_save(), count)
+
+    def _acquire_restore(self, state) -> None:
+        inner_state, count = state
+        self._inner._acquire_restore(inner_state)
+        _note_acquire(self.name)
+        self._owner = threading.get_ident()
+        self._count = count
+
+    def _is_owned(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    def __repr__(self) -> str:
+        return f"<TrackedRLock {self.name} count={self._count}>"
+
+
+# -- factories -------------------------------------------------------------
+
+
+def TrackedLock(name: str = "anon"):
+    """A mutex in the named order class. Disabled mode returns the raw
+    primitive — no wrapper allocation, no per-acquire cost."""
+    if not _ENABLED:
+        return threading.Lock()
+    return _WitnessLock(name)
+
+
+def TrackedRLock(name: str = "anon"):
+    if not _ENABLED:
+        return threading.RLock()
+    return _WitnessRLock(name)
+
+
+def TrackedCondition(lock=None, name: str = "anon"):
+    """threading.Condition over a tracked lock. Passing an existing
+    TrackedLock shares its order class (the store's wal_cond rides the
+    store lock, exactly like the raw Condition(self._lock) it replaces);
+    Condition's wait() goes through the wrapper's _release_save /
+    _acquire_restore hooks, so the held-set stays honest while parked."""
+    if lock is None:
+        lock = TrackedRLock(name)
+    return threading.Condition(lock)

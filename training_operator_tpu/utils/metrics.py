@@ -13,9 +13,10 @@ from __future__ import annotations
 
 import bisect
 import math
-import threading
 from collections import defaultdict
 from typing import Dict, List, Sequence, Tuple
+
+from training_operator_tpu.utils.locks import TrackedLock
 
 
 def _label_str(label_names: Tuple[str, ...], labels: Tuple[str, ...]) -> str:
@@ -34,7 +35,10 @@ class Counter:
         self.help = help_text
         self.label_names = label_names
         self._values: Dict[Tuple[str, ...], float] = defaultdict(float)
-        self._lock = threading.Lock()
+        # One order class for every leaf metric: metrics are read from the
+        # HTTP scrape thread while written from all others, and the only
+        # legal nesting is registry -> metric (never metric -> metric).
+        self._lock = TrackedLock("metrics.metric")
 
     def inc(self, *label_values: str, amount: float = 1.0) -> None:
         if len(label_values) != len(self.label_names):
@@ -103,7 +107,7 @@ class Histogram:
         self.sum = 0.0
         self.min = math.inf
         self.max = -math.inf
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("metrics.metric")
 
     def observe(self, value: float) -> None:
         with self._lock:
@@ -187,7 +191,11 @@ class LabeledHistogram:
         self.label_names = tuple(label_names)
         self.buckets: Tuple[float, ...] = tuple(sorted(buckets))
         self._children: Dict[Tuple[str, ...], Histogram] = {}
-        self._lock = threading.Lock()
+        # Family lock is its OWN order class: labels() releases it before
+        # the caller touches the child (`return` exits the with block), so
+        # family -> metric never nests; keeping the classes distinct means
+        # the witness would see it immediately if that ever changed.
+        self._lock = TrackedLock("metrics.family")
 
     def labels(self, *label_values: str) -> Histogram:
         if len(label_values) != len(self.label_names):
@@ -239,6 +247,15 @@ class LabeledHistogram:
 class MetricsRegistry:
     def __init__(self) -> None:
         self._metrics: Dict[str, Counter] = {}
+        # Guards the family dict itself (registration vs the scrape-thread
+        # walk). snapshot()/render() COPY the family list under this lock
+        # and only then take each metric's own lock — registry -> metric
+        # never nests, which keeps the order graph acyclic by construction.
+        self._lock = TrackedLock("metrics.registry")
+
+    def _families(self) -> List[Counter]:
+        with self._lock:
+            return list(self._metrics.values())
 
     def _existing(self, name: str, cls, labels=None, buckets=None):
         """Re-registration guard: the same name must come back as the SAME
@@ -266,37 +283,40 @@ class MetricsRegistry:
         return m
 
     def counter(self, name: str, help_text: str = "", labels: Tuple[str, ...] = ()) -> Counter:
-        existing = self._existing(name, Counter, labels=labels)
-        if existing is None:
-            existing = self._metrics[name] = Counter(name, help_text, tuple(labels))
-        return existing
+        with self._lock:
+            existing = self._existing(name, Counter, labels=labels)
+            if existing is None:
+                existing = self._metrics[name] = Counter(name, help_text, tuple(labels))
+            return existing
 
     def gauge(self, name: str, help_text: str = "", labels: Tuple[str, ...] = ()) -> Gauge:
-        existing = self._existing(name, Gauge, labels=labels)
-        if existing is None:
-            existing = self._metrics[name] = Gauge(name, help_text, tuple(labels))
-        return existing
+        with self._lock:
+            existing = self._existing(name, Gauge, labels=labels)
+            if existing is None:
+                existing = self._metrics[name] = Gauge(name, help_text, tuple(labels))
+            return existing
 
     def histogram(self, name: str, help_text: str = "",
                   buckets: Sequence[float] = DEFAULT_BUCKETS,
                   labels: Tuple[str, ...] = ()) -> Histogram:
-        if labels:
-            existing = self._existing(
-                name, LabeledHistogram, labels=labels, buckets=buckets
-            )
-            if existing is None:
-                existing = self._metrics[name] = LabeledHistogram(
-                    name, help_text, tuple(labels), buckets
+        with self._lock:
+            if labels:
+                existing = self._existing(
+                    name, LabeledHistogram, labels=labels, buckets=buckets
                 )
+                if existing is None:
+                    existing = self._metrics[name] = LabeledHistogram(
+                        name, help_text, tuple(labels), buckets
+                    )
+                return existing
+            existing = self._existing(name, Histogram, buckets=buckets)
+            if existing is None:
+                existing = self._metrics[name] = Histogram(name, help_text, buckets)
             return existing
-        existing = self._existing(name, Histogram, buckets=buckets)
-        if existing is None:
-            existing = self._metrics[name] = Histogram(name, help_text, buckets)
-        return existing
 
     def render(self) -> str:
         out: List[str] = []
-        for m in self._metrics.values():
+        for m in self._families():
             out.extend(m.render())
         return "\n".join(out) + "\n"
 
@@ -305,7 +325,7 @@ class MetricsRegistry:
         JSON analogue of render(), for the wire API's GET /metrics (a remote
         bench/test can assert counter deltas without text parsing)."""
         out: Dict[str, float] = {}
-        for m in self._metrics.values():
+        for m in self._families():
             if isinstance(m, (Histogram, LabeledHistogram)):
                 out.update(m.snapshot_items())
                 continue
@@ -766,4 +786,13 @@ read_staleness_seconds = registry.histogram(
     "training_read_staleness_seconds",
     "Bounded staleness (X-Training-Staleness) of reads served by a standby",
     buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+)
+# Concurrency-discipline plane (utils/locks.py runtime witness): one count
+# per lock-order cycle incident, labeled by the edge pair that closed it
+# (reported once per pair — a hot inverted path must not melt the family).
+lock_order_violations = registry.counter(
+    "training_lock_order_violations_total",
+    "Lock acquisition-order cycles observed by the runtime witness, by "
+    "closing edge pair",
+    ("pair",),
 )
