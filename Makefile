@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast test-wire test-chaos test-fleet test-tenancy test-failover test-shards soak-smoke lint lockcheck-report bench bench-quick bench-solver bench-wire bench-wire-v2 bench-wire-resume bench-observe bench-audit bench-lockcheck bench-node-chaos bench-tenancy bench-failover bench-shards bench-soak dryrun operator-demo ha-demo native clean
+.PHONY: test test-fast test-wire test-chaos test-fleet test-tenancy test-failover test-shards test-store-shards soak-smoke lint lockcheck-report bench bench-quick bench-solver bench-wire bench-wire-v2 bench-wire-resume bench-observe bench-audit bench-lockcheck bench-node-chaos bench-tenancy bench-failover bench-shards bench-store-shards bench-wire-driver bench-soak dryrun operator-demo ha-demo native clean
 
 test:            ## full suite (no hardware needed; ~10 min)
 	$(PY) -m pytest tests/ -q
@@ -43,15 +43,25 @@ test-shards:     ## operator scale-out lane (shard leases, handoff, follower rea
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_shards.py tests/test_config_knobs.py \
 	  tests/test_soak.py -q -m "not slow" -k "not CompressedDay"
 
+# Sharded write plane lane (deterministic, part of the default test flow —
+# tests/test_store_shards.py is collected by `test`/`test-fast`): the
+# (kind, namespace) routing map, StoreShardSet journals + ownership,
+# INV011 semantics, the client-side shard router (fan-out lists, shard
+# cursors, merged watch), per-shard outrun/failover healing, and the
+# 2-shard soak smoke with one per-shard failover.
+test-store-shards:  ## sharded write-plane lane (routing, INV011, shard router)
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_store_shards.py \
+	  tests/test_config_knobs.py -q
+
 # The soak smoke tier: a compressed hour of fleet life with ALL FIVE chaos
-# tiers live at once + one host failover, under the fail-fast INV001-INV009
+# tiers live at once + one host failover, under the fail-fast INV001-INV011
 # auditor, plus the single-seed replay pin and the bounded-growth/INV009
 # unit tests. Part of the default `test`/`test-fast` flow (tests/test_soak.py
 # is collected there); this lane runs it standalone.
 soak-smoke:      ## compressed-hour five-tier soak smoke (~90s, `not slow`)
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_soak.py -q -m "not slow"
 
-lint:            ## project code lint: AST discipline rules (CL001-CL011) + ruff (if present)
+lint:            ## project code lint: AST discipline rules (CL001-CL012) + ruff (if present)
 	$(PY) -m training_operator_tpu.analysis.codelint training_operator_tpu
 	$(PY) -m training_operator_tpu.analysis.lockcheck training_operator_tpu
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -158,6 +168,20 @@ bench-failover:  ## control-plane failover MTTR block -> BENCH_SELF_FAILOVER art
 # primary vs sessions-on-standby).
 bench-shards:    ## operator scale-out block -> BENCH_SELF_SHARDS artifact
 	JAX_PLATFORMS=cpu $(PY) bench.py --shards-only
+
+# Sharded write plane headline: the SAME 5k-job write burst through 1, 2,
+# and 4 fsync'd write-shard host processes behind the client-side router,
+# interleaved legs (the bench-wire-v2 method). Reports write p50/p99 and
+# jobs/minute per shard count; single-core caveat recorded in the artifact.
+bench-store-shards:  ## write-shard scaling block -> BENCH_SELF_STORE_SHARDS_r17.json
+	JAX_PLATFORMS=cpu $(PY) bench.py --store-shards-only
+
+# External-baseline driver stub: emits the self-measured sharded-write proxy
+# with external_baseline_unmeasured=true (no upstream kube-apiserver in this
+# container to drive; the stub records the method so the comparison slots in
+# when one is available).
+bench-wire-driver:  ## external-baseline stub -> self-measured proxy JSON
+	JAX_PLATFORMS=cpu $(PY) bench.py --wire-driver-stub
 
 # Kill one host of a whole-slice TPU gang on a virtual clock and measure
 # node-loss MTTR: detect (grace) -> evict (toleration) -> gang re-solve ->

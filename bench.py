@@ -1804,6 +1804,418 @@ print(f"POLLS={sum(polls)}", flush=True)
     return block
 
 
+# ---------------------------------------------------------------------------
+# Sharded write plane (PR 17 headline): the SAME 5k-job create burst through
+# 1, 2, and 4 fsync'd write-shard host OS processes behind the client-side
+# shard router. Every shard host is a vanilla single-shard primary paying a
+# real per-record journal fsync, so write latency is bounded by I/O the
+# shards genuinely overlap across processes — the claim being measured.
+# shards=1 runs a plain RemoteAPIServer against one host: the unrouted
+# compat arm. Rounds interleave across shard counts (the bench-wire-v2
+# method) so machine-load drift hits every arm.
+# ---------------------------------------------------------------------------
+
+
+# The writer side of one leg, run as ONE OS SUBPROCESS with `writers`
+# threads sharing one pipelined client: the flagship bulk-submission
+# shape — concurrent creates coalesce into wire-v2 POST /batch envelopes
+# (per-op HTTP/parse CPU amortizes away), while the host still pays a
+# per-record journal fsync inside its store lock, which is exactly the
+# serial resource the write shards split. A subprocess (not bench
+# threads) so the measuring interpreter's own work never sits between
+# the writers and the hosts; single-threaded unpipelined writers were
+# tried first and are CPU-bound end to end on this box — the shard
+# count then only changes scheduler overhead, not the bottleneck.
+# Waits for GO on stdin so import cost never pollutes the burst.
+_STORE_SHARDS_WRITER = r"""
+import sys, threading, time
+sys.path.insert(0, sys.argv[1])
+from training_operator_tpu.api.common import (
+    Container, PodTemplateSpec, ReplicaSpec,
+)
+from training_operator_tpu.api.jobs import JAXJob, ObjectMeta
+from training_operator_tpu.cluster.httpapi import (
+    RemoteAPIServer, ShardedRemoteAPIServer,
+)
+urls = sys.argv[2].split(";")
+n_jobs, writers, namespaces = (int(a) for a in sys.argv[3:6])
+if len(urls) == 1:
+    cli = RemoteAPIServer(urls[0], timeout=10.0)
+else:
+    cli = ShardedRemoteAPIServer(
+        shard_addresses=[[u] for u in urls], timeout=10.0)
+tmpl = PodTemplateSpec(
+    containers=[Container(name="jax", image="trainer",
+                          resources={"cpu": 0.25})],
+)
+lats = [[] for _ in range(writers)]
+errors = [0] * writers
+
+
+def work(w):
+    for i in range(w, n_jobs, writers):
+        job = JAXJob(
+            metadata=ObjectMeta(name=f"j-{i}",
+                                namespace=f"bench-ns-{i % namespaces}"),
+            replica_specs={"Worker": ReplicaSpec(replicas=1, template=tmpl)},
+        )
+        t0 = time.monotonic()
+        try:
+            cli.create(job)
+        except Exception:
+            errors[w] += 1
+            continue
+        lats[w].append(time.monotonic() - t0)
+
+
+threads = [threading.Thread(target=work, args=(w,), daemon=True)
+           for w in range(writers)]
+print("READY", flush=True)
+sys.stdin.readline()
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+print("ERRS=%d" % sum(errors), flush=True)
+print("LATS=" + ",".join("%.0f" % (x * 1e6)
+                         for per in lats for x in per), flush=True)
+"""
+
+
+# This VM's virtio disk acknowledges fsync in ~0.15ms — an order of
+# magnitude faster than any durable cloud volume (EBS/PD-class disks sit
+# at 1-10ms). At that speed the write path is pure CPU and a one-core box
+# can't show I/O overlap at all, so the shard hosts run under an
+# LD_PRELOAD shim that pads fsync/fdatasync to a configurable floor
+# (default 2.5ms, a mid-range durable-disk figure). The pad is wall time
+# the host thread sleeps with the GIL RELEASED — exactly the window a
+# second write shard uses. Both arms run the same floor; the artifact
+# records the floor AND the box's raw fsync cost so nothing hides.
+_FSYNC_FLOOR_C = r"""
+#define _GNU_SOURCE
+#include <dlfcn.h>
+#include <stdlib.h>
+#include <time.h>
+
+static long floor_us(void) {
+    static long v = -1;
+    if (v < 0) {
+        const char *e = getenv("FSYNC_FLOOR_US");
+        v = e ? atol(e) : 0;
+    }
+    return v;
+}
+
+static void pad(struct timespec *t0) {
+    long us = floor_us();
+    if (us <= 0) return;
+    struct timespec t1;
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    long spent = (t1.tv_sec - t0->tv_sec) * 1000000L +
+                 (t1.tv_nsec - t0->tv_nsec) / 1000L;
+    long left = us - spent;
+    if (left > 0) {
+        struct timespec d = {left / 1000000L, (left % 1000000L) * 1000L};
+        nanosleep(&d, NULL);
+    }
+}
+
+int fsync(int fd) {
+    static int (*real)(int) = NULL;
+    if (!real) real = dlsym(RTLD_NEXT, "fsync");
+    struct timespec t0;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    int rc = real(fd);
+    pad(&t0);
+    return rc;
+}
+
+int fdatasync(int fd) {
+    static int (*real)(int) = NULL;
+    if (!real) real = dlsym(RTLD_NEXT, "fdatasync");
+    struct timespec t0;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    int rc = real(fd);
+    pad(&t0);
+    return rc;
+}
+"""
+
+
+def _build_fsync_floor():
+    """Compile the fsync-floor shim; None when no C compiler is around
+    (the legs then run against the raw disk and the artifact says so)."""
+    import os as _os
+    import shutil
+    import subprocess
+    import tempfile
+
+    cc = shutil.which("cc") or shutil.which("gcc")
+    if cc is None:
+        return None
+    d = tempfile.mkdtemp(prefix="fsync-floor-")
+    src = _os.path.join(d, "fsync_floor.c")
+    so = _os.path.join(d, "fsync_floor.so")
+    with open(src, "w") as f:
+        f.write(_FSYNC_FLOOR_C)
+    try:
+        subprocess.run([cc, "-shared", "-fPIC", "-O2", "-o", so, src,
+                        "-ldl"], check=True, capture_output=True, timeout=60)
+    except Exception:  # noqa: BLE001
+        return None
+    return so
+
+
+def _raw_fsync_us(n: int = 100):
+    """The box disk's actual per-fsync cost, for the artifact record."""
+    import os as _os
+    import tempfile
+
+    with tempfile.NamedTemporaryFile() as f:
+        t0 = time.monotonic()
+        for _ in range(n):
+            _os.write(f.fileno(), b"x" * 256)
+            _os.fsync(f.fileno())
+        return round(1e6 * (time.monotonic() - t0) / n, 1)
+
+
+def _store_shards_leg(num_shards: int, n_jobs: int, writers: int = 8,
+                      namespaces: int = 16, shim=None, floor_us: int = 2500):
+    import os as _os
+    import statistics
+    import subprocess
+    import tempfile
+
+    from training_operator_tpu.cluster.shards import shard_for
+    from training_operator_tpu.utils.procio import spawn_module_process
+
+    tmp = tempfile.mkdtemp(prefix=f"store-shards-{num_shards}-")
+    repo = _os.path.dirname(_os.path.abspath(__file__))
+    host_env = {"JAX_PLATFORMS": "cpu"}
+    if shim is not None and floor_us > 0:
+        host_env["LD_PRELOAD"] = shim
+        host_env["FSYNC_FLOOR_US"] = str(floor_us)
+
+    def spawn(*a):
+        return spawn_module_process(a, repo, env_extra=host_env)
+
+    # Loopback HTTP for every leg (not per-TLS-availability): with N hosts
+    # each minting its own CA, a per-shard trust store would measure TLS
+    # plumbing, not write-plane scaling — and the arms must share transport.
+    procs, wprocs = [], []
+    try:
+        for k in range(num_shards):
+            procs.append(spawn(
+                "--role", "host", "--serve-port", "0", "--insecure",
+                "--gang-scheduler-name", "none", "--journal-fsync",
+                "--state-dir", _os.path.join(tmp, f"shard-{k}"),
+            ))
+        urls = [_read_announcement(h, "WIRE_API=") for h in procs]
+
+        env = {"PATH": _os.environ.get("PATH", ""), "HOME": "/tmp",
+               "JAX_PLATFORMS": "cpu"}
+        wprocs.append(subprocess.Popen(
+            [sys.executable, "-c", _STORE_SHARDS_WRITER, repo,
+             ";".join(urls), str(n_jobs), str(writers), str(namespaces)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+            env=env,
+        ))
+        for p in wprocs:
+            line = p.stdout.readline()
+            assert line.strip() == "READY", f"writer never came up: {line!r}"
+
+        t0 = time.monotonic()
+        for p in wprocs:
+            p.stdin.write("\n")
+            p.stdin.flush()
+        lats, errs = [], 0
+        for p in wprocs:
+            out, _ = p.communicate(timeout=max(600, n_jobs))
+            for ln in out.splitlines():
+                if ln.startswith("ERRS="):
+                    errs += int(ln.split("=", 1)[1])
+                elif ln.startswith("LATS="):
+                    body = ln.split("=", 1)[1]
+                    if body:
+                        lats.extend(float(x) / 1e6 for x in body.split(","))
+        wall = time.monotonic() - t0
+    finally:
+        for p in wprocs + procs:
+            if p.poll() is None:
+                p.kill()
+        for p in wprocs + procs:
+            try:
+                p.communicate(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+
+    lats.sort()
+    created = n_jobs - errs
+    spread = {}
+    for i in range(n_jobs):
+        s = shard_for("JAXJob", f"bench-ns-{i % namespaces}", num_shards)
+        spread[s] = spread.get(s, 0) + 1
+    return {
+        "shards": num_shards,
+        "jobs": n_jobs,
+        "created": created,
+        "errors": errs,
+        "writers": writers,
+        "write_p50_ms": round(1000 * statistics.median(lats), 3),
+        "write_p95_ms": round(1000 * _pct(lats, 0.95), 3),
+        "write_p99_ms": round(1000 * _pct(lats, 0.99), 3),
+        "burst_wall_s": round(wall, 2),
+        "jobs_per_minute": round(60.0 * created / wall, 1) if wall else None,
+        "shard_write_spread": {str(k): v for k, v in sorted(spread.items())},
+        "fsync_floor_us": floor_us if shim is not None else 0,
+    }
+
+
+def _write_store_shards_artifact(legs, pairs, jobs, out_path,
+                                 raw_fsync_us=None, floored=True):
+    import statistics
+
+    counts = sorted({leg["shards"] for leg in legs})
+
+    def med(n, key):
+        vals = [leg[key] for leg in legs
+                if leg["shards"] == n and leg.get(key) is not None]
+        return round(statistics.median(vals), 3) if vals else None
+
+    medians = {
+        str(n): {
+            "write_p50_ms": med(n, "write_p50_ms"),
+            "write_p99_ms": med(n, "write_p99_ms"),
+            "jobs_per_minute": med(n, "jobs_per_minute"),
+        }
+        for n in counts
+    }
+    p50_1 = medians.get("1", {}).get("write_p50_ms")
+    p50_2 = medians.get("2", {}).get("write_p50_ms")
+    artifact = {
+        "bench": "store-shards",
+        "what": (f"write p50 + jobs/minute vs write-shard count at a "
+                 f"{jobs}-JAXJob create burst through the client-side "
+                 "shard router (cluster/wire_shards.py)"),
+        "method": (
+            "each leg: N independent --journal-fsync host OS processes "
+            "(every record pays a per-record fsync — held to the "
+            "realistic floor in the `disk` block — inside the store "
+            "write lock: the serial resource the shards split), fresh "
+            "state dirs, one writer SUBPROCESS with 8 threads sharing one "
+            "pipelined client (concurrent creates coalesce into wire-v2 "
+            "POST /batch envelopes, the flagship bulk-submission shape) "
+            "splitting the same burst round-robin across 16 namespaces "
+            "(crc32 namespace-hash routing, the PR 15 shard map); "
+            "shards=1 is a plain unrouted RemoteAPIServer (the compat "
+            "arm); legs interleave across shard counts per round "
+            "(bench-wire-v2 method) so machine drift hits every arm; "
+            "loopback HTTP on all arms. CAVEAT: this build box has ONE "
+            "core — every host process shares it, so CPU-bound shard "
+            "parallelism is invisible here and the measured speedup is "
+            "the fsync/store-lock overlap floor; a multi-core "
+            "deployment only widens the gap."
+        ),
+        "disk": {
+            "box_raw_fsync_us": raw_fsync_us,
+            "fsync_floor_applied": bool(floored),
+            "fsync_floor_rationale": (
+                "this VM's virtio disk acks fsync in ~0.15ms — far below "
+                "any durable cloud volume (1-10ms); the shard hosts run "
+                "under an LD_PRELOAD shim padding fsync to the floor in "
+                "every leg's fsync_floor_us, with the GIL released during "
+                "the pad, so the per-record durability wait is realistic "
+                "and identically applied to every arm"
+            ) if floored else (
+                "no C compiler for the fsync-floor shim: legs ran against "
+                "the raw disk, whose ~0.15ms fsync makes the write path "
+                "CPU-bound — shard scaling is NOT expected to show on a "
+                "single-core box in this mode"
+            ),
+        },
+        "rounds_planned": pairs,
+        "rounds_completed": max((leg.get("round", 0) for leg in legs),
+                                default=0),
+        "legs": legs,
+        "medians_by_shard_count": medians,
+        "two_shards_beat_one_write_p50": bool(
+            p50_1 is not None and p50_2 is not None and p50_2 < p50_1
+        ),
+        "write_p50_speedup_2_over_1": (
+            round(p50_1 / p50_2, 3) if p50_1 and p50_2 else None
+        ),
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+        f.write("\n")
+    return artifact
+
+
+def run_store_shards(jobs: int = 5000, pairs: int = 2, counts=(1, 2, 4),
+                     out: str = "BENCH_SELF_STORE_SHARDS_r17.json",
+                     floor_us: int = 2500):
+    shim = _build_fsync_floor()
+    raw_us = _raw_fsync_us()
+    if shim is None:
+        print("store-shards: no C compiler for the fsync-floor shim; "
+              "legs run against the raw (unrealistically fast) disk",
+              file=sys.stderr)
+    legs = []
+    artifact = None
+    for rnd in range(pairs):
+        for n in counts:
+            leg = _store_shards_leg(n, jobs, shim=shim, floor_us=floor_us)
+            leg["round"] = rnd + 1
+            legs.append(leg)
+            print(
+                f"round {rnd + 1}/{pairs} shards={n}: "
+                f"p50={leg['write_p50_ms']}ms p99={leg['write_p99_ms']}ms "
+                f"jobs/min={leg['jobs_per_minute']} errors={leg['errors']}",
+                file=sys.stderr,
+            )
+            # Rewrite after every leg: a crashed later leg must not
+            # discard completed measurements.
+            artifact = _write_store_shards_artifact(
+                legs, pairs, jobs, out,
+                raw_fsync_us=raw_us, floored=shim is not None,
+            )
+    return artifact
+
+
+def run_wire_driver_stub(out: str = "BENCH_SELF_WIRE_DRIVER_r17.json"):
+    """The machine-readable stand-in for the driver-side wire baseline:
+    the 1.797x overhead ratio (BENCH_r05) has not been externally
+    re-measured since PR 6, and until a driver machine runs the wire leg
+    again every README claim chains off a self-measured proxy. This stub
+    runs the quick-sized wire_overhead block and emits it WITH an explicit
+    `external_baseline_unmeasured: true`, so the hole is a queryable field
+    instead of a README footnote."""
+    proxy = run_wire_overhead(n_jobs=100)
+    artifact = {
+        "bench": "wire-driver-stub",
+        "external_baseline_unmeasured": True,
+        "external_baseline_r05": {
+            "wire_p50_s": 0.6621,
+            "inproc_p50_s": 0.3684,
+            "overhead_ratio_p50": 1.797,
+            "target": "<= 1.5x on the driver machine",
+            "last_measured": "PR 6 (BENCH_r05); not re-measured since",
+        },
+        "self_measured_proxy": proxy,
+        "method": (
+            "quick-sized (100-job) wire-vs-inproc overhead block on the "
+            "build container — a PROXY, not the driver baseline: different "
+            "machine, and loopback HTTP when the TLS dep is absent. When a "
+            "driver machine re-runs the wire leg, replace this artifact "
+            "and flip external_baseline_unmeasured to false."
+        ),
+    }
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1)
+        f.write("\n")
+    return artifact
+
+
 def run_failover(jobs: int = 120, watch_sessions: int = 4,
                  out: str = "BENCH_SELF_FAILOVER_r12.json"):
     import statistics
@@ -2772,6 +3184,29 @@ def main():
                          "(default 1000)")
     ap.add_argument("--shards-out", default="BENCH_SELF_SHARDS_r15.json",
                     help="artifact path for --shards-only")
+    ap.add_argument("--store-shards-only", action="store_true",
+                    help="run ONLY the sharded write-plane block: write p50 "
+                         "+ jobs/minute vs write-shard count (1/2/4 fsync'd "
+                         "host processes behind the client-side router, "
+                         "interleaved legs) -> BENCH_SELF_STORE_SHARDS "
+                         "artifact")
+    ap.add_argument("--store-shards-jobs", type=int, default=5000,
+                    help="create-burst size per write-shard leg")
+    ap.add_argument("--store-shards-pairs", type=int, default=2,
+                    help="interleaved rounds across shard counts")
+    ap.add_argument("--store-shards-counts", default="1,2,4",
+                    help="comma-separated shard counts to sweep")
+    ap.add_argument("--store-shards-out",
+                    default="BENCH_SELF_STORE_SHARDS_r17.json",
+                    help="artifact path for --store-shards-only")
+    ap.add_argument("--wire-driver-stub", action="store_true",
+                    help="emit the self-measured wire-overhead proxy with "
+                         "an explicit external_baseline_unmeasured=true "
+                         "field (the driver-side 1.797x has not been "
+                         "re-measured since PR 6)")
+    ap.add_argument("--wire-driver-out",
+                    default="BENCH_SELF_WIRE_DRIVER_r17.json",
+                    help="artifact path for --wire-driver-stub")
     ap.add_argument("--node-chaos-only", action="store_true",
                     help="run only the node-loss MTTR block (kill one host "
                          "of a whole-slice TPU gang; measure detect -> "
@@ -3027,6 +3462,48 @@ def main():
                     "carries the 1k-session standby-offload write p50",
             "vs_baseline": None,
             "shards": block,
+        }))
+        return
+
+    if args.store_shards_only:
+        counts = tuple(
+            int(x) for x in args.store_shards_counts.split(",") if x.strip()
+        )
+        artifact = run_store_shards(jobs=args.store_shards_jobs,
+                                    pairs=args.store_shards_pairs,
+                                    counts=counts,
+                                    out=args.store_shards_out)
+        print(json.dumps({
+            "metric": "store_shard_write_p50_speedup_2_over_1",
+            "value": artifact["write_p50_speedup_2_over_1"],
+            "unit": "x (1-shard write p50 / 2-shard write p50, medians of "
+                    "interleaved fsync'd create-burst legs through the "
+                    "client-side shard router)",
+            "vs_baseline": None,
+            "store_shards": {
+                "medians_by_shard_count": artifact["medians_by_shard_count"],
+                "two_shards_beat_one_write_p50":
+                    artifact["two_shards_beat_one_write_p50"],
+                "artifact": args.store_shards_out,
+            },
+        }))
+        return
+
+    if args.wire_driver_stub:
+        artifact = run_wire_driver_stub(out=args.wire_driver_out)
+        print(json.dumps({
+            "metric": "wire_driver_external_baseline_unmeasured",
+            "value": artifact["external_baseline_unmeasured"],
+            "unit": "bool (true until a driver machine re-measures the "
+                    "1.797x wire ratio; self_measured_proxy is the tracked "
+                    "stand-in)",
+            "vs_baseline": artifact["external_baseline_r05"][
+                "overhead_ratio_p50"],
+            "wire_driver": {
+                "self_measured_ratio_p50":
+                    artifact["self_measured_proxy"]["overhead_ratio_p50"],
+                "artifact": args.wire_driver_out,
+            },
         }))
         return
 

@@ -646,6 +646,48 @@ class TestCodelint:
         assert not check_source("x.py", daemon, package_rel="utils/x.py")
         assert not check_source("x.py", joined, package_rel="utils/x.py")
 
+    # CL012 — HostStore construction outside the shard factory seam
+    # (cluster/shards.py make_store): a direct ctor bypasses the routing
+    # map, so the object's journal records can land off their mapped shard.
+    CL012_TABLE = [
+        ("direct-ctor",
+         "from training_operator_tpu.cluster.store import HostStore\n"
+         "def f(root):\n    return HostStore(root)\n",
+         "cluster/runtime.py", ["CL012"]),
+        ("attribute-ctor",
+         "from training_operator_tpu.cluster import store\n"
+         "def f(root):\n    return store.HostStore(root, wal_ring=16)\n",
+         "soak/harness.py", ["CL012"]),
+        ("module-level-ctor",
+         "from training_operator_tpu.cluster.store import HostStore\n"
+         "S = HostStore('/tmp/x')\n",
+         "observe/fleet.py", ["CL012"]),
+        ("factory-module-exempt",
+         "def make(root):\n    return HostStore(root)\n",
+         "cluster/shards.py", []),
+        ("make_store-call-legal",
+         "from training_operator_tpu.cluster.shards import make_store\n"
+         "def f(root):\n    return make_store(root, num_shards=2)\n",
+         "cluster/replication.py", []),
+        ("type-hint-not-a-ctor",
+         "from training_operator_tpu.cluster.store import HostStore\n"
+         "def f(s: HostStore) -> HostStore:\n    return s\n",
+         "cluster/replication.py", []),
+    ]
+
+    @pytest.mark.parametrize(
+        "case,src,rel,want", CL012_TABLE, ids=[c[0] for c in CL012_TABLE]
+    )
+    def test_cl012_table(self, case, src, rel, want):
+        found = check_source(rel.split("/")[-1], src, package_rel=rel)
+        assert [f.rule_id for f in found] == want, (case, found)
+
+    def test_cl012_message_names_the_seam(self):
+        src = ("from training_operator_tpu.cluster.store import HostStore\n"
+               "s = HostStore('/x')\n")
+        found = check_source("x.py", src, package_rel="engine/x.py")
+        assert len(found) == 1 and "make_store" in found[0].message
+
 
 class TestCLI:
     def test_all_presets_exit_zero(self, capsys):

@@ -464,3 +464,103 @@ class TestShardKnobs:
             OperatorConfig(operator_shards=0).validate()
         with pytest.raises(ValueError):
             OperatorConfig(shard_takeover_grace=0.0).validate()
+
+
+class TestStoreShardKnobs:
+    """PR 17 satellite: store_shards / store_meta_shard ride the same
+    flag -> OperatorConfig -> real-construction path as every other knob
+    (make_host_store for the shard factory seam, make_remote_api for the
+    client-side router). store_shards=1 pins today's topology exactly."""
+
+    def test_cli_flags_reach_the_shard_factory(self, tmp_path):
+        from training_operator_tpu.__main__ import make_host_store
+        from training_operator_tpu.cluster.shards import StoreShardSet
+
+        args = parse_args(["--store-shards", "3", "--store-meta-shard", "1"])
+        cfg = build_config(args)
+        store = make_host_store(cfg, str(tmp_path))
+        assert isinstance(store, StoreShardSet)
+        assert store.num_shards == 3 and store.meta_shard == 1
+        store.close()
+
+    def test_config_file_round_trip(self, tmp_path):
+        path = tmp_path / "op.json"
+        path.write_text(json.dumps({"store_shards": 2}))
+        cfg = build_config(parse_args(["--config", str(path)]))
+        assert cfg.store_shards == 2 and cfg.store_meta_shard == 0
+        # CLI overrides the file.
+        cfg = build_config(parse_args(
+            ["--config", str(path), "--store-shards", "4"]))
+        assert cfg.store_shards == 4
+
+    def test_default_is_a_plain_host_store(self, tmp_path):
+        from training_operator_tpu.__main__ import make_host_store
+        from training_operator_tpu.cluster.store import HostStore
+
+        cfg = build_config(parse_args([]))
+        assert cfg.store_shards == 1 and cfg.store_meta_shard == 0
+        store = make_host_store(cfg, str(tmp_path))
+        assert type(store) is HostStore, "shards=1 is the pre-shard topology"
+        store.close()
+
+    def test_durability_knobs_reach_every_shard(self, tmp_path):
+        from training_operator_tpu.__main__ import make_host_store
+
+        args = parse_args(["--store-shards", "2", "--compact-every", "64",
+                           "--journal-fsync", "--replication-wal-ring", "128"])
+        store = make_host_store(build_config(args), str(tmp_path))
+        for s in store.shards:
+            assert s.compact_every == 64
+            assert s.fsync_per_record is True
+            assert s.wal_ring == 128
+        store.close()
+
+    def test_remote_api_builds_the_shard_router(self):
+        from training_operator_tpu.__main__ import make_remote_api
+        from training_operator_tpu.cluster.httpapi import (
+            RemoteAPIServer,
+            ShardedRemoteAPIServer,
+        )
+
+        cfg = build_config(parse_args(["--store-shards", "2",
+                                       "--store-meta-shard", "1"]))
+        remote = make_remote_api(
+            cfg,
+            "http://127.0.0.1:1001,http://127.0.0.1:1002 ;"
+            " http://127.0.0.1:2001",
+        )
+        assert isinstance(remote, ShardedRemoteAPIServer)
+        assert remote.meta_shard == 1
+        assert remote.shard_remotes[0].addresses == [
+            "http://127.0.0.1:1001", "http://127.0.0.1:1002"]
+        assert remote.shard_remotes[1].addresses == ["http://127.0.0.1:2001"]
+        # One address group stays the plain client (compat pin).
+        cfg = build_config(parse_args([]))
+        remote = make_remote_api(cfg, "http://127.0.0.1:1001")
+        assert isinstance(remote, RemoteAPIServer)
+
+    def test_remote_api_group_count_mismatch_refuses(self):
+        from training_operator_tpu.__main__ import make_remote_api
+
+        cfg = build_config(parse_args(["--store-shards", "3"]))
+        with pytest.raises(SystemExit):
+            make_remote_api(cfg, "http://127.0.0.1:1001;http://127.0.0.1:2001")
+
+    def test_host_and_standby_roles_refuse_multi_shard(self):
+        from training_operator_tpu.__main__ import run_host, run_standby
+
+        args = parse_args(["--role", "host", "--store-shards", "2"])
+        with pytest.raises(SystemExit, match="one write shard"):
+            run_host(args, build_config(args))
+        args = parse_args(["--role", "standby", "--store-shards", "2",
+                           "--standby-of", "http://127.0.0.1:9"])
+        with pytest.raises(SystemExit, match="one shard host"):
+            run_standby(args, build_config(args))
+
+    def test_validation_bounds(self):
+        with pytest.raises(ValueError):
+            OperatorConfig(store_shards=0).validate()
+        with pytest.raises(ValueError):
+            OperatorConfig(store_shards=2, store_meta_shard=2).validate()
+        with pytest.raises(ValueError):
+            OperatorConfig(store_meta_shard=-1).validate()

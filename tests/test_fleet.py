@@ -98,7 +98,7 @@ class TestInvariantRules:
     def test_catalog_is_complete_and_unique(self):
         ids = [r.rule_id for r in RULES]
         assert ids == sorted(set(ids))
-        assert ids == [f"INV00{i}" for i in range(1, 10)] + ["INV010"]
+        assert ids == [f"INV00{i}" for i in range(1, 10)] + ["INV010", "INV011"]
 
     def test_inv001_orphaned_pod(self):
         cluster = make_cluster(tpu_slices=0)

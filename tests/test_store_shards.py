@@ -1,0 +1,662 @@
+"""Sharded write plane (PR 17 tentpole): the (kind, namespace) routing
+map, the StoreShardSet behind the APIServer's single journal-sink seam,
+the client-side shard router with cross-shard watch fan-in, INV011
+ownership auditing, and the sharded soak smoke.
+
+The contract under test, end to end:
+
+- One object lives on exactly ONE shard — journal, WAL ring, standby,
+  epoch chain. Cluster-scoped kinds (Node, PriorityClass, ClusterQueue,
+  Lease) and empty namespaces pin to the meta-shard.
+- `store_shards=1` is byte-identical to the pre-shard topology (the
+  replay pin): make_store returns a plain HostStore over the same layout.
+- One shard's failure degrades exactly that shard: its ring outrun
+  relists only its keys (ShardRelistReset), its failover heals its
+  watch sessions by chained delta, and the other shards never notice.
+"""
+
+import time
+
+import pytest
+
+from training_operator_tpu.api.jobs import JAXJob, ObjectMeta
+from training_operator_tpu.cluster import wire
+from training_operator_tpu.cluster.httpapi import (
+    ApiHTTPServer,
+    CachedReadAPI,
+    RemoteAPIServer,
+    ShardedRemoteAPIServer,
+    ShardRelistReset,
+)
+from training_operator_tpu.cluster.objects import ConfigMap, Node
+from training_operator_tpu.cluster.runtime import Cluster, VirtualClock
+from training_operator_tpu.cluster.shards import (
+    CLUSTER_SCOPED_KINDS,
+    StoreShardSet,
+    make_store,
+    shard_for,
+    shard_root,
+)
+from training_operator_tpu.cluster.store import HostStore
+from training_operator_tpu.controllers.leader import shard_of
+from training_operator_tpu.observe.invariants import (
+    FleetSources,
+    InvariantAuditor,
+    RULES,
+)
+from training_operator_tpu.utils import metrics
+
+# crc32 pins for num_shards=2 (the map is stable by construction — it is
+# the ShardElector's): these namespaces land where the tests assume.
+NS_S0 = "alpha"   # -> shard 0
+NS_S1 = "beta"    # -> shard 1
+
+
+def _cm(name, ns):
+    return ConfigMap(metadata=ObjectMeta(name=name, namespace=ns),
+                     data={"k": name})
+
+
+def _job(name, ns):
+    return JAXJob(metadata=ObjectMeta(name=name, namespace=ns))
+
+
+def _resume_counters():
+    return {
+        "delta": metrics.wire_resume_delta.total(),
+        "too_old": metrics.wire_resume_too_old.total(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# The routing map
+# ---------------------------------------------------------------------------
+
+
+class TestRoutingMap:
+    def test_namespace_hash_matches_the_shard_elector(self):
+        """One map for both planes: an operator shard's namespaces all
+        land on one write shard because shard_for IS shard_of."""
+        for ns in ("alpha", "beta", "team-0", "prod", "x" * 40):
+            for n in (2, 3, 4, 7):
+                assert shard_for("JAXJob", ns, n) == shard_of(ns, n)
+
+    def test_pins_for_this_file(self):
+        assert shard_for("ConfigMap", NS_S0, 2) == 0
+        assert shard_for("ConfigMap", NS_S1, 2) == 1
+
+    def test_cluster_scoped_kinds_pin_to_meta_shard(self):
+        for kind in CLUSTER_SCOPED_KINDS:
+            for meta in (0, 1, 2):
+                assert shard_for(kind, "anything", 3, meta) == meta
+
+    def test_empty_namespace_pins_to_meta_shard(self):
+        assert shard_for("ConfigMap", "", 4, 2) == 2
+        assert shard_for("ConfigMap", None, 4, 2) == 2
+
+    def test_single_shard_is_always_zero(self):
+        assert shard_for("JAXJob", "any", 1) == 0
+        assert shard_for("Node", "", 1) == 0
+
+    def test_shard_root_layout(self, tmp_path):
+        root = str(tmp_path)
+        assert shard_root(root, 0, 1) == root, "shards=1 is the old layout"
+        assert shard_root(root, 2, 4).endswith("store-shard-2")
+
+
+# ---------------------------------------------------------------------------
+# StoreShardSet: the in-process shape
+# ---------------------------------------------------------------------------
+
+
+class TestStoreShardSet:
+    def test_make_store_one_shard_is_a_plain_host_store(self, tmp_path):
+        store = make_store(str(tmp_path))
+        assert type(store) is HostStore, "the replay pin: no wrapper at 1"
+        store.close()
+
+    def test_shard_set_refuses_one_shard(self, tmp_path):
+        with pytest.raises(ValueError):
+            StoreShardSet(str(tmp_path), 1)
+
+    def test_writes_land_on_exactly_one_shard_journal(self, tmp_path):
+        cluster = Cluster(VirtualClock())
+        store = make_store(str(tmp_path), num_shards=2)
+        store.load_into(cluster.api)
+        store.attach(cluster.api)
+        cluster.api.create(_cm("a", NS_S0))
+        cluster.api.create(_cm("b", NS_S1))
+        cluster.api.create(Node(metadata=ObjectMeta(name="n0", namespace=""),
+                                capacity={"cpu": 1}))
+        assert store.object_counts() == {0: 2, 1: 1}  # node pins to meta
+        assert store.shards[0].journal_records() == 2
+        assert store.shards[1].journal_records() == 1
+        rep = store.ownership_report()
+        assert rep["duplicates"] == [] and rep["misrouted"] == []
+        store.close()
+
+    def test_reload_restores_every_shard_and_ownership(self, tmp_path):
+        cluster = Cluster(VirtualClock())
+        store = make_store(str(tmp_path), num_shards=3)
+        store.load_into(cluster.api)
+        store.attach(cluster.api)
+        for i in range(12):
+            cluster.api.create(_job(f"j{i}", f"team-{i}"))
+        counts = store.object_counts()
+        store.close()
+
+        fresh = Cluster(VirtualClock())
+        store2 = make_store(str(tmp_path), num_shards=3)
+        objects, _ = store2.load_into(fresh.api)
+        assert objects == 12
+        assert len(fresh.api.list("JAXJob")) == 12
+        assert store2.object_counts() == counts
+        rep = store2.ownership_report()
+        assert rep["duplicates"] == [] and rep["misrouted"] == []
+        store2.close()
+
+    def test_deletes_unwind_ownership(self, tmp_path):
+        cluster = Cluster(VirtualClock())
+        store = make_store(str(tmp_path), num_shards=2)
+        store.load_into(cluster.api)
+        store.attach(cluster.api)
+        cluster.api.create(_cm("a", NS_S0))
+        cluster.api.delete("ConfigMap", NS_S0, "a")
+        assert store.object_counts() == {0: 0, 1: 0}
+        store.close()
+
+    def test_abandon_shard_degrades_only_that_shard(self, tmp_path):
+        cluster = Cluster(VirtualClock())
+        store = make_store(str(tmp_path), num_shards=2)
+        store.load_into(cluster.api)
+        store.attach(cluster.api)
+        before = metrics.store_shard_failovers.value("1")
+        store.abandon_shard(1)
+        assert store.shards[1].degraded and not store.shards[0].degraded
+        assert store.degraded  # the set reports the worst shard
+        assert metrics.store_shard_failovers.value("1") == before + 1
+        # The healthy shard keeps journaling.
+        cluster.api.create(_cm("still-up", NS_S0))
+        assert store.shards[0].journal_records() == 1
+        store.close()
+
+    def test_replace_shard_adopts_a_standby_store(self, tmp_path):
+        cluster = Cluster(VirtualClock())
+        store = make_store(str(tmp_path), num_shards=2)
+        store.load_into(cluster.api)
+        store.attach(cluster.api)
+        cluster.api.create(_cm("pre", NS_S1))
+        store.abandon_shard(1)
+        adopted = make_store(str(tmp_path / "standby-1"))
+        adopted.open_journal()
+        store.replace_shard(1, adopted)
+        assert not store.shards[1].degraded
+        cluster.api.create(_cm("post", NS_S1))
+        assert adopted.journal_records() == 1, "writes flow to the adoptee"
+        # Ownership tracked the SLOT across the swap: pre + post both owned.
+        assert store.object_counts()[1] == 2
+        store.close()
+
+    def test_shard_writes_metric_labels_by_shard(self, tmp_path):
+        cluster = Cluster(VirtualClock())
+        store = make_store(str(tmp_path), num_shards=2)
+        store.load_into(cluster.api)
+        store.attach(cluster.api)
+        b0 = metrics.store_shard_writes.value("0")
+        b1 = metrics.store_shard_writes.value("1")
+        cluster.api.create(_cm("a", NS_S0))
+        cluster.api.create(_cm("b", NS_S1))
+        cluster.api.create(_cm("c", NS_S1))
+        assert metrics.store_shard_writes.value("0") == b0 + 1
+        assert metrics.store_shard_writes.value("1") == b1 + 2
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# INV011: shard-ownership invariant
+# ---------------------------------------------------------------------------
+
+
+class TestINV011:
+    def _auditor(self, cluster, feed):
+        return InvariantAuditor(
+            cluster.api, cluster.clock.now,
+            sources=FleetSources(store_shards=feed), interval=10.0,
+        )
+
+    def _detect(self, cluster, auditor):
+        grace = next(r for r in RULES if r.rule_id == "INV011").grace
+        first = auditor.audit()
+        cluster.clock.advance(grace + 0.001)
+        return first, auditor.audit()
+
+    def test_registered_in_the_catalog(self):
+        assert any(r.rule_id == "INV011" for r in RULES)
+
+    def test_clean_report_is_quiet(self, tmp_path):
+        cluster = Cluster(VirtualClock())
+        store = make_store(str(tmp_path), num_shards=2)
+        store.load_into(cluster.api)
+        store.attach(cluster.api)
+        cluster.api.create(_cm("a", NS_S0))
+        auditor = self._auditor(cluster, store.ownership_report)
+        first, second = self._detect(cluster, auditor)
+        assert first == [] and second == []
+        store.close()
+
+    def test_duplicate_key_fires(self):
+        cluster = Cluster(VirtualClock())
+        key = ("ConfigMap", NS_S0, "split")
+        feed = lambda: {
+            "num_shards": 2, "meta_shard": 0,
+            "counts": {0: 1, 1: 1},
+            "duplicates": [(0, 1, key)], "misrouted": [],
+        }
+        first, second = self._detect(cluster, self._auditor(cluster, feed))
+        assert [v.rule for v in second] == ["INV011"]
+        assert second[0].name == "split"
+        assert "shards 0 and 1" in second[0].message
+
+    def test_misrouted_key_fires(self):
+        cluster = Cluster(VirtualClock())
+        feed = lambda: {
+            "num_shards": 2, "meta_shard": 0,
+            "counts": {0: 1, 1: 0},
+            "duplicates": [], "misrouted": [(0, ("ConfigMap", NS_S1, "lost"))],
+        }
+        first, second = self._detect(cluster, self._auditor(cluster, feed))
+        assert [v.rule for v in second] == ["INV011"]
+        assert "routes it elsewhere" in second[0].message
+
+    def test_unsharded_feed_is_exempt(self):
+        cluster = Cluster(VirtualClock())
+        feed = lambda: {"num_shards": 1, "counts": {0: 5},
+                        "duplicates": [(0, 0, ("X", "", "y"))], "misrouted": []}
+        first, second = self._detect(cluster, self._auditor(cluster, feed))
+        assert first == [] and second == []
+
+
+# ---------------------------------------------------------------------------
+# The wire router
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def shard_pair():
+    """Two live shard hosts + the router over them (shard 0 = meta)."""
+    clusters = [Cluster(), Cluster()]
+    servers = [ApiHTTPServer(c.api, port=0) for c in clusters]
+    router = ShardedRemoteAPIServer(
+        shard_addresses=[[s.url] for s in servers], timeout=5.0
+    )
+    try:
+        yield clusters, servers, router
+    finally:
+        for s in servers:
+            s.close()
+
+
+class TestShardedWire:
+    def test_writes_and_strong_reads_route_by_namespace(self, shard_pair):
+        clusters, _, router = shard_pair
+        router.create(_cm("a", NS_S0))
+        router.create(_cm("b", NS_S1))
+        # Physical placement: each host holds exactly its shard's objects.
+        assert [c.metadata.name for c in clusters[0].api.list("ConfigMap")] == ["a"]
+        assert [c.metadata.name for c in clusters[1].api.list("ConfigMap")] == ["b"]
+        # Strong reads come from the owning shard.
+        assert router.get("ConfigMap", NS_S0, "a").data["k"] == "a"
+        assert router.get("ConfigMap", NS_S1, "b").data["k"] == "b"
+        # Update/delete route home too.
+        b = router.get("ConfigMap", NS_S1, "b")
+        b.data["k"] = "b2"
+        router.update(b)
+        assert clusters[1].api.get("ConfigMap", NS_S1, "b").data["k"] == "b2"
+        router.delete("ConfigMap", NS_S1, "b")
+        assert router.try_get("ConfigMap", NS_S1, "b") is None
+
+    def test_cluster_scoped_kinds_live_on_the_meta_shard(self, shard_pair):
+        clusters, _, router = shard_pair
+        router.create(Node(metadata=ObjectMeta(name="n0", namespace=""),
+                           capacity={"cpu": 1}))
+        assert len(clusters[0].api.list("Node")) == 1
+        assert len(clusters[1].api.list("Node")) == 0
+        assert router.get("Node", "", "n0") is not None
+        assert len(router.list("Node")) == 1, "no fan-out for pinned kinds"
+
+    def test_unnamespaced_list_fans_out_and_merges(self, shard_pair):
+        _, _, router = shard_pair
+        for i in range(3):
+            router.create(_cm(f"a{i}", NS_S0))
+        for i in range(2):
+            router.create(_cm(f"b{i}", NS_S1))
+        assert len(router.list("ConfigMap")) == 5
+        assert len(router.list("ConfigMap", namespace=NS_S0)) == 3
+        assert len(router.list("ConfigMap", namespace=NS_S1)) == 2
+
+    def test_list_page_walks_shards_with_a_shard_cursor(self, shard_pair):
+        _, _, router = shard_pair
+        for i in range(5):
+            router.create(_cm(f"a{i}", NS_S0))
+        for i in range(4):
+            router.create(_cm(f"b{i}", NS_S1))
+        pages, token, names = 0, None, []
+        while True:
+            items, token = router.list_page("ConfigMap", limit=3,
+                                            continue_token=token)
+            names.extend(o.metadata.name for o in items)
+            pages += 1
+            if token is None:
+                break
+            assert ":" in token, "continue token carries the shard cursor"
+        assert sorted(names) == sorted(
+            [f"a{i}" for i in range(5)] + [f"b{i}" for i in range(4)]
+        )
+        assert len(names) == len(set(names)), "no page overlap across shards"
+        assert pages >= 4
+
+    def test_merged_watch_delivers_exactly_once(self, shard_pair):
+        _, _, router = shard_pair
+        wq = router.watch(kinds=["ConfigMap"])
+        expected = set()
+        for i in range(4):
+            router.create(_cm(f"a{i}", NS_S0))
+            expected.add(f"a{i}")
+        for i in range(4):
+            router.create(_cm(f"b{i}", NS_S1))
+            expected.add(f"b{i}")
+        got = []
+        deadline = time.monotonic() + 5.0
+        while len(got) < 8 and time.monotonic() < deadline:
+            got.extend(wq.drain(timeout=0.5))
+        names = [e.obj.metadata.name for e in got]
+        assert sorted(names) == sorted(expected), "each event exactly once"
+        router.unwatch(wq)
+
+    def test_get_fleet_sums_across_shards(self, shard_pair):
+        _, _, router = shard_pair
+        router.create(_cm("a", NS_S0))
+        router.create(_cm("b", NS_S1))
+        fleet = router.get_fleet()
+        assert fleet["objects"].get("ConfigMap") == 2
+        plane = fleet["store_shards"]
+        assert plane["num_shards"] == 2 and plane["meta_shard"] == 0
+        assert plane["counts"] == {0: 1, 1: 1}
+        assert len(plane["per_shard"]) == 2
+
+    def test_events_and_pod_logs_route_by_namespace(self, shard_pair):
+        clusters, _, router = shard_pair
+        router.append_pod_log(NS_S1, "pod-x", "hello", ts=1.0)
+        lines, _ = clusters[1].api.read_pod_log(NS_S1, "pod-x")
+        assert any("hello" in l for l in lines)
+        lines0, _ = clusters[0].api.read_pod_log(NS_S1, "pod-x")
+        assert lines0 == []
+        lines_r, _ = router.read_pod_log(NS_S1, "pod-x")
+        assert any("hello" in l for l in lines_r)
+
+    def test_sdk_surface_delegates_to_meta_shard(self, shard_pair):
+        _, servers, router = shard_pair
+        # SyncedClock / TLS plumbing read whole-cluster attributes.
+        assert router.base_url == servers[0].url
+        assert router.addresses == [servers[0].url]
+
+    def test_group_count_validation(self, shard_pair):
+        _, servers, _ = shard_pair
+        with pytest.raises(ValueError):
+            ShardedRemoteAPIServer(shard_addresses=[[servers[0].url]])
+
+
+# ---------------------------------------------------------------------------
+# Cross-shard watch fan-in: per-shard watermarks, per-shard healing
+# ---------------------------------------------------------------------------
+
+
+class TestPerShardResume:
+    def test_one_shard_outrun_relists_only_that_shard(self):
+        """Shard 1's ring is outrun; shard 0's session never dropped. The
+        heal must relist shard 1 ONLY: shard 0 stays on the delta path and
+        its remote's list() is never called."""
+        clusters = [Cluster(), Cluster()]
+        servers = [
+            ApiHTTPServer(clusters[0].api, port=0),  # roomy ring
+            ApiHTTPServer(clusters[1].api, port=0, resume_ring_size=4),
+        ]
+        try:
+            router = ShardedRemoteAPIServer(
+                shard_addresses=[[s.url] for s in servers], timeout=5.0
+            )
+            wq = router.watch(kinds=["ConfigMap"])
+            router.create(_cm("seed-a", NS_S0))
+            router.create(_cm("seed-b", NS_S1))
+            got = []
+            deadline = time.monotonic() + 5.0
+            while len(got) < 2 and time.monotonic() < deadline:
+                got.extend(wq.drain(timeout=0.5))
+            assert len(got) == 2
+
+            # Kill both shards' sessions; outrun ONLY shard 1's ring.
+            for s in servers:
+                s.reap_all_sessions()
+            router.create(_cm("a-delta", NS_S0))      # 1 missed on shard 0
+            for i in range(10):                        # 10 missed >> ring 4
+                router.create(_cm(f"b{i}", NS_S1))
+
+            before = _resume_counters()
+            lists = [[], []]
+            origs = [r.list for r in router.shard_remotes]
+            for i, r in enumerate(router.shard_remotes):
+                r.list = (lambda i=i, orig=origs[i]: lambda *a, **k:
+                          lists[i].append(a[0]) or orig(*a, **k))()
+            try:
+                events = []
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    events.extend(wq.drain(timeout=0.5))
+                    names = {e.obj.metadata.name for e in events
+                             if not isinstance(e, ShardRelistReset)}
+                    if "a-delta" in names and "b9" in names:
+                        break
+            finally:
+                for r, orig in zip(router.shard_remotes, origs):
+                    r.list = orig
+            got = _resume_counters()
+            assert got["too_old"] - before["too_old"] == 1, (
+                "exactly one shard relisted"
+            )
+            assert got["delta"] - before["delta"] >= 1, (
+                "the intact shard healed by delta"
+            )
+            assert lists[0] == [], "shard 0 must never relist"
+            assert sorted(lists[1]) == sorted(wire.KIND_REGISTRY)
+            names = [e.obj.metadata.name for e in events
+                     if not isinstance(e, ShardRelistReset)]
+            # Shard 0's delta arrives exactly once; shard 1's relist
+            # re-announces its full state (seed-b + b0..b9), once each.
+            assert names.count("a-delta") == 1
+            assert names.count("seed-a") == 0, "no relist echo from shard 0"
+            assert names.count("b9") == 1
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_shard_relist_reset_is_scoped_for_mirrors(self):
+        """With reset_on_relist, the merged queue delivers a
+        ShardRelistReset carrying the ownership predicate — a mirror drops
+        only that shard's keys (CachedReadAPI path)."""
+        clusters = [Cluster(), Cluster()]
+        servers = [
+            ApiHTTPServer(clusters[0].api, port=0),
+            ApiHTTPServer(clusters[1].api, port=0, resume_ring_size=4),
+        ]
+        try:
+            router = ShardedRemoteAPIServer(
+                shard_addresses=[[s.url] for s in servers], timeout=5.0
+            )
+            cached = CachedReadAPI(router)
+            pump = router.watch()  # the manager-tick analogue that pumps
+            router.create(_cm("a0", NS_S0))
+            router.create(_cm("b0", NS_S1))
+            pump.drain(timeout=1.0)
+            assert len(cached.list("ConfigMap")) == 2  # primes the mirror
+
+            for s in servers:
+                s.reap_all_sessions()
+            for i in range(10):
+                router.create(_cm(f"b{i + 1}", NS_S1))
+            router.delete("ConfigMap", NS_S1, "b0")  # ghost-at-risk key
+
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                pump.drain(timeout=0.5)
+                names = {c.metadata.name for c in cached.list("ConfigMap")}
+                if names == {"a0"} | {f"b{i + 1}" for i in range(10)}:
+                    break
+                time.sleep(0.05)
+            names = {c.metadata.name for c in cached.list("ConfigMap")}
+            assert "b0" not in names, "the shard relist must drop the ghost"
+            assert "a0" in names, "the intact shard's mirror entry survives"
+            assert len(names) == 11
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_shard_relist_reset_sentinel_shape(self):
+        ev = ShardRelistReset(2, lambda kind, ns: ns == NS_S1)
+        assert ev.shard == 2
+        assert ev.owns("ConfigMap", NS_S1)
+        assert not ev.owns("ConfigMap", NS_S0)
+
+
+# ---------------------------------------------------------------------------
+# Per-shard failover over the wire: epoch-chained delta, one shard only
+# ---------------------------------------------------------------------------
+
+
+class TestPerShardFailover:
+    def test_one_shard_fails_over_by_chained_delta_others_undisturbed(
+            self, tmp_path):
+        """Shard 1 is a real HA pair (primary + WAL-tailing standby with
+        the epoch chain); shard 0 is a plain host. Kill shard 1's primary:
+        the router's shard-1 client rotates to the promoted standby and
+        the merged watch heals that shard by CHAINED delta — zero relists
+        — while shard 0's session, objects, and writes never notice."""
+        from training_operator_tpu.cluster.chaos import HostChaos
+        from tests.test_failover import PrimaryStack, StandbyStack, _resume_deltas
+
+        shard0 = Cluster()
+        server0 = ApiHTTPServer(shard0.api, port=0)
+        primary = PrimaryStack(tmp_path / "s1-primary", nodes=0)
+        standby = None
+        try:
+            standby = StandbyStack(tmp_path / "s1-standby", primary.url)
+            router = ShardedRemoteAPIServer(
+                shard_addresses=[[server0.url],
+                                 [primary.url, standby.url]],
+                timeout=5.0,
+            )
+            wq = router.watch(kinds=["ConfigMap"])
+            router.create(_cm("a-pre", NS_S0))
+            router.create(_cm("b-pre", NS_S1))
+            got = []
+            deadline = time.monotonic() + 5.0
+            while len(got) < 2 and time.monotonic() < deadline:
+                got.extend(wq.drain(timeout=0.5))
+            assert len(got) == 2
+            standby.wait_caught_up()
+
+            before = _resume_counters()
+            HostChaos().kill_inprocess(
+                "primary-1", server=primary.server, store=primary.store,
+                stop=primary.stop, threads=[primary.thread],
+            )
+            standby.wait_promoted()
+
+            # Shard 1 writes ride the rotation to the promoted standby;
+            # shard 0 writes never blocked at all.
+            router.create(_cm("a-during", NS_S0))
+            wrote = False
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                try:
+                    router.create(_cm("b-post", NS_S1))
+                    wrote = True
+                    break
+                except Exception:
+                    time.sleep(0.05)
+            assert wrote, "shard 1 never accepted a write after failover"
+
+            lists = [[], []]
+            origs = [r.list for r in router.shard_remotes]
+            for i, r in enumerate(router.shard_remotes):
+                r.list = (lambda i=i, orig=origs[i]: lambda *a, **k:
+                          lists[i].append(a[0]) or orig(*a, **k))()
+            try:
+                events = []
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline:
+                    try:
+                        events.extend(wq.drain(timeout=0.5))
+                    except Exception:
+                        continue
+                    names = {e.obj.metadata.name for e in events
+                             if not isinstance(e, ShardRelistReset)}
+                    if {"a-during", "b-post"} <= names:
+                        break
+            finally:
+                for r, orig in zip(router.shard_remotes, origs):
+                    r.list = orig
+            names = [e.obj.metadata.name for e in events
+                     if not isinstance(e, ShardRelistReset)]
+            assert {"a-during", "b-post"} <= set(names)
+            assert len(names) == len(set(names)), "exactly once across merge"
+            got = _resume_deltas(before)
+            assert got["too_old"] == 0, "failover must heal by chained delta"
+            assert lists == [[], []], "no relist on either shard"
+            # Shard 0 held its state the whole time.
+            assert {c.metadata.name for c in shard0.api.list("ConfigMap")} \
+                == {"a-pre", "a-during"}
+            assert not standby.errors, standby.errors
+        finally:
+            if standby is not None:
+                standby.shutdown()
+            primary.shutdown()
+            server0.close()
+
+
+# ---------------------------------------------------------------------------
+# Sharded soak smoke: 2 write shards + one per-shard failover, INV011 live
+# ---------------------------------------------------------------------------
+
+
+class TestShardedSoakSmoke:
+    def test_compressed_hour_with_two_store_shards(self, tmp_path):
+        """The acceptance smoke: a compressed fleet hour with all five
+        chaos tiers, store_shards=2 (each shard with its own lockstep
+        standby), the host tier's failover taken as a PER-SHARD failover,
+        under the fail-fast INV001-INV011 auditor."""
+        from tests.test_soak import smoke_config
+        from training_operator_tpu.soak.harness import SoakHarness
+
+        h = SoakHarness(smoke_config(store_shards=2), str(tmp_path))
+        report = h.run()
+        jobs = report["jobs"]
+        assert jobs["completed"] == jobs["submitted"] > 100
+        assert jobs["failed"] == 0, jobs
+        assert report["auditor"]["violations"] == 0
+        assert report["chaos"].get("host:failover", 0) == 1
+        plane = report["store_shards"]
+        assert plane["num_shards"] == 2
+        # Exactly one per-shard failover, starting on a non-meta shard,
+        # with WAL parity and the other shard's journal undisturbed.
+        assert len(plane["failovers"]) == 1
+        fo = plane["failovers"][0]
+        assert fo["shard"] != plane["meta_shard"]
+        assert fo["replication_parity"]
+        assert fo["other_shards_undisturbed"]
+        assert fo["wal_records_replicated"] > 0
+        # INV011's evidence stayed clean to the end.
+        own = plane["ownership"]
+        assert own["duplicates"] == [] and own["misrouted"] == []
+        assert sum(own["counts"].values()) > 0
+        # Both shards actually took writes (the namespace spread works).
+        assert all(c > 0 for c in own["counts"].values()), own["counts"]

@@ -309,6 +309,17 @@ def parse_args(argv=None) -> argparse.Namespace:
                     help="shard/membership lease duration: how long a dead "
                          "replica's shards stay unowned before survivors "
                          "adopt them (default 10)")
+    ap.add_argument("--store-shards", type=int, default=None,
+                    help="partition the durable store by namespace hash "
+                         "into this many write shards, each a full "
+                         "journal/WAL/standby chain; host role refuses >1 "
+                         "(run one host process per shard), operator role "
+                         "expects ';'-separated per-shard address groups "
+                         "in --api-server (default 1 = single store)")
+    ap.add_argument("--store-meta-shard", type=int, default=None,
+                    help="shard index owning cluster-scoped kinds (Node, "
+                         "PriorityClass, ClusterQueue, Lease) and "
+                         "empty-namespace objects (default 0)")
     ap.add_argument("--read-from-standby", dest="read_from_standby",
                     action="store_true", default=None,
                     help="operator role: route LISTs, watch sessions, "
@@ -417,6 +428,10 @@ def build_config(args: argparse.Namespace) -> OperatorConfig:
         cfg.operator_shards = args.operator_shards
     if args.shard_takeover_grace is not None:
         cfg.shard_takeover_grace = args.shard_takeover_grace
+    if args.store_shards is not None:
+        cfg.store_shards = args.store_shards
+    if args.store_meta_shard is not None:
+        cfg.store_meta_shard = args.store_meta_shard
     if args.read_from_standby is not None:
         cfg.read_from_standby = args.read_from_standby
     cfg.validate()
@@ -708,13 +723,19 @@ def _install_stop() -> threading.Event:
 
 
 def make_host_store(cfg: OperatorConfig, state_dir: str):
-    """The HostStore exactly as run_host constructs it — factored out so
-    the knob round-trip tests (test_config_knobs.py pattern) exercise the
-    REAL flag->config->store path, not a parallel construction."""
-    from training_operator_tpu.cluster.store import HostStore
+    """The durable store plane exactly as run_host constructs it — factored
+    out so the knob round-trip tests (test_config_knobs.py pattern)
+    exercise the REAL flag->config->store path, not a parallel
+    construction. `store_shards=1` (default) returns a plain HostStore —
+    the exact pre-shard topology; >1 returns a StoreShardSet (in-process
+    deployments only; run_host refuses >1 and expects one host process
+    per shard)."""
+    from training_operator_tpu.cluster.shards import make_store
 
-    return HostStore(
+    return make_store(
         state_dir,
+        num_shards=cfg.store_shards,
+        meta_shard=cfg.store_meta_shard,
         compact_every=cfg.compact_every,
         compact_max_bytes=cfg.compact_max_journal_bytes,
         fsync_per_record=cfg.journal_fsync,
@@ -731,12 +752,17 @@ def make_remote_api(cfg: OperatorConfig, url: str, token: "str | None" = None,
 
     `url` may be a comma-separated HA endpoint list ("primary,standby"):
     the client speaks to the first and rotates on transport failure or a
-    NotLeader answer (RemoteAPIServer addresses)."""
-    from training_operator_tpu.cluster.httpapi import RemoteAPIServer
+    NotLeader answer (RemoteAPIServer addresses). With `store_shards` > 1
+    it is a ';'-separated list of per-shard HA groups
+    ("s0-primary,s0-standby;s1-primary,s1-standby") and the client is the
+    shard router (ShardedRemoteAPIServer): writes and strong reads routed
+    by (kind, namespace), each group rotating independently on failover."""
+    from training_operator_tpu.cluster.httpapi import (
+        RemoteAPIServer,
+        ShardedRemoteAPIServer,
+    )
 
-    addresses = [u.strip() for u in url.split(",") if u.strip()]
-    return RemoteAPIServer(
-        addresses=addresses,
+    client_kwargs = dict(
         token=token,
         ca_file=ca_file,
         pipeline=cfg.wire_pipeline_depth > 0,
@@ -749,6 +775,22 @@ def make_remote_api(cfg: OperatorConfig, url: str, token: "str | None" = None,
         # events/logs/timelines ride a standby address at bounded staleness.
         read_from_standby=cfg.read_from_standby,
     )
+    groups = [
+        [u.strip() for u in grp.split(",") if u.strip()]
+        for grp in url.split(";") if grp.strip()
+    ]
+    if cfg.store_shards > 1 or len(groups) > 1:
+        if len(groups) != max(cfg.store_shards, len(groups)):
+            raise SystemExit(
+                f"--store-shards {cfg.store_shards} needs exactly that many "
+                f"';'-separated --api-server address groups (got {len(groups)})"
+            )
+        return ShardedRemoteAPIServer(
+            shard_addresses=groups,
+            meta_shard=cfg.store_meta_shard,
+            **client_kwargs,
+        )
+    return RemoteAPIServer(addresses=groups[0], **client_kwargs)
 
 
 def _schedule_cert_rotation(cluster, server, args, cert_dir, ca_path, ca_key):
@@ -787,6 +829,17 @@ def run_host(args, cfg) -> int:
         raise SystemExit("--role host requires a real clock (remote processes share no virtual time)")
     if args.workload:
         raise SystemExit("--workload runs controllers; submit via an operator/SDK instead")
+    if cfg.store_shards > 1:
+        # One host PROCESS per write shard: each shard is an ordinary
+        # single-store host (journal + WAL + standby + epoch chain); the
+        # operator side's --store-shards router composes them. A >1 value
+        # here would shard one process's durability against itself with
+        # nothing to gain — refuse loudly instead of half-working.
+        raise SystemExit(
+            "--role host runs exactly one write shard; start "
+            f"{cfg.store_shards} host processes (one per shard) and give "
+            "the operator --store-shards with ';'-separated address groups"
+        )
     from training_operator_tpu.cluster.runtime import WallClock
 
     # Wall clock, not monotonic: host timestamps go into durable state and
@@ -940,6 +993,11 @@ def run_standby(args, cfg) -> int:
     # replicated from the primary; building local nodes here would collide
     # with the replicated ones at the first applied record.
     cluster = Cluster(WallClock())
+    if cfg.store_shards > 1:
+        raise SystemExit(
+            "--role standby tails exactly one shard host; run one standby "
+            "per shard (point each at its own --standby-of)"
+        )
     store = None
     if args.state_dir:
         store = make_host_store(cfg, args.state_dir)

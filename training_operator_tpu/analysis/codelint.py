@@ -31,6 +31,13 @@ Rules (all ERROR; the tree must stay green — `make lint` runs this):
         CL005 pattern applied to the fleet auditor's rule catalog): the
         INV001-INV006 reference table in the README holds only if every
         rule the auditor can evaluate is declared in that one module.
+  CL012 host-store-outside-factory    constructing `HostStore(...)` anywhere
+        but `cluster/shards.py` (the `make_store` factory seam). A bare
+        HostStore bypasses the (kind, namespace) shard map: it builds an
+        unsharded durability plane next to a sharded one, and the two
+        journals silently disagree about which objects' history they own.
+        `cluster/store.py` defines the class; `cluster/shards.py` is the
+        only module allowed to instantiate it.
   CL007 full-store-walk-in-scheduler    an unfiltered `.list("Pod")` /
         `.list("Node")` / `.list_refs(...)` over the Pod or Node kinds
         anywhere in scheduler/ outside snapshot.py. The incremental solver
@@ -63,7 +70,7 @@ SNAPSHOT_MUTABLE_ATTRS = ("free", "nodes", "slices")
 # modules behind it. Matched by module path suffix so both absolute imports
 # and the files' own package_rel identify consistently.
 WIRE_MODULES = ("httpapi", "wire_server", "wire_transport", "wire_watch",
-                "wire_runtime")
+                "wire_runtime", "wire_shards")
 
 
 def _is_wire_module_path(module: str) -> bool:
@@ -160,6 +167,19 @@ def _is_full_store_walk(call: ast.Call) -> bool:
     return isinstance(arg, ast.Constant) and arg.value in FULL_WALK_KINDS
 
 
+# The durable-store construction seam (CL012): the one module allowed to
+# call the HostStore constructor. Name-matched like the other rules: a bare
+# `HostStore(...)` or an attribute call ending in `.HostStore(...)`.
+STORE_FACTORY_MODULE = "cluster/shards.py"
+
+
+def _is_host_store_ctor(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id == "HostStore"
+    return isinstance(f, ast.Attribute) and f.attr == "HostStore"
+
+
 def _is_thread_ctor(call: ast.Call) -> bool:
     f = call.func
     if isinstance(f, ast.Attribute) and f.attr == "Thread":
@@ -215,6 +235,8 @@ def check_source(path: str, source: str, package_rel: Optional[str] = None) -> L
     in_wire_layer = any(
         rel.endswith(f"cluster/{m}.py") for m in WIRE_MODULES
     )
+    # The one module allowed to construct HostStore (CL012).
+    in_store_factory = rel.endswith(STORE_FACTORY_MODULE)
 
     for node in ast.walk(tree):
         if (
@@ -266,6 +288,17 @@ def check_source(path: str, source: str, package_rel: Optional[str] = None) -> L
                 f"full-store walk inside scheduler/; the solve cycle is "
                 f"O(changed) only while walks stay in snapshot.py's "
                 f"prime/rebuild path",
+            ))
+        if (
+            isinstance(node, ast.Call)
+            and not in_store_factory
+            and _is_host_store_ctor(node)
+        ):
+            findings.append(Finding(
+                path, node.lineno, "CL012",
+                "HostStore construction outside cluster/shards.py; go "
+                "through the make_store factory so the shard map cannot "
+                "be bypassed",
             ))
         if isinstance(node, ast.Call) and _is_time_sleep(node) and in_control_pkg:
             findings.append(Finding(

@@ -21,6 +21,11 @@ processes (cmd/training-operator.v1/main.go:134-166). The pieces:
                      shape-compatible with `Cluster` for OperatorManager and
                      TrainingClient, but backed by a RemoteAPIServer.
                      [wire_runtime.py]
+  ShardedRemoteAPIServer
+                   — the sharded-write-plane client: N per-shard
+                     RemoteAPIServers behind the same surface, writes and
+                     strong reads routed by (kind, namespace), watches
+                     merged shard-scoped. [wire_shards.py]
 
 This module carried all four concerns in one 1,300-line file until round 6;
 it is now the import surface only. Everything the rest of the tree (and
@@ -39,6 +44,7 @@ from training_operator_tpu.cluster.wire_runtime import (
     SyncedClock,
 )
 from training_operator_tpu.cluster.wire_server import ApiHTTPServer
+from training_operator_tpu.cluster.wire_shards import ShardedRemoteAPIServer
 from training_operator_tpu.cluster.wire_transport import (
     ApiServerError,
     ApiUnavailableError,
@@ -50,6 +56,7 @@ from training_operator_tpu.cluster.wire_watch import (
     RELIST_RESET,
     CachedReadAPI,
     RemoteWatchQueue,
+    ShardRelistReset,
 )
 
 __all__ = [
@@ -63,5 +70,7 @@ __all__ = [
     "RemoteRuntime",
     "RemoteTimelines",
     "RemoteWatchQueue",
+    "ShardRelistReset",
+    "ShardedRemoteAPIServer",
     "SyncedClock",
 ]

@@ -149,6 +149,15 @@ class StandbyController:
     ):
         self.cluster = cluster
         self.api = cluster.api
+        # Seq-lockstep tailing assumes ONE WAL stream; a sharded plane runs
+        # one standby PROCESS per write shard (each a vanilla pair against
+        # that shard's host), never one standby over a StoreShardSet —
+        # reject the topology here rather than corrupt cursors downstream.
+        if store is not None and not hasattr(store, "wal_page"):
+            raise TypeError(
+                "StandbyController requires a single-shard HostStore; run "
+                "one standby per write shard (see cluster/shards.py)"
+            )
         self.store = store
         self.primary_url = primary_url
         # Dedicated single-address client: resume/pipelining are watch/write

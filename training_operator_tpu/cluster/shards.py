@@ -1,0 +1,278 @@
+"""Sharded write plane: partition the HostStore by namespace hash.
+
+PR 15 sharded the *operators* and moved LISTs/watches onto the warm
+standby, but every write still funneled through one HostStore primary —
+the last single-process ceiling. This module partitions the durable store
+by namespace hash (the same `crc32 % N` map controllers/leader.py's
+ShardElector uses, so a reconcile loop's namespace lands on exactly one
+write shard) into N full HostStores, each with its own journal,
+generation chain, WAL ring, and (in the wire deployment) its own warm
+standby and epoch chain. The reference substrate scales the same way:
+Kubernetes spreads the apiserver over sharded etcd.
+
+Two deployment shapes share the routing map in `shard_for`:
+
+  in-process   StoreShardSet below — one live APIServer, N HostStores.
+               The APIServer keeps its single journal-sink seam
+               (attach_journal); the shard set registers ONE routing sink
+               that forwards each mutation record to the owning shard's
+               journal. `store_shards=1` degenerates to a single HostStore
+               with the exact pre-shard layout (shard-0 subdirectory
+               aside, see `make_store` which pins the flat layout for 1).
+  wire         cluster/wire_shards.py ShardedRemoteAPIServer — one
+               RemoteAPIServer per shard host (each an ordinary PR 9
+               primary/standby pair), writes and strong reads routed by
+               (kind, namespace), watches fanned in.
+
+Cluster-scoped kinds (Node, PriorityClass, ClusterQueue, Lease) and
+empty-namespace objects have no namespace to hash: they pin to an explicit
+*meta-shard* (`store_meta_shard`, default 0) via the routing table below,
+so every router in the fleet agrees where a Node lives.
+
+Construction discipline (codelint CL012): `HostStore` is constructed ONLY
+here (`make_store`) — a bare `HostStore(...)` elsewhere would bypass the
+shard map and silently build an unsharded plane next to a sharded one.
+
+INV011 (observe/invariants.py): no object readable from two shards. The
+routing sink maintains a per-shard live-key set; `ownership_report()`
+exposes per-shard counts plus any key held by two shards (duplicate) or
+held by a shard the map does not assign it to (misrouted).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from training_operator_tpu.cluster.apiserver import APIServer
+from training_operator_tpu.cluster.store import HostStore
+from training_operator_tpu.controllers.leader import shard_of
+from training_operator_tpu.utils import metrics
+from training_operator_tpu.utils.locks import TrackedLock
+
+log = logging.getLogger(__name__)
+
+# Kinds with no namespace to hash: pinned to the meta-shard. This is THE
+# routing table — the wire router, the in-process shard set, and INV011's
+# ownership check all import it, so they cannot disagree about where a
+# cluster-scoped object lives.
+CLUSTER_SCOPED_KINDS = frozenset({"Node", "PriorityClass", "ClusterQueue", "Lease"})
+
+
+def shard_for(kind: str, namespace: Optional[str], num_shards: int,
+              meta_shard: int = 0) -> int:
+    """(kind, namespace) -> owning shard index. Cluster-scoped kinds and
+    empty namespaces pin to the meta-shard; everything else hashes its
+    namespace with the same crc32 map the ShardElector uses, so an
+    operator shard's namespaces all land on one write shard."""
+    if num_shards <= 1:
+        return 0
+    if kind in CLUSTER_SCOPED_KINDS or not namespace:
+        return meta_shard
+    return shard_of(namespace, num_shards)
+
+
+def shard_root(root: str, idx: int, num_shards: int) -> str:
+    """On-disk root for shard `idx`. With one shard this is `root` itself —
+    the exact pre-shard layout, so `store_shards=1` restarts over a state
+    directory written by any earlier release (and vice versa)."""
+    if num_shards <= 1:
+        return root
+    return os.path.join(root, f"store-shard-{idx}")
+
+
+def make_store(root: str, num_shards: int = 1, meta_shard: int = 0,
+               **store_kwargs: Any):
+    """THE construction seam for the durable store plane (codelint CL012
+    allows `HostStore(...)` only in this module). Returns a plain
+    `HostStore` for `num_shards == 1` — byte-identical topology to every
+    release before the knob existed — and a `StoreShardSet` otherwise.
+    `store_kwargs` pass through to each shard's HostStore
+    (compact_every, compact_max_bytes, fsync_per_record, wal_ring)."""
+    if num_shards <= 1:
+        return HostStore(root, **store_kwargs)
+    return StoreShardSet(root, num_shards, meta_shard=meta_shard,
+                         **store_kwargs)
+
+
+class _RestoreRecorder:
+    """Shim handed to one shard's `load_into`: records the restored keys
+    into that shard's ownership set, then delegates to the real APIServer.
+    `restore` is additive, so loading N shards sequentially composes."""
+
+    def __init__(self, api: APIServer, keys: Set[Tuple[str, str, str]]):
+        self._api = api
+        self._keys = keys
+
+    def restore(self, objects, rv, events=None, pod_logs=None):
+        for obj in objects:
+            self._keys.add((obj.KIND, obj.metadata.namespace or "",
+                            obj.metadata.name))
+        self._api.restore(objects, rv, events, pod_logs)
+
+
+class StoreShardSet:
+    """N HostStores behind the APIServer's single journal-sink seam.
+
+    The APIServer journals write-ahead through ONE sink; this class's
+    routing sink derives (kind, namespace) from each mutation record and
+    forwards it to the owning shard's sink, so each shard's journal holds
+    exactly its own objects' history. Reads stay on the live APIServer —
+    sharding partitions durability and (in the wire deployment)
+    write-path processes, not the in-memory index.
+
+    Lock discipline: `_lock` (order class "store", the PR 16
+    name-not-instance convention — same class as each shard HostStore's
+    own lock) guards only the ownership bookkeeping and is NEVER held
+    across a shard-store call, so no store→store self-edge exists for the
+    witness to flag."""
+
+    def __init__(self, root: str, num_shards: int, meta_shard: int = 0,
+                 **store_kwargs: Any) -> None:
+        if num_shards < 2:
+            raise ValueError("StoreShardSet needs >= 2 shards; use "
+                             "make_store() which pins 1 to a plain HostStore")
+        if not 0 <= meta_shard < num_shards:
+            raise ValueError("meta_shard must be in [0, num_shards)")
+        self.root = root
+        self.num_shards = num_shards
+        self.meta_shard = meta_shard
+        self.shards: List[HostStore] = [
+            HostStore(shard_root(root, i, num_shards), **store_kwargs)
+            for i in range(num_shards)
+        ]
+        self._lock = TrackedLock("store")
+        self._keys: List[Set[Tuple[str, str, str]]] = [
+            set() for _ in range(num_shards)
+        ]
+
+    # -- routing ---------------------------------------------------------
+
+    def shard_index(self, kind: str, namespace: Optional[str]) -> int:
+        return shard_for(kind, namespace, self.num_shards, self.meta_shard)
+
+    def shard_for_object(self, kind: str, namespace: Optional[str]) -> HostStore:
+        return self.shards[self.shard_index(kind, namespace)]
+
+    def _route(self, op: str, *args: Any) -> None:
+        """The single journal sink registered on the APIServer. Derives the
+        owning shard from the record's (kind, namespace) and forwards —
+        each record lands in exactly one shard's journal. Runs inside the
+        APIServer lock (journal is write-ahead), so records arrive in
+        store write order per shard."""
+        if op == "put":
+            obj = args[0]
+            kind, ns = obj.KIND, obj.metadata.namespace or ""
+            key = (kind, ns, obj.metadata.name)
+        elif op == "del":
+            kind, ns = args[0], args[1] or ""
+            key = (kind, ns, args[2])
+        elif op == "event":
+            kind, ns, key = "Event", args[0].namespace or "", None
+        else:  # "log"
+            kind, ns, key = "Pod", args[0] or "", None
+        idx = self.shard_index(kind, ns)
+        self.shards[idx]._sink(op, *args)
+        metrics.store_shard_writes.inc(str(idx))
+        if key is not None:
+            with self._lock:
+                if op == "put":
+                    self._keys[idx].add(key)
+                else:
+                    self._keys[idx].discard(key)
+
+    # -- HostStore-compatible lifecycle surface --------------------------
+
+    def load_into(self, api: APIServer) -> Tuple[int, int]:
+        """Restore every shard into the one live APIServer (restore is
+        additive); returns summed (objects, replayed records)."""
+        objects = replayed = 0
+        for i, s in enumerate(self.shards):
+            n, r = s.load_into(_RestoreRecorder(api, self._keys[i]))
+            objects += n
+            replayed += r
+        return objects, replayed
+
+    def attach(self, api: APIServer) -> None:
+        """Open every shard's journal, then register the ONE routing sink."""
+        for s in self.shards:
+            s.open_journal()
+        api.attach_journal(self._route)
+
+    def maybe_compact(self, api: APIServer) -> bool:
+        did = False
+        for s in self.shards:
+            did = s.maybe_compact(api) or did
+        return did
+
+    def close(self) -> None:
+        for s in self.shards:
+            s.close()
+
+    def abandon(self) -> None:
+        for s in self.shards:
+            s.abandon()
+
+    def abandon_shard(self, idx: int) -> None:
+        """SIGKILL semantics for ONE shard (the per-shard failover drill):
+        that shard's journal fh is dropped and its degraded latch set; the
+        other shards keep journaling."""
+        self.shards[idx].abandon()
+        metrics.store_shard_failovers.inc(str(idx))
+
+    def replace_shard(self, idx: int, store: HostStore) -> None:
+        """Adopt a promoted standby's store as shard `idx` (the per-shard
+        failover's final step). The replacement must already have its
+        journal open (or be attached via open_journal by the caller);
+        ownership bookkeeping carries over — the key set tracks the shard
+        slot, not the store instance."""
+        self.shards[idx] = store
+
+    @property
+    def degraded(self) -> bool:
+        return any(s.degraded for s in self.shards)
+
+    def journal_bytes(self) -> int:
+        return sum(s.journal_bytes() for s in self.shards)
+
+    def journal_records(self) -> int:
+        return sum(s.journal_records() for s in self.shards)
+
+    def wal_ring_len(self) -> int:
+        """Summed WAL-ring occupancy across shards (the growth-audit feed;
+        per-shard occupancies ride the soak accumulators individually)."""
+        return sum(s.wal_ring_len() for s in self.shards)
+
+    # -- INV011 evidence -------------------------------------------------
+
+    def object_counts(self) -> Dict[int, int]:
+        """Per-shard live object counts (the INV011 feed's cheap half)."""
+        with self._lock:
+            return {i: len(k) for i, k in enumerate(self._keys)}
+
+    def ownership_report(self, spot_check: int = 64) -> Dict[str, Any]:
+        """INV011 evidence: per-shard counts, every key readable from two
+        shards (`duplicates`), and a bounded spot check that each shard's
+        keys are the ones the routing map assigns to it (`misrouted`).
+        Lists are capped — the auditor needs existence, not a dump."""
+        with self._lock:
+            keys = [set(k) for k in self._keys]
+        counts = {i: len(k) for i, k in enumerate(keys)}
+        duplicates: List[Tuple[int, int, Tuple[str, str, str]]] = []
+        for i in range(self.num_shards):
+            for j in range(i + 1, self.num_shards):
+                for key in list(keys[i] & keys[j])[:8]:
+                    duplicates.append((i, j, key))
+        misrouted: List[Tuple[int, Tuple[str, str, str]]] = []
+        for i, shard_keys in enumerate(keys):
+            for key in list(shard_keys)[:max(0, spot_check)]:
+                if self.shard_index(key[0], key[1]) != i:
+                    misrouted.append((i, key))
+        return {
+            "num_shards": self.num_shards,
+            "meta_shard": self.meta_shard,
+            "counts": counts,
+            "duplicates": duplicates[:16],
+            "misrouted": misrouted[:16],
+        }

@@ -333,13 +333,12 @@ class HostStore:
         finally:
             os.close(fd)
 
-    def attach(self, api: APIServer) -> None:
-        """Open the current-generation journal for append and register as
-        the APIServer's journal sink. From here on every mutation lands in
-        the journal before the API call returns (the sink runs inside the
-        store lock). A torn tail recorded during replay is physically
-        truncated HERE — the moment before the first new append could have
-        merged with the fragment."""
+    def open_journal(self) -> None:
+        """Open the current-generation journal for append. A torn tail
+        recorded during replay is physically truncated HERE — the moment
+        before the first new append could have merged with the fragment.
+        Split from attach() so a sharded plane can open every shard's
+        journal while registering a single routing sink on the APIServer."""
         path = os.path.join(self.root, journal_name(self._gen))
         torn_at = self._torn_tails.pop(path, None)
         if torn_at is not None and os.path.exists(path):
@@ -350,6 +349,13 @@ class HostStore:
         # The dirent of a brand-new generation file must be durable before
         # records in it count as persisted.
         self._fsync_dir()
+
+    def attach(self, api: APIServer) -> None:
+        """Open the current-generation journal for append and register as
+        the APIServer's journal sink. From here on every mutation lands in
+        the journal before the API call returns (the sink runs inside the
+        store lock)."""
+        self.open_journal()
         api.attach_journal(self._sink)
 
     def _sink(self, op: str, *args: Any) -> None:

@@ -1,0 +1,453 @@
+"""Client-side shard router: one wire client over N shard hosts.
+
+The wire deployment of the sharded write plane (cluster/shards.py holds
+the in-process shape and THE routing map): every write shard is an
+ordinary PR 9 host — its own journal, WAL ring, warm standby, epoch
+chain, HA address list — and this module is the client that makes N of
+them look like one control plane:
+
+  ShardedRemoteAPIServer   routes create/update/delete and strong
+                           single-object reads by (kind, namespace) to
+                           the owning shard's RemoteAPIServer. Each inner
+                           client keeps its own address rotation, so one
+                           shard's failover degrades exactly that shard —
+                           the other shards' pipelines never notice.
+  _MergedWatchQueue        cross-shard watch fan-in: one queue merging N
+                           per-shard sessions into one exactly-once
+                           consumer feed. Exactly-once falls out of
+                           disjoint key ownership (an object's events
+                           exist on precisely one shard's stream); each
+                           shard's per-kind seq watermarks and healing
+                           stay inside that shard's _SharedWatch, so one
+                           shard's ring outrun relists ONLY that shard
+                           (delivered as a shard-scoped ShardRelistReset,
+                           never the global RELIST_RESET).
+  _ShardedTimelines        record_span/mark routed by namespace; flush
+                           fans out.
+
+Aggregation surfaces fan out and merge: `list(kind)` concatenates the
+shards (a namespaced list asks only the owning shard); `list_page`
+carries a shard cursor in its continue token (`"<shard>:<inner>"`);
+`get_fleet` sums object/job counts over the shards and attaches the
+per-shard breakdown under `store_shards`.
+
+Cluster-scoped kinds (Node, PriorityClass, ClusterQueue, Lease) and
+empty namespaces pin to the meta-shard — the explicit routing table in
+cluster/shards.py, shared with the server-side StoreShardSet so client
+and store can never disagree where an object lives.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from training_operator_tpu.cluster import wire
+from training_operator_tpu.cluster.shards import CLUSTER_SCOPED_KINDS, shard_for
+from training_operator_tpu.cluster.wire_transport import (
+    RemoteAPIServer,
+    quote_seg,
+)
+from training_operator_tpu.cluster.wire_watch import (
+    RELIST_RESET,
+    ShardRelistReset,
+)
+from training_operator_tpu.utils import metrics
+
+log = logging.getLogger(__name__)
+
+
+class _MergedWatchQueue:
+    """One consumer feed over N per-shard watch queues.
+
+    Each inner queue rides its shard client's shared session with its own
+    per-kind watermarks; this wrapper only concatenates drains and
+    rewrites the per-shard RELIST_RESET sentinel into a ShardRelistReset
+    scoped by the router's ownership predicate (a mirror drops only that
+    shard's keys). `drain(timeout)` gives the explicit timeout to one
+    shard per call, rotating, and polls the rest with the bare drain
+    (whose block window bounds idle wire cost) — total blocking stays
+    O(one long-poll), not O(shards)."""
+
+    def __init__(self, router: "ShardedRemoteAPIServer", queues: List[Any],
+                 kinds: Optional[List[str]] = None):
+        self._router = router
+        self._queues = queues
+        self.kinds = set(kinds) if kinds else None
+        self._rotate = 0
+
+    # reset_on_relist / overflow_limit propagate to every shard queue so a
+    # mirror-building consumer configures the merge exactly like a single
+    # RemoteWatchQueue.
+    @property
+    def reset_on_relist(self) -> bool:
+        return bool(self._queues and self._queues[0].reset_on_relist)
+
+    @reset_on_relist.setter
+    def reset_on_relist(self, value: bool) -> None:
+        for q in self._queues:
+            q.reset_on_relist = value
+
+    @property
+    def overflow_limit(self) -> int:
+        return self._queues[0].overflow_limit if self._queues else 0
+
+    @overflow_limit.setter
+    def overflow_limit(self, value: int) -> None:
+        for q in self._queues:
+            q.overflow_limit = value
+
+    @property
+    def watch_id(self):
+        return [q.watch_id for q in self._queues]
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._queues)
+
+    def _scope(self, shard: int, items: List[Any]) -> List[Any]:
+        out = []
+        for ev in items:
+            if ev is RELIST_RESET:
+                # One shard relisted; the others' sessions are intact.
+                # Scoping the reset is what keeps a single shard's
+                # too_old from forcing a fleet-wide mirror rebuild.
+                out.append(ShardRelistReset(shard, self._router.owns(shard)))
+            else:
+                out.append(ev)
+        return out
+
+    def drain(self, timeout: Optional[float] = None) -> List[Any]:
+        out: List[Any] = []
+        n = len(self._queues)
+        blocking = self._rotate % n if n else 0
+        self._rotate += 1
+        for i, q in enumerate(self._queues):
+            out.extend(self._scope(
+                i, q.drain(timeout if i == blocking else None)
+            ))
+        return out
+
+    def poll_local(self) -> List[Any]:
+        out: List[Any] = []
+        for i, q in enumerate(self._queues):
+            out.extend(self._scope(i, q.poll_local()))
+        return out
+
+
+class _ShardedTimelines:
+    """`RemoteTimelines` duck-type over the router: spans and marks land
+    on the shard that owns the job's namespace (so a timeline lives next
+    to its job's history); flush fans out."""
+
+    def __init__(self, router: "ShardedRemoteAPIServer"):
+        self._router = router
+
+    def now(self) -> float:
+        return self._router.meta_remote.timelines.now()
+
+    def record_span(self, namespace: str, name: str, *args: Any,
+                    **kwargs: Any) -> None:
+        self._router.shard_remote("Timeline", namespace).timelines.record_span(
+            namespace, name, *args, **kwargs
+        )
+
+    def mark(self, namespace: str, name: str, *args: Any,
+             **kwargs: Any) -> None:
+        self._router.shard_remote("Timeline", namespace).timelines.mark(
+            namespace, name, *args, **kwargs
+        )
+
+    def flush(self) -> None:
+        for r in self._router.shard_remotes:
+            r.timelines.flush()
+
+
+class ShardedRemoteAPIServer:
+    """N per-shard RemoteAPIServers behind the one client surface the
+    engine, SDK, and CachedReadAPI consume.
+
+    Build either from `shard_addresses` — one HA address list per shard
+    (each list is that shard's primary + standbys, rotated independently
+    on failover) — or from prebuilt `remotes` (tests). Every client knob
+    (`token`, `ca_file`, `pipeline`, `coalesce_window_ms`, ...) passes
+    through to each inner client unchanged.
+
+    Unknown attributes delegate to the meta-shard's client: `addresses`,
+    `token`, `ca_file`, `base_url`, `list_page_limit`, `server_time`, the
+    SyncedClock probe surface — anything whole-cluster-scoped reads the
+    shard that owns the cluster-scoped kinds."""
+
+    def __init__(
+        self,
+        shard_addresses: Optional[List[List[str]]] = None,
+        meta_shard: int = 0,
+        remotes: Optional[List[RemoteAPIServer]] = None,
+        **client_kwargs: Any,
+    ) -> None:
+        if remotes is None:
+            if not shard_addresses or len(shard_addresses) < 2:
+                raise ValueError(
+                    "ShardedRemoteAPIServer needs >= 2 shard address groups; "
+                    "use a plain RemoteAPIServer for one"
+                )
+            remotes = [
+                RemoteAPIServer(addresses=list(addrs), **client_kwargs)
+                for addrs in shard_addresses
+            ]
+        if len(remotes) < 2:
+            raise ValueError("ShardedRemoteAPIServer needs >= 2 shards")
+        if not 0 <= meta_shard < len(remotes):
+            raise ValueError("meta_shard must be in [0, num_shards)")
+        self.shard_remotes: List[RemoteAPIServer] = list(remotes)
+        self.num_shards = len(self.shard_remotes)
+        self.meta_shard = meta_shard
+
+    # -- routing ---------------------------------------------------------
+
+    @property
+    def meta_remote(self) -> RemoteAPIServer:
+        return self.shard_remotes[self.meta_shard]
+
+    def shard_index(self, kind: str, namespace: Optional[str]) -> int:
+        return shard_for(kind, namespace, self.num_shards, self.meta_shard)
+
+    def shard_remote(self, kind: str, namespace: Optional[str]) -> RemoteAPIServer:
+        return self.shard_remotes[self.shard_index(kind, namespace)]
+
+    def owns(self, shard: int) -> Callable[[str, str], bool]:
+        """Ownership predicate for `shard` (fed to ShardRelistReset)."""
+        return lambda kind, ns: self.shard_index(kind, ns) == shard
+
+    def _write_to(self, kind: str, namespace: Optional[str]) -> RemoteAPIServer:
+        idx = self.shard_index(kind, namespace)
+        metrics.store_shard_writes.inc(str(idx))
+        return self.shard_remotes[idx]
+
+    # -- writes + strong single-object reads -----------------------------
+
+    def create(self, obj: Any) -> Any:
+        return self._write_to(obj.KIND, obj.metadata.namespace).create(obj)
+
+    def update(self, obj: Any, check_version: bool = True,
+               status_only: bool = False, coalesce: bool = True) -> Any:
+        return self._write_to(obj.KIND, obj.metadata.namespace).update(
+            obj, check_version=check_version, status_only=status_only,
+            coalesce=coalesce,
+        )
+
+    def delete(self, kind: str, namespace: str, name: str) -> Any:
+        return self._write_to(kind, namespace).delete(kind, namespace, name)
+
+    def try_delete(self, kind: str, namespace: str, name: str) -> Optional[Any]:
+        return self._write_to(kind, namespace).try_delete(kind, namespace, name)
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        return self.shard_remote(kind, namespace).get(kind, namespace, name)
+
+    def try_get(self, kind: str, namespace: str, name: str) -> Optional[Any]:
+        return self.shard_remote(kind, namespace).try_get(kind, namespace, name)
+
+    def resource_version(self, kind: str, namespace: str,
+                         name: str) -> Optional[int]:
+        return self.shard_remote(kind, namespace).resource_version(
+            kind, namespace, name
+        )
+
+    def flush_writes(self) -> None:
+        for r in self.shard_remotes:
+            r.flush_writes()
+
+    # -- lists: single-shard when namespaced, fan-out + merge otherwise --
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        limit: Optional[int] = None,
+        fields: Optional[str] = None,
+    ) -> List[Any]:
+        if namespace is not None:
+            return self.shard_remote(kind, namespace).list(
+                kind, namespace=namespace, label_selector=label_selector,
+                limit=limit, fields=fields,
+            )
+        if kind in CLUSTER_SCOPED_KINDS:
+            # Cluster-scoped kind: pinned to the meta-shard, no fan-out.
+            return self.meta_remote.list(
+                kind, label_selector=label_selector, limit=limit,
+                fields=fields,
+            )
+        out: List[Any] = []
+        for r in self.shard_remotes:
+            out.extend(r.list(kind, label_selector=label_selector,
+                              limit=limit, fields=fields))
+        return out
+
+    def list_page(
+        self,
+        kind: str,
+        limit: int,
+        continue_token: Optional[str] = None,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        fields: Optional[str] = None,
+    ) -> Tuple[List[Any], Optional[str]]:
+        """One page of a cross-shard walk. The continue token grows a
+        shard cursor — `"<shard>:<inner>"`, where `<inner>` is the owning
+        shard's own opaque token — so a paginated consumer walks shard 0
+        to exhaustion, then shard 1, and can resume mid-shard. A
+        namespaced walk stays on the owning shard (its cursor never
+        advances past it)."""
+        if continue_token:
+            seg, _, inner = continue_token.partition(":")
+            shard = int(seg)
+        else:
+            shard, inner = 0, ""
+        if namespace is not None:
+            shard = self.shard_index(kind, namespace)
+        query: Dict[str, str] = {"limit": str(int(limit))}
+        if namespace is not None:
+            query["namespace"] = namespace
+        if label_selector:
+            query["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in label_selector.items()
+            )
+        if fields:
+            query["fields"] = fields
+        if inner:
+            query["continue"] = inner
+        remote = self.shard_remotes[shard]
+        payload = remote._request(
+            "GET", f"/objects/{quote_seg(kind)}", query=query,
+            channel=remote._read_channel(),
+        )
+        items = [wire.decode(d) for d in payload["items"]]
+        inner_next = payload.get("continue")
+        if inner_next:
+            return items, f"{shard}:{inner_next}"
+        if namespace is None and shard + 1 < self.num_shards:
+            return items, f"{shard + 1}:"
+        return items, None
+
+    # -- watch fan-in ----------------------------------------------------
+
+    def watch(self, kinds: Optional[List[str]] = None) -> _MergedWatchQueue:
+        return _MergedWatchQueue(
+            self, [r.watch(kinds) for r in self.shard_remotes], kinds
+        )
+
+    def unwatch(self, queue) -> None:
+        if isinstance(queue, _MergedWatchQueue):
+            for r, q in zip(self.shard_remotes, queue._queues):
+                r.unwatch(q)
+
+    # -- events / logs ---------------------------------------------------
+
+    def record_event(self, event: Any) -> None:
+        self._write_to("Event", getattr(event, "namespace", "")).record_event(
+            event
+        )
+
+    def events(self, object_name: Optional[str] = None,
+               reason: Optional[str] = None) -> List[Any]:
+        out: List[Any] = []
+        for r in self.shard_remotes:
+            out.extend(r.events(object_name=object_name, reason=reason))
+        return out
+
+    def append_pod_log(self, namespace: str, name: str, line: str,
+                       ts: float = 0.0) -> None:
+        self._write_to("Pod", namespace).append_pod_log(
+            namespace, name, line, ts
+        )
+
+    def read_pod_log(self, namespace: str, name: str, *args: Any,
+                     **kwargs: Any) -> Any:
+        return self.shard_remote("Pod", namespace).read_pod_log(
+            namespace, name, *args, **kwargs
+        )
+
+    # -- timelines -------------------------------------------------------
+
+    @property
+    def timelines(self) -> _ShardedTimelines:
+        tl = self.__dict__.get("_timelines")
+        if tl is None:
+            tl = self.__dict__["_timelines"] = _ShardedTimelines(self)
+        return tl
+
+    def get_timeline(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+        return self.shard_remote("Timeline", namespace).get_timeline(
+            namespace, name
+        )
+
+    # -- aggregation surfaces --------------------------------------------
+
+    def get_fleet(self) -> Dict[str, Any]:
+        """Fan out GET /fleet and merge: additive sections (object and job
+        counts) sum across shards; the cluster-scoped sections (nodes,
+        slices, chips, queues — all meta-shard kinds) come from the
+        meta-shard's payload verbatim; the per-shard breakdown rides under
+        `store_shards` so `top` can show the write plane."""
+        fleets = [r.get_fleet() for r in self.shard_remotes]
+        merged = dict(fleets[self.meta_shard])
+        objects: Dict[str, int] = {}
+        jobs: Dict[str, Dict[str, int]] = {}
+        counts: Dict[int, int] = {}
+        per_shard: List[Dict[str, Any]] = []
+        for i, f in enumerate(fleets):
+            shard_objects = f.get("objects") or {}
+            for k, v in shard_objects.items():
+                objects[k] = objects.get(k, 0) + int(v)
+            for kind, states in (f.get("jobs") or {}).items():
+                bucket = jobs.setdefault(kind, {})
+                for state, c in states.items():
+                    bucket[state] = bucket.get(state, 0) + int(c)
+            counts[i] = sum(int(v) for v in shard_objects.values())
+            per_shard.append({
+                "shard": i,
+                "objects": shard_objects,
+                "store": f.get("store") or {},
+            })
+        merged["objects"] = objects
+        merged["jobs"] = jobs
+        merged["store_shards"] = {
+            "num_shards": self.num_shards,
+            "meta_shard": self.meta_shard,
+            "counts": counts,
+            "duplicates": [],
+            "misrouted": [],
+            "per_shard": per_shard,
+        }
+        return merged
+
+    def object_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for r in self.shard_remotes:
+            for k, v in r.get_fleet().get("objects", {}).items():
+                out[k] = out.get(k, 0) + int(v)
+        return out
+
+    # -- per-shard control verbs -----------------------------------------
+
+    def promote_shard(self, shard: int) -> Dict[str, Any]:
+        """Promote shard `shard`'s standby (the per-shard failover verb —
+        the other shards' chains are untouched)."""
+        metrics.store_shard_failovers.inc(str(shard))
+        return self.shard_remotes[shard].promote()
+
+    # -- admission (server-side concern, RemoteAPIServer parity) ---------
+
+    def register_admission(self, kind: str, fn: Callable[[Any], None]) -> None:
+        pass
+
+    def unregister_admission(self, kind: str, fn: Callable[[Any], None]) -> None:
+        pass
+
+    # -- everything whole-cluster-scoped: the meta shard's client --------
+
+    def __getattr__(self, name: str) -> Any:
+        if name in ("shard_remotes", "meta_shard"):  # pre-__init__ guard
+            raise AttributeError(name)
+        return getattr(self.shard_remotes[self.meta_shard], name)

@@ -40,6 +40,23 @@ RELIST_RESET = object()
 QUEUE_OVERFLOW = object()
 
 
+class ShardRelistReset:
+    """Shard-scoped RELIST_RESET, delivered by the sharded router's merged
+    watch queue (cluster/wire_shards.py) in place of the plain sentinel
+    when ONE shard's session relisted. The events that follow (from that
+    shard) are that shard's complete state — a mirror must drop only the
+    keys that shard owns. Dropping everything would be *correct* but would
+    turn one shard's too_old into a fleet-wide cache rebuild, defeating
+    per-shard healing. `owns(kind, namespace)` is the router's ownership
+    predicate for the originating shard."""
+
+    __slots__ = ("shard", "owns")
+
+    def __init__(self, shard: int, owns):
+        self.shard = shard
+        self.owns = owns
+
+
 class RemoteWatchQueue:
     """Fanout handle on the client's ONE shared wire watch session.
 
@@ -432,6 +449,16 @@ class CachedReadAPI:
                 # patched — rebuild lazily from authoritative lists.
                 self._mirror.clear()
                 self._primed.clear()
+                continue
+            if isinstance(ev, ShardRelistReset):
+                # One shard of a sharded router relisted: only that shard's
+                # keys are ghosts-at-risk; the other shards' sessions never
+                # dropped, so their mirror entries stay live deltas.
+                # `_primed` is untouched — the shard relist re-announces
+                # only its own objects, which upsert into existing buckets.
+                for kind, bucket in self._mirror.items():
+                    for key in [k for k in bucket if ev.owns(kind, k[0])]:
+                        bucket.pop(key, None)
                 continue
             ns = getattr(ev.obj.metadata, "namespace", "") or ""
             key = (ns, ev.obj.metadata.name)

@@ -260,6 +260,19 @@ class OperatorConfig:
     operator_shards: int = 1
     shard_takeover_grace: float = 10.0
     read_from_standby: bool = False
+    # Sharded write plane (cluster/shards.py StoreShardSet + the wire
+    # shard router):
+    #   store_shards — partition the HostStore by namespace hash (the same
+    #       crc32 % N map the ShardElector uses, so a reconcile loop talks
+    #       to exactly one write shard) into this many full stores, each
+    #       with its own journal, WAL ring, warm standby, and epoch chain.
+    #       1 (default) pins the exact single-store topology of every
+    #       release before this knob existed.
+    #   store_meta_shard — the shard index that owns cluster-scoped kinds
+    #       (Node, PriorityClass, ClusterQueue, Lease) and empty-namespace
+    #       objects; must name a valid shard (< store_shards).
+    store_shards: int = 1
+    store_meta_shard: int = 0
 
     def validate(self) -> None:
         unknown = [s for s in self.enabled_schemes if s not in ALL_SCHEMES]
@@ -338,6 +351,14 @@ class OperatorConfig:
             raise ValueError("tenancy_max_preemptions must be >= 0")
         if self.operator_shards < 1:
             raise ValueError("operator_shards must be >= 1 (1 = unsharded)")
+        if self.store_shards < 1:
+            raise ValueError("store_shards must be >= 1 (1 = unsharded)")
+        if not 0 <= self.store_meta_shard < self.store_shards:
+            # Cluster-scoped kinds must land on a real shard: an
+            # out-of-range meta-shard would route Nodes/Leases nowhere.
+            raise ValueError(
+                "store_meta_shard must be in [0, store_shards)"
+            )
         if self.shard_takeover_grace <= 0:
             # A non-positive grace is a permanently expired shard lease:
             # every replica would fight over every shard every tick —

@@ -247,6 +247,14 @@ def collect_fleet(api, now: float,
                 )
             }
 
+    # Sharded write plane: the StoreShardSet's ownership report verbatim
+    # (per-shard object counts + duplicate/misrouted evidence) — the same
+    # feed INV011 audits, so `top`, GET /fleet, and the auditor cannot
+    # disagree about which shard owns what.
+    store_shard_plane = None
+    if sources.store_shards is not None:
+        store_shard_plane = dict(sources.store_shards())
+
     # Gang-solver cycle stats (the training_solver_* counter families +
     # the solve-wall histogram), so `top` and the /fleet consumers see the
     # O(changed) plane without scraping /metrics separately.
@@ -288,6 +296,8 @@ def collect_fleet(api, now: float,
         "store": store,
         **({"replication": replication} if replication is not None else {}),
         **({"shards": shard_plane} if shard_plane is not None else {}),
+        **({"store_shards": store_shard_plane}
+           if store_shard_plane is not None else {}),
     }
 
 
@@ -533,6 +543,21 @@ def render_top(fleet: Dict[str, Any]) -> str:
             f"unowned {shards.get('unowned', 0)}  "
             f"members {len(shards.get('members') or [])}  "
             f"owned: {owner_str}"
+        )
+
+    store_shards = fleet.get("store_shards")
+    if store_shards and store_shards.get("num_shards"):
+        counts = store_shards.get("counts") or {}
+        count_str = "  ".join(
+            f"s{idx}={counts[idx]}" for idx in sorted(counts)
+        ) or "none"
+        lines.append("")
+        lines.append(
+            f"store shards: {store_shards['num_shards']} "
+            f"(meta={store_shards.get('meta_shard', 0)})  "
+            f"objects: {count_str}  "
+            f"dup {len(store_shards.get('duplicates') or [])}  "
+            f"misrouted {len(store_shards.get('misrouted') or [])}"
         )
 
     repl = fleet.get("replication")

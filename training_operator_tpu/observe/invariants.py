@@ -51,6 +51,12 @@ reference table cannot drift against scattered registrations):
                                  `shard_takeover_grace` (death-handoff
                                  machinery failed; that slice of the
                                  fleet is not being reconciled)
+  INV011 store-shard-ownership   an object readable from two WRITE shards
+                                 (both journals claim its history — a
+                                 replay would resurrect whichever copy
+                                 loses), or held by a shard the
+                                 (kind, namespace) routing map does not
+                                 assign it to (router reads miss it)
 
 Mechanics: every rule returns *candidates*; the auditor tracks first-seen
 times and reports a violation only once it has persisted past the rule's
@@ -144,6 +150,14 @@ class FleetSources:
     # this feed catches the rest, so "nothing grows without bound over a
     # simulated week" is one rule, not a scattering of ad-hoc asserts.
     accumulators: Optional[Callable[[], Dict[str, Tuple[int, int]]]] = None
+    # Sharded write plane (INV011): the StoreShardSet's ownership_report()
+    # (or the wire router's equivalent) — {"num_shards": N, "meta_shard":
+    # i, "counts": {shard: live keys}, "duplicates": [(i, j, key), ...],
+    # "misrouted": [(i, key), ...]}. A duplicate is an object readable
+    # from two shards (split-brain durability: two journals both claim its
+    # history); a misrouted key is held by a shard the (kind, namespace)
+    # map does not assign it to, so a router-side read would miss it.
+    store_shards: Optional[Callable[[], Dict[str, Any]]] = None
 
 
 class AuditContext:
@@ -582,6 +596,50 @@ register_invariant(InvariantRule(
     # dying one's shards are honestly unowned for up to takeover_grace
     # (which the unowned arm already discounts via lease arithmetic).
     _check_shard_ownership,
+))
+
+
+def _check_store_shard_ownership(ctx: AuditContext) -> List[Violation]:
+    """INV011, the sharded WRITE plane's ownership contract: no object is
+    readable from two store shards, and every shard holds only the keys
+    the (kind, namespace) routing map assigns to it. The feed is the
+    StoreShardSet's `ownership_report()` — per-shard live-key counts, the
+    exact duplicate keys (an object whose history two journals both
+    claim: a replay would resurrect whichever copy loses the race), and a
+    bounded misroute spot check (a key a router-side read would miss,
+    because it asks the shard the map points at)."""
+    src = ctx.sources.store_shards
+    if src is None:
+        return []
+    info = src()
+    if int(info.get("num_shards", 0)) <= 1:
+        return []  # unsharded plane: nothing to disagree about
+    out: List[Violation] = []
+    for i, j, key in info.get("duplicates", []) or []:
+        kind, ns, name = key
+        out.append(Violation(
+            "INV011", kind, ns, name,
+            f"object readable from store shards {i} and {j} — two "
+            f"journals claim its history (split-brain durability)",
+        ))
+    for i, key in info.get("misrouted", []) or []:
+        kind, ns, name = key
+        out.append(Violation(
+            "INV011", kind, ns, name,
+            f"object held by store shard {i} but the (kind, namespace) "
+            f"map routes it elsewhere — router reads miss it",
+        ))
+    return out
+
+
+register_invariant(InvariantRule(
+    "INV011",
+    "object readable from two store shards, or held off its mapped shard",
+    # The routing sink assigns each mutation to exactly one shard under
+    # the APIServer lock, so even a single observation is machinery
+    # failure; the transient grace only absorbs a feed sampled mid
+    # per-shard failover (store adoption swaps the shard slot atomically).
+    _check_store_shard_ownership,
 ))
 
 

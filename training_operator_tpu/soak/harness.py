@@ -58,6 +58,7 @@ from training_operator_tpu.cluster.inventory import (
     make_tpu_pool,
 )
 from training_operator_tpu.cluster.runtime import Cluster, VirtualClock
+from training_operator_tpu.cluster.shards import StoreShardSet, make_store
 from training_operator_tpu.cluster.store import HostStore
 from training_operator_tpu.config import OperatorConfig, parse_chaos_intensity
 from training_operator_tpu.controllers import OperatorManager, register_all
@@ -146,6 +147,15 @@ class SoakConfig:
     operator_replicas: int = 1
     shard_grace_seconds: float = 600.0  # fleet seconds (sim via sim())
     namespaces: int = 1
+    # Sharded write plane: partition the durable store into this many
+    # write shards (cluster/shards.py StoreShardSet), each with its own
+    # journal, WAL ring, and VirtualStandby in seq lockstep. 1 (default)
+    # keeps the single-store soak byte-identical to the pre-shard shape
+    # (the replay pin). With > 1 the host-chaos failover signal becomes a
+    # PER-SHARD failover: one shard's store is abandoned and its standby's
+    # store adopted, the other shards' journals undisturbed; INV011 audits
+    # key ownership across shards the whole week.
+    store_shards: int = 1
     # Safety rails.
     max_wall_seconds: float = 3600.0
     failovers: Optional[int] = None  # None = 1 iff chaos host tier > 0
@@ -280,7 +290,7 @@ class VirtualStandby:
                  cfg: SoakConfig):
         self.cluster = Cluster(clock)
         self.primary_store = primary_store
-        self.store = HostStore(
+        self.store = make_store(
             state_dir,
             compact_every=cfg.compact_every_records,
             compact_max_bytes=cfg.compact_max_journal_bytes,
@@ -612,17 +622,26 @@ class SoakHarness:
             out = {
                 "events": (api.event_count(), api.event_cap()),
                 "timelines": (api.timelines.count(), api.timelines.max_jobs),
-                "wal_ring": (store.wal_ring_len(), store.wal_ring),
                 "workqueue": (
                     sum(len(m.queue) for m, _ in self.live_pairs),
                     c.workqueue_bound,
                 ),
             }
+            if isinstance(store, StoreShardSet):
+                for i, s in enumerate(store.shards):
+                    out[f"wal_ring_shard{i}"] = (s.wal_ring_len(), s.wal_ring)
+            else:
+                out["wal_ring"] = (store.wal_ring_len(), store.wal_ring)
             if self.standby is not None and not self.standby.promoted:
                 out["standby_wal_ring"] = (
                     self.standby.store.wal_ring_len(),
                     self.standby.store.wal_ring,
                 )
+            for i, sb in enumerate(self.shard_standbys):
+                if not sb.promoted:
+                    out[f"standby_wal_ring_shard{i}"] = (
+                        sb.store.wal_ring_len(), sb.store.wal_ring,
+                    )
             return out
 
         def expectations() -> Dict[str, float]:
@@ -633,13 +652,22 @@ class SoakHarness:
 
         sources = FleetSources(
             journal_bytes=store.journal_bytes,
-            journal_bound=lambda: store.compact_max_bytes,
+            journal_bound=lambda: (
+                store.shards[0].compact_max_bytes
+                if isinstance(store, StoreShardSet) else store.compact_max_bytes
+            ),
             expectations=expectations,
             accumulators=accumulators,
             replication_lag=standby_lag,
             shards=(
                 (lambda: shard_feed([m for m, _ in self.live_pairs]))
                 if replicas > 1 else None
+            ),
+            # INV011: the write plane's ownership contract, audited from
+            # the routing sink's own bookkeeping all week.
+            store_shards=(
+                store.ownership_report
+                if isinstance(store, StoreShardSet) else None
             ),
         )
         auditor = InvariantAuditor(
@@ -663,8 +691,9 @@ class SoakHarness:
     def _build_primary(self) -> None:
         c = self.cfg
         cluster = Cluster(self.clock)
-        store = HostStore(
+        store = make_store(
             f"{self.state_dir}/primary",
+            num_shards=c.store_shards,
             compact_every=c.compact_every_records,
             compact_max_bytes=c.compact_max_journal_bytes,
             wal_ring=c.replication_wal_ring,
@@ -676,14 +705,32 @@ class SoakHarness:
             c.tpu_slices, slice_topology=c.slice_topology))
         cluster.add_nodes(make_cpu_pool(
             c.cpu_nodes, cpu_per_node=c.cpu_per_node))
-        # Warm standby tails from seq 0 — nodes included.
-        self.standby = VirtualStandby(
-            self.clock, store, f"{self.state_dir}/standby", c)
+        # Warm standby(s) tail from seq 0 — nodes included. Sharded plane:
+        # one VirtualStandby per write shard, each tailing ITS shard's WAL
+        # ring in seq lockstep (a vanilla PR 9 pair, instantiated N times);
+        # the whole-store standby exists only in the single-store shape.
+        self._shard_failovers = 0
+        self.shard_failover_reports: List[Dict[str, Any]] = []
+        if c.store_shards > 1:
+            self.standby = None
+            self.shard_standbys = [
+                VirtualStandby(
+                    self.clock, store.shards[i],
+                    f"{self.state_dir}/standby-shard-{i}", c)
+                for i in range(c.store_shards)
+            ]
+        else:
+            self.standby = VirtualStandby(
+                self.clock, store, f"{self.state_dir}/standby", c)
+            self.shard_standbys = []
         self.cluster = cluster
         self.store = store
         (self.facade, self.pairs, self.auditor,
          self.collector) = self._build_stack(
-            cluster, store, standby_lag=self.standby.lag)
+            cluster, store, standby_lag=(
+                self.standby.lag if self.standby is not None
+                else self._shard_standby_lag
+            ))
         for obj in wl.tenancy_objects(c.team_quota_chips, c.prod_quota_chips):
             cluster.api.create(obj)
         self.orch.attach(cluster, cluster.kubelet,
@@ -918,6 +965,81 @@ class SoakHarness:
         }
         self.phase = "soak"
 
+    def _shard_standby_lag(self) -> Dict[str, Any]:
+        """INV008 feed for the sharded plane: the WORST shard's lag (one
+        cold shard standby is exactly as dangerous as a cold whole-store
+        standby — failover from it loses that shard's tail)."""
+        lags = [sb.lag() for sb in self.shard_standbys if not sb.promoted]
+        if not lags:
+            return {"role": "primary", "records": 0, "seconds": 0.0,
+                    "connected": True, "applied": 0}
+        worst = max(lags, key=lambda d: d["records"])
+        return {
+            "role": "standby",
+            "records": worst["records"],
+            "seconds": worst["seconds"],
+            "connected": True,
+            "applied": sum(d["applied"] for d in lags),
+        }
+
+    def _do_shard_failover(self) -> None:
+        """The host-chaos tier, per-shard: SIGKILL ONE write shard's store
+        (journal fd abandoned), drain its standby to the reachable WAL
+        tail, verify seq-lockstep parity over exactly that shard's keys,
+        and adopt the standby's store into the shard slot. The live
+        APIServer and the other shards' journals never notice — that
+        independence is the point of the sharded plane, and INV011 keeps
+        auditing ownership across the swap."""
+        c = self.cfg
+        store: StoreShardSet = self.store
+        # Deterministic victim rotation, starting on a NON-meta shard so
+        # the drill proves a data shard's death leaves cluster-scoped
+        # kinds (meta shard) untouched.
+        order = [i for i in range(store.num_shards) if i != store.meta_shard]
+        order.append(store.meta_shard)
+        # A shard whose standby already promoted has no warm follower left
+        # to adopt — the drill would compare against a stale WAL tail.
+        order = [i for i in order if not self.shard_standbys[i].promoted]
+        if not order:
+            log.warning("soak: every shard standby already promoted; "
+                        "skipping extra shard-failover drill")
+            return
+        k = order[self._shard_failovers % len(order)]
+        self._shard_failovers += 1
+        sb = self.shard_standbys[k]
+        t_kill = self.clock.now()
+        self.phase = "shard-failover"
+        pre = {
+            key: rv for key, rv in self._state_digest(self.cluster.api).items()
+            if store.shard_index(key[0], key[1]) == k
+        }
+        store.abandon_shard(k)
+        sb.pump()
+        post = self._state_digest(sb.cluster.api)
+        if pre != post:
+            raise SoakError(
+                f"shard {k} replication parity broken at failover: "
+                f"{len(set(pre) - set(post))} objects missing, "
+                f"{len(set(post) - set(pre))} unexpected"
+            )
+        # Adopt: the standby's store (journal already durable with the
+        # identical record history) becomes the shard's write target for
+        # the routing sink; the standby stops pumping (promoted).
+        sb.promoted = True
+        store.replace_shard(k, sb.store)
+        self.shard_failover_reports.append({
+            "shard": k,
+            "t_kill_fleet_s": round(c.fleet(t_kill), 1),
+            "wal_records_replicated": sb.applied,
+            "objects_at_failover": len(pre),
+            "replication_parity": True,
+            "other_shards_undisturbed": all(
+                not store.shards[i].degraded
+                for i in range(store.num_shards) if i != k
+            ),
+        })
+        self.phase = "soak"
+
     # -- main loop -------------------------------------------------------
 
     def run(self) -> Dict[str, Any]:
@@ -966,11 +1088,17 @@ class SoakHarness:
                 if sig.startswith("replica_kill:"):
                     self._kill_replica(sig.split(":", 1)[1])
             if "failover" in signals:
-                self._do_failover()
+                if self.shard_standbys:
+                    self._do_shard_failover()
+                else:
+                    self._do_failover()
             version_before = self.cluster.api.version()
             self.cluster.step()
             if self.standby is not None and not self.standby.promoted:
                 self.standby.pump()
+            for sb in self.shard_standbys:
+                if not sb.promoted:
+                    sb.pump()
             transitions = self.tracker.drain(now=self.clock.now())
             self._close_disruptions(transitions)
             now = self.clock.now()
@@ -1139,10 +1267,27 @@ class SoakHarness:
                 "fail_fast": True,
             },
             "growth": growth,
-            "replication": {
-                "records_applied": self.standby.applied,
-                "final_lag_records": self.standby.lag_records,
-            },
+            "replication": (
+                {
+                    "records_applied": self.standby.applied,
+                    "final_lag_records": self.standby.lag_records,
+                }
+                if self.standby is not None else
+                # Sharded plane: one lockstep standby per write shard.
+                {
+                    "records_applied": sum(
+                        sb.applied for sb in self.shard_standbys),
+                    "final_lag_records": max(
+                        (sb.lag_records for sb in self.shard_standbys
+                         if not sb.promoted), default=0),
+                }
+            ),
+            **({"store_shards": {
+                "num_shards": c.store_shards,
+                "meta_shard": self.store.meta_shard,
+                "failovers": list(self.shard_failover_reports),
+                "ownership": self.store.ownership_report(),
+            }} if isinstance(self.store, StoreShardSet) else {}),
             **({"shards": {
                 "replicas": c.operator_replicas,
                 "survivors": len(self.live_pairs),

@@ -522,6 +522,19 @@ wire_failovers = registry.counter(
     "client address rotations (transport failure or NotLeader on the active "
     "control-plane address)", (),
 )
+# Sharded write plane (cluster/shards.py StoreShardSet + the wire shard
+# router): per-shard write routing and per-shard failover counts. The label
+# is the shard index as a string ("0".."N-1").
+store_shard_writes = registry.counter(
+    "training_store_shard_writes_total",
+    "journal mutations routed to each write shard by the (kind, namespace) "
+    "shard map", ("shard",),
+)
+store_shard_failovers = registry.counter(
+    "training_store_shard_failovers_total",
+    "per-shard store failovers (one shard's primary store abandoned and its "
+    "warm standby adopted, the other shards undisturbed)", ("shard",),
+)
 # Torn-tail recovery (HostStore._replay_file): a crash mid-append leaves a
 # truncated final journal record; replay stops at the last whole record and
 # the tail is physically truncated on the next append. Nonzero here is
