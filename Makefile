@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast test-wire test-chaos test-fleet test-tenancy test-failover test-shards test-store-shards soak-smoke lint lockcheck-report bench bench-quick bench-solver bench-wire bench-wire-v2 bench-wire-resume bench-observe bench-audit bench-lockcheck bench-node-chaos bench-tenancy bench-failover bench-shards bench-store-shards bench-wire-driver bench-soak dryrun operator-demo ha-demo native clean
+.PHONY: test test-fast test-wire test-chaos test-fleet test-tenancy test-failover test-shards test-store-shards test-slo soak-smoke lint lockcheck-report bench bench-quick bench-solver bench-wire bench-wire-v2 bench-wire-resume bench-observe bench-audit bench-lockcheck bench-node-chaos bench-tenancy bench-failover bench-shards bench-store-shards bench-slo bench-wire-driver bench-soak dryrun operator-demo ha-demo native clean
 
 test:            ## full suite (no hardware needed; ~10 min)
 	$(PY) -m pytest tests/ -q
@@ -53,6 +53,15 @@ test-store-shards:  ## sharded write-plane lane (routing, INV011, shard router)
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_store_shards.py \
 	  tests/test_config_knobs.py -q
 
+# SLO engine lane (deterministic, part of the default test flow —
+# tests/test_slo.py is collected by `test`/`test-fast`): sliding-window
+# histograms, SLOPolicy admission, multi-window burn-rate evaluation +
+# once-per-incident events, per-job latency attribution (`explain`), the
+# owning-shard routing of timeline/explain reads, and the merged
+# chrome-trace export.
+test-slo:        ## SLO engine lane (burn rate, attribution, sharded explain)
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_slo.py -q
+
 # The soak smoke tier: a compressed hour of fleet life with ALL FIVE chaos
 # tiers live at once + one host failover, under the fail-fast INV001-INV011
 # auditor, plus the single-seed replay pin and the bounded-growth/INV009
@@ -61,7 +70,7 @@ test-store-shards:  ## sharded write-plane lane (routing, INV011, shard router)
 soak-smoke:      ## compressed-hour five-tier soak smoke (~90s, `not slow`)
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_soak.py -q -m "not slow"
 
-lint:            ## project code lint: AST discipline rules (CL001-CL012) + ruff (if present)
+lint:            ## project code lint: AST discipline rules (CL001-CL013) + ruff (if present)
 	$(PY) -m training_operator_tpu.analysis.codelint training_operator_tpu
 	$(PY) -m training_operator_tpu.analysis.lockcheck training_operator_tpu
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -175,6 +184,12 @@ bench-shards:    ## operator scale-out block -> BENCH_SELF_SHARDS artifact
 # jobs/minute per shard count; single-core caveat recorded in the artifact.
 bench-store-shards:  ## write-shard scaling block -> BENCH_SELF_STORE_SHARDS_r17.json
 	JAX_PLATFORMS=cpu $(PY) bench.py --store-shards-only
+
+# SLO evaluator + attribution on vs off over the same 120-job gang burst
+# (the bench-audit method): direct self-timed evaluate+explain share decides
+# the <2% budget recorded in the BENCH_SELF_SLO artifact.
+bench-slo:       ## SLO-engine overhead block (one JSON line + BENCH_SELF_SLO artifact)
+	JAX_PLATFORMS=cpu $(PY) bench.py --slo-only
 
 # External-baseline driver stub: emits the self-measured sharded-write proxy
 # with external_baseline_unmeasured=true (no upstream kube-apiserver in this

@@ -393,7 +393,7 @@ AUDIT_INTERVAL_S = 15.0
 
 def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nodes=CPU_NODES,
               return_latencies=False, chrome_trace=None, audit=None,
-              incremental=True):
+              incremental=True, extra_setup=None):
     cluster = Cluster(VirtualClock())
     cluster.add_nodes(make_tpu_pool(tpu_slices, slice_topology=SLICE_TOPOLOGY))
     cluster.add_nodes(make_gpu_pool(gpu_nodes, gpus_per_node=GPUS_PER_NODE, nodes_per_nvlink_domain=4))
@@ -429,6 +429,12 @@ def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nod
             interval=AUDIT_INTERVAL_S,
             fail_fast=True,
         ).attach(cluster)
+
+    # Optional burst-resident instrumentation (the SLO-overhead block rides
+    # this): called with the live cluster before submission; may register
+    # tickers and may return a finalizer to run at quiescence, all inside
+    # the measured wall.
+    finalize = extra_setup(cluster) if extra_setup is not None else None
 
     jobs = [make_job(s) for s in specs]
     t_wall = time.perf_counter()
@@ -508,6 +514,8 @@ def run_burst(specs, placer, tpu_slices=TPU_SLICES, gpu_nodes=GPU_NODES, cpu_nod
         # Closing audit at quiescence: the converged fleet must be clean
         # too (orphans/wedged expectations would survive the burst).
         auditor.audit()
+    if callable(finalize):
+        finalize()
 
     latencies = []
     by_name = {} if return_latencies else None
@@ -1258,6 +1266,133 @@ def run_audit_overhead(n_jobs: int = 120, pairs: int = 5, seed: int = 11):
         },
         "burst_audit": audited.get("audit"),
         "violations": (audited.get("audit") or {}).get("violations", 0),
+        "overhead_pct": round(100 * direct_share, 3),
+        "under_2pct": direct_share < 0.02,
+    }
+
+
+SLO_EVAL_INTERVAL_S = 15.0
+
+
+def run_slo_overhead(n_jobs: int = 120, pairs: int = 5, seed: int = 11):
+    """The `slo` bench block (the run_audit_overhead method, applied to the
+    SLO engine): the SAME 120-job gang burst with the engine off vs on,
+    overhead reported two ways —
+
+    - direct: every `SLOEvaluator.evaluate` call (burn-rate pass every
+      SLO_EVAL_INTERVAL_S of virtual time against a live SLOPolicy) and
+      every `explain` call (per-job latency attribution for the full burst
+      at quiescence) self-timed during one instrumented burst;
+      `overhead_pct` is their summed time as a share of the burst wall.
+      Deterministic and conservative (probe cost charged to the engine).
+      This is the number the <2% acceptance budget reads.
+    - wall pairs: alternating off/on pairs, median per-pair ratio with
+      spread, as end-to-end corroboration."""
+    from training_operator_tpu.api.jobs import ObjectMeta
+    from training_operator_tpu.observe import attribution as _attr
+    from training_operator_tpu.observe import slo as _slo
+
+    specs = build_workload(n_jobs, seed)
+
+    def slo_setup(cluster):
+        _slo.register_slo_admission(cluster.api)
+        cluster.api.create(_slo.SLOPolicy(
+            metadata=ObjectMeta(name="bench-slo"),
+            objectives=[
+                _slo.SLOObjective(name="ttr-p99", metric="time_to_running",
+                                  threshold_seconds=600.0, target=0.99),
+                _slo.SLOObjective(name="queue-p95", metric="queue_wait",
+                                  threshold_seconds=300.0, target=0.95),
+            ],
+        ))
+        ev = _slo.SLOEvaluator(cluster.api, cluster.clock.now)
+        state = {"next": 0.0}
+
+        def tick():
+            now = cluster.clock.now()
+            if now >= state["next"]:
+                state["next"] = now + SLO_EVAL_INTERVAL_S
+                ev.evaluate(now)
+
+        cluster.add_ticker(tick)
+
+        def finalize():
+            ev.evaluate(cluster.clock.now())
+            for tl in cluster.api.timelines.timelines():
+                _attr.explain(cluster.api, tl.namespace, tl.name,
+                              now=cluster.clock.now())
+
+        return finalize
+
+    def leg(slo_on):
+        t0 = time.perf_counter()
+        out = run_burst(specs, TPUPacker(), audit=False,
+                        extra_setup=slo_setup if slo_on else None)
+        return time.perf_counter() - t0, out
+
+    leg(True)  # warmup: codec + placer compiles land outside the measurement
+
+    counters = {"evaluate_calls": 0, "evaluate_time": 0.0,
+                "explain_calls": 0, "explain_time": 0.0}
+    orig_evaluate = _slo.SLOEvaluator.evaluate
+    orig_explain = _attr.explain
+
+    def evaluate_probe(self, now=None):
+        t0 = time.perf_counter()
+        try:
+            return orig_evaluate(self, now)
+        finally:
+            counters["evaluate_calls"] += 1
+            counters["evaluate_time"] += time.perf_counter() - t0
+
+    def explain_probe(api, namespace, name, now=None):
+        t0 = time.perf_counter()
+        try:
+            return orig_explain(api, namespace, name, now=now)
+        finally:
+            counters["explain_calls"] += 1
+            counters["explain_time"] += time.perf_counter() - t0
+
+    _slo.SLOEvaluator.evaluate = evaluate_probe
+    _attr.explain = explain_probe
+    try:
+        direct_wall, _ = leg(True)
+    finally:
+        _slo.SLOEvaluator.evaluate = orig_evaluate
+        _attr.explain = orig_explain
+    engine_time = counters["evaluate_time"] + counters["explain_time"]
+    direct_share = engine_time / direct_wall if direct_wall > 0 else 0.0
+
+    off, on, ratios = [], [], []
+    for i in range(max(1, pairs)):
+        if i % 2 == 0:
+            d, _ = leg(False)
+            e, _ = leg(True)
+        else:
+            e, _ = leg(True)
+            d, _ = leg(False)
+        off.append(d)
+        on.append(e)
+        ratios.append(e / d if d > 0 else 1.0)
+    ratios.sort()
+    return {
+        "jobs": n_jobs,
+        "pairs": pairs,
+        "eval_interval_s": SLO_EVAL_INTERVAL_S,
+        "direct": {
+            "evaluate_calls": counters["evaluate_calls"],
+            "evaluate_time_s": round(counters["evaluate_time"], 4),
+            "explain_calls": counters["explain_calls"],
+            "explain_time_s": round(counters["explain_time"], 4),
+            "burst_wall_s": round(direct_wall, 3),
+            "share_pct": round(100 * direct_share, 3),
+        },
+        "wall_pairs": {
+            "disabled_wall_s": [round(v, 3) for v in off],
+            "enabled_wall_s": [round(v, 3) for v in on],
+            "pair_ratios": [round(r, 4) for r in ratios],  # sorted above
+            "median_pair_ratio": round(ratios[len(ratios) // 2], 4),
+        },
         "overhead_pct": round(100 * direct_share, 3),
         "under_2pct": direct_share < 0.02,
     }
@@ -3278,6 +3413,14 @@ def main():
                     help="burst size for the audit-overhead block")
     ap.add_argument("--audit-out", default="BENCH_SELF_AUDIT_r10.json",
                     help="artifact path for --audit-only")
+    ap.add_argument("--slo-only", action="store_true",
+                    help="run only the SLO-engine-overhead block (evaluator "
+                         "+ attribution on/off over the same 120-job burst, "
+                         "run_audit_overhead method) and write --slo-out")
+    ap.add_argument("--slo-jobs", type=int, default=120,
+                    help="burst size for the SLO-overhead block")
+    ap.add_argument("--slo-out", default="BENCH_SELF_SLO_r19.json",
+                    help="artifact path for --slo-only")
     ap.add_argument("--lockcheck", action="store_true",
                     help="run the whole bench under the runtime lock-order "
                          "witness (TRAINING_LOCKCHECK=1; off by default in "
@@ -3378,6 +3521,22 @@ def main():
         }
         print(json.dumps(doc))
         with open(args.audit_out, "w") as f:
+            json.dump(doc, f, indent=1)
+        return
+
+    if args.slo_only:
+        block = run_slo_overhead(args.slo_jobs)
+        doc = {
+            "metric": "slo_overhead_pct",
+            "value": block["overhead_pct"],
+            "unit": "% of burst wall spent in SLOEvaluator.evaluate + "
+                    "explain (direct self-timed share; wall_pairs = on/off "
+                    "corroboration with spread)",
+            "vs_baseline": None,
+            "slo": block,
+        }
+        print(json.dumps(doc))
+        with open(args.slo_out, "w") as f:
             json.dump(doc, f, indent=1)
         return
 

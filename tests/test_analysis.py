@@ -688,6 +688,49 @@ class TestCodelint:
         found = check_source("x.py", src, package_rel="engine/x.py")
         assert len(found) == 1 and "make_store" in found[0].message
 
+    # CL013 — attribution causes must come from the registered taxonomy
+    # in observe/attribution.py: no register_cause() calls elsewhere, no
+    # free-text {"cause": "..."} strings outside the registered ids.
+    CL013_TABLE = [
+        ("register-outside",
+         "from training_operator_tpu.observe.attribution import register_cause\n"
+         "register_cause('my_cause', 'desc')\n",
+         "controllers/x.py", ["CL013"]),
+        ("attribute-register-outside",
+         "from training_operator_tpu.observe import attribution\n"
+         "attribution.register_cause('my_cause', 'desc')\n",
+         "engine/x.py", ["CL013"]),
+        ("register-in-attribution-module",
+         "CAUSES = {}\n"
+         "def register_cause(c, d):\n    CAUSES[c] = d\n"
+         "register_cause('quota_wait', 'waiting on quota')\n",
+         "observe/attribution.py", []),
+        ("free-text-cause",
+         "row = {'cause': 'vibes', 'seconds': 1.0}\n",
+         "observe/fleet.py", ["CL013"]),
+        ("registered-cause-literal-ok",
+         "row = {'cause': 'preemption_displacement', 'seconds': 1.0}\n",
+         "observe/fleet.py", []),
+        ("dynamic-cause-value-ok",
+         "def f(c):\n    return {'cause': c, 'seconds': 0.0}\n",
+         "sdk/client.py", []),
+    ]
+
+    @pytest.mark.parametrize(
+        "case,src,rel,want", CL013_TABLE, ids=[c[0] for c in CL013_TABLE]
+    )
+    def test_cl013_table(self, case, src, rel, want):
+        found = check_source(rel.split("/")[-1], src, package_rel=rel)
+        assert [f.rule_id for f in found] == want, (case, found)
+
+    def test_cl013_taxonomy_matches_attribution_registry(self):
+        # The lint table is a hardcoded copy; this pins it to the live
+        # registry so adding a cause without updating CL013 fails loudly.
+        from training_operator_tpu.analysis import codelint
+        from training_operator_tpu.observe import attribution
+
+        assert codelint.CAUSE_TAXONOMY == tuple(attribution.CAUSES)
+
 
 class TestCLI:
     def test_all_presets_exit_zero(self, capsys):

@@ -1,0 +1,598 @@
+"""SLO engine (PR 19 tentpole): sliding-window histograms, SLOPolicy
+admission, multi-window burn-rate evaluation with once-per-incident
+events, per-job latency attribution (`explain`), the owning-shard routing
+of the timeline/explain read plane, and the merged chrome-trace export.
+
+The two acceptance properties this file pins:
+
+- attribution rows sum EXACTLY to the job's measured time-to-running (the
+  deterministic preempted + node-loss scenario in TestAttribution), and
+- a breach that persists across evaluations is ONE SLOBurnRate incident
+  event, not one event per pass.
+"""
+
+import json
+
+import pytest
+
+from training_operator_tpu.api.jobs import JAXJob, ObjectMeta
+from training_operator_tpu.api.validation import ValidationError
+from training_operator_tpu.cluster import wire
+from training_operator_tpu.cluster.httpapi import (
+    ApiHTTPServer,
+    RemoteAPIServer,
+    ShardedRemoteAPIServer,
+)
+from training_operator_tpu.cluster.objects import Event
+from training_operator_tpu.cluster.runtime import Cluster, VirtualClock
+from training_operator_tpu.cluster.shards import CLUSTER_SCOPED_KINDS, shard_for
+from training_operator_tpu.observe import (
+    SLOEvaluator,
+    SLOObjective,
+    SLOPolicy,
+    attribute,
+    explain,
+    export_chrome_trace_merged,
+    register_slo_admission,
+    render_explain,
+    render_slo,
+    validate_slo_policy,
+)
+from training_operator_tpu.observe.attribution import (
+    CAUSE_CONTROL_PLANE,
+    CAUSE_NODE_LOSS_RECOVERY,
+    CAUSE_PREEMPTION_DISPLACEMENT,
+    CAUSE_PRIORITY_WAIT,
+    CAUSE_STARTUP,
+    CAUSES,
+    aggregate_queue_shares,
+)
+from training_operator_tpu.observe.slo import _good_count
+from training_operator_tpu.observe.timeline import TimelineStore
+from training_operator_tpu.sdk import TrainingClient
+from training_operator_tpu.utils import metrics
+from training_operator_tpu.utils.metrics import (
+    LabeledSlidingWindowHistogram,
+    MetricsRegistry,
+    SlidingWindowHistogram,
+)
+
+# crc32 pins for num_shards=2 (test_store_shards.py uses the same pair).
+NS_S0 = "alpha"   # -> shard 0
+NS_S1 = "beta"    # -> shard 1
+
+
+def _policy(name="slo-ttr", **obj_kw):
+    kw = dict(name="ttr", metric="time_to_running",
+              threshold_seconds=60.0, target=0.9)
+    kw.update(obj_kw)
+    return SLOPolicy(metadata=ObjectMeta(name=name),
+                     objectives=[SLOObjective(**kw)])
+
+
+def _parse_render(lines):
+    """'name{labels} value' sample lines -> dict, skipping # HELP/# TYPE."""
+    out = {}
+    for line in lines:
+        if line.startswith("#"):
+            continue
+        key, _, value = line.rpartition(" ")
+        out[key] = float(value)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Sliding-window histograms (the metrics substrate)
+# ---------------------------------------------------------------------------
+
+
+class TestSlidingWindowHistogram:
+    def test_windowed_view_vs_full_retention(self):
+        h = SlidingWindowHistogram("t_sw", "", buckets=(1.0, 10.0),
+                                   window_seconds=60.0, num_windows=10)
+        h.observe(0.5, now=0.0)      # window 0
+        h.observe(5.0, now=130.0)    # window 2
+        full = h.cumulative_buckets()
+        assert full[-1] == (float("inf"), 2)
+        recent = h.cumulative_buckets(window_seconds=60.0, now=130.0)
+        assert recent[-1] == (float("inf"), 1), "trailing window only"
+        assert recent[0] == (1.0, 0), "the old <=1.0 obs is outside it"
+
+    def test_retention_expiry_via_advance(self):
+        h = SlidingWindowHistogram("t_exp", "", buckets=(1.0,),
+                                   window_seconds=60.0, num_windows=3)
+        h.observe(0.5, now=0.0)
+        assert h.cumulative_buckets()[-1][1] == 1
+        h.advance(1000.0)  # > 3 windows later: retention dropped it
+        assert h.cumulative_buckets()[-1][1] == 0
+
+    def test_stale_observation_folds_into_newest_window(self):
+        h = SlidingWindowHistogram("t_st", "", buckets=(1.0,),
+                                   window_seconds=60.0, num_windows=3)
+        h.observe(0.5, now=600.0)
+        h.observe(0.6, now=0.0)  # older than retention: folds, not lost
+        assert h.cumulative_buckets()[-1][1] == 2
+
+    def test_render_and_snapshot_expose_the_same_view(self):
+        """The one-view rule: text and JSON exposition derive from the same
+        cumulative_buckets() output — identical keys, identical values."""
+        h = SlidingWindowHistogram("t_agree", "help", buckets=(1.0, 5.0))
+        for v, t in ((0.5, 0.0), (3.0, 10.0), (99.0, 20.0)):
+            h.observe(v, now=t)
+        rendered = _parse_render(h.render())
+        snap = h.snapshot_items()
+        assert rendered == snap
+        assert snap['t_agree_bucket{le="1.0"}'] == 1.0
+        assert snap['t_agree_bucket{le="+Inf"}'] == 3.0
+        assert snap["t_agree_count"] == 3.0
+        assert snap["t_agree_sum"] == pytest.approx(102.5)
+
+    def test_labeled_family_splices_and_agrees(self):
+        fam = LabeledSlidingWindowHistogram(
+            "t_fam", "", ("queue", "kind"), buckets=(1.0,))
+        fam.observe(0.5, "q0", "JAXJob", now=0.0)
+        fam.observe(2.0, "q1", "JAXJob", now=0.0)
+        assert [lbls for lbls, _ in fam.children()] == [
+            ("q0", "JAXJob"), ("q1", "JAXJob")]
+        rendered = _parse_render(fam.render())
+        assert rendered == fam.snapshot_items()
+        assert rendered[
+            't_fam_bucket{queue="q0",kind="JAXJob",le="1.0"}'] == 1.0
+
+    def test_registry_duplicate_guard(self):
+        reg = MetricsRegistry()
+        a = reg.sliding_histogram("dup_sw", "", buckets=(1.0,),
+                                  window_seconds=30.0)
+        assert reg.sliding_histogram("dup_sw", "", buckets=(1.0,),
+                                     window_seconds=30.0) is a
+        with pytest.raises(ValueError):
+            reg.sliding_histogram("dup_sw", "", buckets=(1.0,),
+                                  window_seconds=60.0)
+
+
+# ---------------------------------------------------------------------------
+# SLOPolicy: kind registration, admission, codec
+# ---------------------------------------------------------------------------
+
+
+class TestSLOPolicy:
+    def test_valid_policy_passes(self):
+        validate_slo_policy(_policy())
+
+    @pytest.mark.parametrize("bad", [
+        dict(name=""),
+        dict(metric="made_up"),
+        dict(threshold_seconds=0.0),
+        dict(target=1.0),
+        dict(target=0.0),
+        dict(fast_window_seconds=0.0),
+        dict(fast_window_seconds=600.0, slow_window_seconds=600.0),
+        dict(burn_threshold=0.0),
+    ])
+    def test_bad_objective_rejected(self, bad):
+        with pytest.raises(ValidationError):
+            validate_slo_policy(_policy(**bad))
+
+    def test_no_objectives_rejected(self):
+        with pytest.raises(ValidationError):
+            validate_slo_policy(
+                SLOPolicy(metadata=ObjectMeta(name="empty")))
+
+    def test_admission_forces_cluster_scope(self):
+        cluster = Cluster(VirtualClock())
+        register_slo_admission(cluster.api)
+        p = _policy()
+        p.metadata.namespace = "some-team"
+        cluster.api.create(p)
+        assert cluster.api.get("SLOPolicy", "", "slo-ttr") is not None
+
+    def test_admission_rejects_malformed(self):
+        cluster = Cluster(VirtualClock())
+        register_slo_admission(cluster.api)
+        with pytest.raises(ValidationError):
+            cluster.api.create(_policy(threshold_seconds=-1.0))
+
+    def test_codec_round_trip_preserves_objectives(self):
+        p = _policy(queue="prod", kind="JAXJob", burn_threshold=2.0)
+        back = wire.decode(wire.encode(p))
+        assert isinstance(back, SLOPolicy)
+        assert len(back.objectives) == 1
+        obj = back.objectives[0]
+        assert isinstance(obj, SLOObjective)
+        assert (obj.queue, obj.kind, obj.burn_threshold) == (
+            "prod", "JAXJob", 2.0)
+
+    def test_pinned_to_the_meta_shard(self):
+        assert "SLOPolicy" in CLUSTER_SCOPED_KINDS
+        for meta in (0, 1, 2):
+            assert shard_for("SLOPolicy", "anything", 3, meta) == meta
+
+
+# ---------------------------------------------------------------------------
+# Burn-rate evaluation
+# ---------------------------------------------------------------------------
+
+
+class TestBurnRate:
+    def test_good_count_interpolates_inside_the_straddling_bucket(self):
+        view = [(1.0, 5), (2.0, 10), (float("inf"), 12)]
+        assert _good_count(view, 1.5) == pytest.approx(7.5)
+        assert _good_count(view, 2.0) == 10.0
+
+    def test_inf_residue_is_conservatively_bad(self):
+        view = [(1.0, 5), (2.0, 10), (float("inf"), 12)]
+        assert _good_count(view, 100.0) == 10.0
+
+    # Each test pins its objective to a unique queue selector: the metric
+    # families are process-global, and suite neighbours observe into them
+    # (some at wall-clock scale, which folds later virtual-clock samples
+    # into THEIR newest window) — a per-test child is the isolation seam.
+    def _seed(self, epoch, good, bad, queue, threshold=60.0):
+        for i in range(good):
+            metrics.slo_time_to_running_window.observe(
+                threshold / 2.0, queue, "JAXJob", now=epoch + i)
+        for i in range(bad):
+            metrics.slo_time_to_running_window.observe(
+                threshold * 10, queue, "JAXJob", now=epoch + i)
+
+    def test_attainment_burn_and_budget(self):
+        epoch = 10_000_000.0
+        cluster = Cluster(VirtualClock())
+        register_slo_admission(cluster.api)
+        cluster.api.create(_policy(target=0.9, queue="brq-att"))
+        self._seed(epoch, good=8, bad=2, queue="brq-att")
+        ev = SLOEvaluator(cluster.api, cluster.clock.now)
+        section = ev.evaluate(epoch + 10)
+        [row] = section["objectives"]
+        assert row["attainment"] == pytest.approx(0.8)
+        # bad_fraction 0.2 over a 0.1 budget: 2x in both windows.
+        assert row["burn_fast"] == pytest.approx(2.0)
+        assert row["burn_slow"] == pytest.approx(2.0)
+        assert row["budget_remaining"] == 0.0
+        assert row["burning"] is True
+        assert section["incidents"] == 1
+
+    def test_incident_event_fires_once_per_incident(self):
+        epoch = 20_000_000.0
+        cluster = Cluster(VirtualClock())
+        register_slo_admission(cluster.api)
+        cluster.api.create(_policy(target=0.9, queue="brq-inc"))
+        self._seed(epoch, good=0, bad=5, queue="brq-inc")
+        ev = SLOEvaluator(cluster.api, cluster.clock.now)
+        for dt in (10, 20, 30):  # persisting breach: one incident
+            ev.evaluate(epoch + dt)
+        [burn] = cluster.api.events(reason="SLOBurnRate")
+        assert burn.count == 1, "three burning passes, ONE incident event"
+        assert burn.event_type == "Warning"
+        assert burn.object_kind == "SLOPolicy"
+        # Recovery (windows age out), then a NEW breach: a second incident.
+        # The server aggregates same-key events, so it shows as count=2.
+        recovered = ev.evaluate(epoch + 40_000)
+        assert recovered["incidents"] == 0
+        self._seed(epoch + 50_000, good=0, bad=5, queue="brq-inc")
+        ev.evaluate(epoch + 50_010)
+        [burn] = cluster.api.events(reason="SLOBurnRate")
+        assert burn.count == 2
+
+    def test_no_data_means_attained_not_burning(self):
+        epoch = 30_000_000.0
+        cluster = Cluster(VirtualClock())
+        register_slo_admission(cluster.api)
+        cluster.api.create(_policy(queue="no-such-queue"))
+        ev = SLOEvaluator(cluster.api, cluster.clock.now, enable_events=False)
+        [row] = ev.evaluate(epoch)["objectives"]
+        assert row["attainment"] == 1.0
+        assert row["burning"] is False
+        assert row["samples_slow"] == 0
+
+    def test_gauges_published_and_zeroed_when_policy_removed(self):
+        epoch = 40_000_000.0
+        cluster = Cluster(VirtualClock())
+        register_slo_admission(cluster.api)
+        cluster.api.create(_policy(name="gauged", target=0.9,
+                                   queue="brq-gau"))
+        self._seed(epoch, good=4, bad=0, queue="brq-gau")
+        ev = SLOEvaluator(cluster.api, cluster.clock.now, enable_events=False)
+        ev.evaluate(epoch + 5)
+        snap = metrics.registry.snapshot()
+        key = ('training_slo_attainment_ratio'
+               '{policy="gauged",objective="ttr",queue="brq-gau"}')
+        assert snap[key] == 1.0
+        cluster.api.delete("SLOPolicy", "", "gauged")
+        ev.evaluate(epoch + 10)
+        assert metrics.registry.snapshot()[key] == 0.0
+
+    def test_render_slo_names_burning_objectives(self):
+        epoch = 50_000_000.0
+        cluster = Cluster(VirtualClock())
+        register_slo_admission(cluster.api)
+        cluster.api.create(_policy(target=0.9, queue="brq-ren"))
+        self._seed(epoch, good=0, bad=4, queue="brq-ren")
+        ev = SLOEvaluator(cluster.api, cluster.clock.now, enable_events=False)
+        text = render_slo(ev.evaluate(epoch + 5))
+        assert "ttr" in text and "BURNING" in text
+
+
+# ---------------------------------------------------------------------------
+# Attribution: the deterministic decomposition
+# ---------------------------------------------------------------------------
+
+
+def _span(name, start, end, wall=0.0):
+    return {"name": name, "start": start, "end": end, "wall": wall,
+            "attrs": {}}
+
+
+def _event(reason, t, name="job-a", ns="default", etype="Warning"):
+    return Event(object_kind="PodGroup", object_name=name, namespace=ns,
+                 event_type=etype, reason=reason, message=reason,
+                 timestamp=t, first_timestamp=t)
+
+
+class _FakePodGroup:
+    queue = "prod"
+
+
+class TestAttribution:
+    def test_preempted_plus_node_loss_sums_exactly_to_ttr(self):
+        """THE acceptance property: a job that was preempted AND displaced
+        by node loss itemizes causes that sum exactly to its measured
+        time-to-running."""
+        timeline = {
+            "namespace": "default", "name": "job-a",
+            "spans": [
+                _span("time_to_running", 0.0, 100.0),
+                _span("admission", 0.0, 0.0, wall=2.0),
+                _span("gang_solve", 4.0, 5.0, wall=1.0),
+                _span("node_evict", 50.0, 50.5),
+            ],
+            "marks": {},
+        }
+        events = [
+            _event("Preempted", 10.0),
+            _event("GangAdmitted", 40.0, etype="Normal"),
+            _event("GangAdmitted", 90.0, etype="Normal"),
+        ]
+        report = attribute(timeline, events, podgroup=_FakePodGroup(),
+                           now=100.0)
+        assert report["running"] is True
+        assert report["time_to_running_seconds"] == pytest.approx(100.0)
+        rows = {r["cause"]: r["seconds"] for r in report["causes"]}
+        assert sum(rows.values()) == pytest.approx(100.0, abs=1e-9)
+        assert rows[CAUSE_NODE_LOSS_RECOVERY] == pytest.approx(40.0)
+        assert rows[CAUSE_PREEMPTION_DISPLACEMENT] == pytest.approx(30.0)
+        assert rows[CAUSE_STARTUP] == pytest.approx(22.0)
+        assert rows[CAUSE_PRIORITY_WAIT] == pytest.approx(5.0)
+        assert rows[CAUSE_CONTROL_PLANE] == pytest.approx(3.0)
+        # Shares are the same decomposition, normalized.
+        assert sum(r["share"] for r in report["causes"]) == pytest.approx(1.0)
+        # Every cause is drawn from the registered taxonomy (CL013).
+        assert all(r["cause"] in CAUSES for r in report["causes"])
+
+    def test_live_job_window_ends_now(self):
+        timeline = {"namespace": "d", "name": "j",
+                    "spans": [_span("admission", 5.0, 5.5)], "marks": {}}
+        report = attribute(timeline, [], now=30.0, created=0.0)
+        assert report["running"] is False
+        assert report["window"] == [0.0, 30.0]
+        assert sum(
+            r["seconds"] for r in report["causes"]) == pytest.approx(30.0)
+
+    def test_empty_timeline_is_a_zero_window(self):
+        report = attribute(None, [], now=7.0)
+        assert report["time_to_running_seconds"] == 0.0
+        assert report["causes"] == []
+
+    def test_rows_sorted_by_seconds_desc(self):
+        timeline = {"namespace": "d", "name": "j",
+                    "spans": [_span("time_to_running", 0.0, 50.0)],
+                    "marks": {}}
+        report = attribute(timeline, [_event("Preempted", 10.0, name="j")],
+                           now=50.0)
+        secs = [r["seconds"] for r in report["causes"]]
+        assert secs == sorted(secs, reverse=True)
+
+
+class TestExplainSurfaces:
+    def _seeded_cluster(self):
+        cluster = Cluster(VirtualClock())
+        tls = cluster.api.timelines
+        tls.record_span("default", "job-a", "u1", "time_to_running",
+                        0.0, 100.0)
+        tls.record_span("default", "job-a", "u1", "gang_solve",
+                        4.0, 5.0, wall=1.0)
+        cluster.api.record_event(_event("Preempted", 10.0))
+        cluster.api.record_event(_event("GangAdmitted", 40.0, etype="Normal"))
+        return cluster
+
+    def test_explain_against_the_in_process_api(self):
+        cluster = self._seeded_cluster()
+        report = explain(cluster.api, "default", "job-a")
+        assert report["name"] == "job-a"
+        rows = {r["cause"]: r["seconds"] for r in report["causes"]}
+        assert sum(rows.values()) == pytest.approx(100.0)
+        assert rows[CAUSE_PREEMPTION_DISPLACEMENT] == pytest.approx(30.0)
+        text = render_explain(report)
+        assert "job-a" in text and "preemption_displacement" in text
+
+    def test_sdk_explain_job_and_get_slo(self):
+        cluster = self._seeded_cluster()
+        client = TrainingClient(cluster)
+        report = client.explain_job("job-a")
+        assert report["time_to_running_seconds"] == pytest.approx(100.0)
+        register_slo_admission(cluster.api)
+        client.create_slo_policy(_policy())
+        assert [p.name for p in client.list_slo_policies()] == ["slo-ttr"]
+        section = client.get_slo()
+        assert section["policies"] == 1
+
+    def test_aggregate_queue_shares_normalizes_per_queue(self):
+        cluster = self._seeded_cluster()
+        shares = aggregate_queue_shares(cluster.api, now=100.0)
+        assert "default" in shares
+        assert sum(shares["default"].values()) == pytest.approx(1.0)
+        assert set(shares["default"]) <= set(CAUSES)
+
+
+# ---------------------------------------------------------------------------
+# Wire routes: /slo, /explain, /timelines (bare), and owning-shard routing
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def wire_pair():
+    cluster = Cluster(VirtualClock())
+    register_slo_admission(cluster.api)
+    server = ApiHTTPServer(cluster.api, port=0)
+    try:
+        yield cluster, RemoteAPIServer(server.url, timeout=10.0)
+    finally:
+        server.close()
+
+
+class TestWireRoutes:
+    def test_get_slo_route(self, wire_pair):
+        cluster, remote = wire_pair
+        cluster.api.create(_policy())
+        section = remote.get_slo()
+        assert section["policies"] == 1
+        assert [r["objective"] for r in section["objectives"]] == ["ttr"]
+
+    def test_explain_route(self, wire_pair):
+        cluster, remote = wire_pair
+        cluster.api.timelines.record_span(
+            "default", "job-w", "u1", "time_to_running", 0.0, 42.0)
+        report = remote.explain("default", "job-w")
+        assert report["time_to_running_seconds"] == pytest.approx(42.0)
+        assert sum(
+            r["seconds"] for r in report["causes"]) == pytest.approx(42.0)
+
+    def test_bare_timelines_route_lists_all(self, wire_pair):
+        cluster, remote = wire_pair
+        for n in ("t-a", "t-b"):
+            cluster.api.timelines.record_span(
+                "default", n, "u", "bind", 1.0, 2.0)
+        names = {tl["name"] for tl in remote.get_timelines()}
+        assert names == {"t-a", "t-b"}
+
+
+@pytest.fixture()
+def shard_pair():
+    """Two live shard hosts + the router over them (shard 0 = meta)."""
+    clusters = [Cluster(), Cluster()]
+    servers = [ApiHTTPServer(c.api, port=0) for c in clusters]
+    for c in clusters:
+        register_slo_admission(c.api)
+    router = ShardedRemoteAPIServer(
+        shard_addresses=[[s.url] for s in servers], timeout=5.0
+    )
+    try:
+        yield clusters, servers, router
+    finally:
+        for s in servers:
+            s.close()
+
+
+class TestShardedObservabilityRouting:
+    def _seed_timeline(self, cluster, ns, name, end=50.0):
+        cluster.api.timelines.record_span(
+            ns, name, "u", "time_to_running", 0.0, end)
+
+    def test_get_timeline_routes_to_the_owning_shard(self, shard_pair):
+        clusters, _, router = shard_pair
+        self._seed_timeline(clusters[0], NS_S0, "job-a0")
+        self._seed_timeline(clusters[1], NS_S1, "job-b1", end=70.0)
+        # Round-trip from each shard through the one router.
+        tl0 = router.get_timeline(NS_S0, "job-a0")
+        tl1 = router.get_timeline(NS_S1, "job-b1")
+        assert tl0["spans"][0]["end"] == 50.0
+        assert tl1["spans"][0]["end"] == 70.0
+        # The non-owning shard genuinely does not hold the timeline.
+        assert clusters[1].api.get_timeline(NS_S0, "job-a0") is None
+
+    def test_get_timelines_fans_out_and_tags_the_shard(self, shard_pair):
+        clusters, _, router = shard_pair
+        self._seed_timeline(clusters[0], NS_S0, "job-a0")
+        self._seed_timeline(clusters[1], NS_S1, "job-b1")
+        merged = router.get_timelines()
+        by_name = {tl["name"]: tl["shard"] for tl in merged}
+        assert by_name == {"job-a0": 0, "job-b1": 1}
+
+    def test_explain_served_from_the_owning_shard(self, shard_pair):
+        clusters, _, router = shard_pair
+        self._seed_timeline(clusters[1], NS_S1, "job-b1", end=100.0)
+        # Evidence co-lives on the owning shard: events route there too.
+        clusters[1].api.record_event(
+            _event("Preempted", 10.0, name="job-b1", ns=NS_S1))
+        clusters[1].api.record_event(
+            _event("GangAdmitted", 40.0, name="job-b1", ns=NS_S1,
+                   etype="Normal"))
+        report = router.explain(NS_S1, "job-b1")
+        rows = {r["cause"]: r["seconds"] for r in report["causes"]}
+        assert sum(rows.values()) == pytest.approx(100.0)
+        assert rows[CAUSE_PREEMPTION_DISPLACEMENT] == pytest.approx(30.0)
+
+    def test_get_slo_comes_from_the_meta_shard(self, shard_pair):
+        clusters, _, router = shard_pair
+        router.create(_policy())  # cluster-scoped -> meta shard (0)
+        assert len(clusters[0].api.list("SLOPolicy")) == 1
+        assert len(clusters[1].api.list("SLOPolicy")) == 0
+        section = router.get_slo()
+        assert section["policies"] == 1
+
+    def test_describe_round_trips_through_the_router(self, shard_pair):
+        clusters, _, router = shard_pair
+        from training_operator_tpu.observe import render_describe
+
+        router.create(JAXJob(metadata=ObjectMeta(name="dj", namespace=NS_S1)))
+        clusters[1].api.record_event(
+            _event("GangAdmitted", 1.0, name="dj", ns=NS_S1, etype="Normal"))
+        text = render_describe(router, NS_S1, "dj")
+        assert "dj" in text and "GangAdmitted" in text
+
+
+# ---------------------------------------------------------------------------
+# Merged chrome-trace export
+# ---------------------------------------------------------------------------
+
+
+class TestMergedChromeTrace:
+    def test_sources_become_processes_jobs_become_threads(self, tmp_path):
+        s0, s1 = TimelineStore(), TimelineStore()
+        s0.record_span("a", "j0", "u", "bind", 1.0, 2.0)
+        s0.record_span("a", "j1", "u", "bind", 2.0, 3.0)
+        s1.record_span("b", "j2", "u", "gang_solve", 0.0, 0.0, wall=1.5)
+        out = str(tmp_path / "merged.json")
+        doc = export_chrome_trace_merged(
+            {"shard-1": s1, "shard-0": s0}, out)
+        with open(out) as f:
+            assert json.load(f) == doc
+        procs = {e["args"]["name"]: e["pid"] for e in doc["traceEvents"]
+                 if e["name"] == "process_name"}
+        assert procs == {"shard-0": 1, "shard-1": 2}, "sorted labels -> pids"
+        threads = {(e["pid"], e["tid"]): e["args"]["name"]
+                   for e in doc["traceEvents"] if e["name"] == "thread_name"}
+        assert threads[(1, 1)] == "a/j0"
+        assert threads[(1, 2)] == "a/j1"
+        assert threads[(2, 1)] == "b/j2"
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert {e["name"] for e in spans} == {"bind", "gang_solve"}
+        solve = next(e for e in spans if e["name"] == "gang_solve")
+        assert solve["dur"] == pytest.approx(1.5e6), "wall wins for virtual"
+        bind0 = next(e for e in spans if e["ts"] == 1e6)
+        assert bind0["dur"] == pytest.approx(1e6), "shared cluster clock"
+
+    def test_router_fanout_feeds_the_merged_exporter(self, shard_pair):
+        clusters, _, router = shard_pair
+        clusters[0].api.timelines.record_span(
+            NS_S0, "ja", "u", "bind", 1.0, 2.0)
+        clusters[1].api.timelines.record_span(
+            NS_S1, "jb", "u", "bind", 3.0, 4.0)
+        by_shard = {}
+        for tl in router.get_timelines():
+            by_shard.setdefault(f"store-shard-{tl['shard']}", []).append(tl)
+        doc = export_chrome_trace_merged(by_shard)
+        procs = [e["args"]["name"] for e in doc["traceEvents"]
+                 if e["name"] == "process_name"]
+        assert procs == ["store-shard-0", "store-shard-1"]
+        assert len([e for e in doc["traceEvents"] if e["ph"] == "X"]) == 2

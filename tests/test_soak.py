@@ -99,6 +99,23 @@ class TestSoakSmoke:
         assert set(jobs["by_kind"]) >= {
             "jax-sub", "jax-host", "jax-full", "mpi", "cpu", "v2",
         }
+        # Per-tier SLO attainment (PR 19): every disruption tier that
+        # struck a running job reports its own attainment slice, plus the
+        # undisrupted control group, all joined against the priority-aware
+        # TTR targets. With zero invariant violations above, this is the
+        # "per-tier SLO attainment under chaos" acceptance report.
+        by_tier = report["slo"]["by_tier"]
+        assert "undisrupted" in by_tier
+        for tier, row in by_tier.items():
+            assert row["jobs"] >= row["ran"] >= 0, (tier, row)
+            if row["ran"]:
+                assert 0.0 <= row["attainment"] <= 1.0, (tier, row)
+                assert row["p50_ttr_s"] <= row["p99_ttr_s"], (tier, row)
+            else:
+                assert row["attainment"] is None, (tier, row)
+        undis = by_tier["undisrupted"]
+        assert undis["ran"] > 0
+        assert undis["attainment"] >= 0.9, undis
 
     def test_disruptions_recover(self, tmp_path):
         """Node/pod kills and maintenance drains open MTTR records and the

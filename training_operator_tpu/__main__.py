@@ -495,6 +495,11 @@ def wire_cluster_services(cluster: Cluster, cfg: OperatorConfig) -> None:
     # Tenancy kinds are stored wherever the gang scheduler runs; their
     # admission rides along so a malformed quota can't wedge the arbiter.
     register_tenancy_admission(cluster.api)
+    # SLOPolicy admission rides the same registration site for the same
+    # reason: a malformed objective must not wedge the burn-rate evaluator.
+    from training_operator_tpu.observe.slo import register_slo_admission
+
+    register_slo_admission(cluster.api)
     if cfg.gang_scheduler_name != "none":
         placer = {
             "tpu-packer": lambda: TPUPacker(
@@ -540,9 +545,16 @@ def wire_fleet_plane(cluster: Cluster, cfg: OperatorConfig, sources=None):
         FleetCollector,
         FleetSources,
         InvariantAuditor,
+        SLOEvaluator,
     )
 
     sources = sources or FleetSources()
+    if sources.slo is None:
+        # SLO evaluation rides the same tick as the audit/collect pass: one
+        # evaluator per control plane, scoring stored SLOPolicies against
+        # the windowed latency families and republishing training_slo_*.
+        evaluator = SLOEvaluator(cluster.api, cluster.clock.now)
+        sources.slo = evaluator.evaluate
     auditor = InvariantAuditor(
         cluster.api,
         cluster.clock.now,
@@ -1303,6 +1315,49 @@ def run_describe(argv) -> int:
     return 0
 
 
+def run_explain(argv) -> int:
+    """`python -m training_operator_tpu explain <ns>/<job>` — the "why is
+    my job not running yet" report: time-to-running decomposed into the
+    registered cause taxonomy (observe/attribution.py), live or
+    post-mortem. The report is built server-side (GET /explain/{ns}/{name})
+    from the evidence the serving host holds; through a sharded front end
+    it comes from the job's owning shard."""
+    import os as _os
+
+    ap = argparse.ArgumentParser(
+        prog="python -m training_operator_tpu explain",
+        description="per-job latency attribution: where time-to-running went",
+    )
+    ap.add_argument("target", help="<namespace>/<job> (or just <job>, "
+                                   "namespace defaults to 'default')")
+    ap.add_argument("--api-server", required=True, metavar="URL",
+                    help="base URL of the serving host (WIRE_API=...)")
+    ap.add_argument("--api-token", default=None,
+                    help="bearer token (env TPU_OPERATOR_API_TOKEN)")
+    ap.add_argument("--ca-cert", default=None, metavar="PEM",
+                    help="CA bundle pinning an https host (WIRE_CA=...; "
+                         "env TPU_OPERATOR_CA_CERT)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw report dict as JSON instead of text")
+    args = ap.parse_args(argv)
+    ns, _, name = args.target.rpartition("/")
+    ns = ns or "default"
+    from training_operator_tpu.cluster.httpapi import RemoteAPIServer
+    from training_operator_tpu.observe import render_explain
+
+    api = RemoteAPIServer(
+        args.api_server,
+        token=args.api_token or _os.environ.get("TPU_OPERATOR_API_TOKEN") or None,
+        ca_file=args.ca_cert or _os.environ.get("TPU_OPERATOR_CA_CERT") or None,
+    )
+    report = api.explain(ns, name)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render_explain(report))
+    return 0
+
+
 def run_top(argv) -> int:
     """`python -m training_operator_tpu top --api-server URL` — the
     kubectl-top analogue against a serving host: node/slice chip
@@ -1447,6 +1502,8 @@ def main(argv=None) -> int:
         return lint_run(raw[1:])
     if raw and raw[0] == "describe":
         return run_describe(raw[1:])
+    if raw and raw[0] == "explain":
+        return run_explain(raw[1:])
     if raw and raw[0] == "top":
         return run_top(raw[1:])
     if raw and raw[0] == "queues":

@@ -38,6 +38,14 @@ Rules (all ERROR; the tree must stay green — `make lint` runs this):
         journals silently disagree about which objects' history they own.
         `cluster/store.py` defines the class; `cluster/shards.py` is the
         only module allowed to instantiate it.
+  CL013 attribution-cause-outside-taxonomy    minting latency-attribution
+        causes outside the registered taxonomy: either a
+        `register_cause(...)` call anywhere but observe/attribution.py
+        (CL005/CL006 applied to the cause catalog), or a free-text cause
+        string — a `{"cause": "..."}` literal whose value is not one of the
+        registered cause ids. `explain` reports and per-queue attribution
+        shares are only joinable/diffable across jobs while every producer
+        draws from the one taxonomy table in the README.
   CL007 full-store-walk-in-scheduler    an unfiltered `.list("Pod")` /
         `.list("Node")` / `.list_refs(...)` over the Pod or Node kinds
         anywhere in scheduler/ outside snapshot.py. The incremental solver
@@ -112,7 +120,7 @@ def _looks_like_snapshot(node: ast.AST) -> bool:
 
 # The registry factory methods whose call outside utils/metrics.py is a
 # CL005 finding.
-METRIC_FACTORIES = ("counter", "gauge", "histogram")
+METRIC_FACTORIES = ("counter", "gauge", "histogram", "sliding_histogram")
 
 
 def _is_registry_receiver(node: ast.AST) -> bool:
@@ -145,6 +153,47 @@ def _is_invariant_registration(call: ast.Call) -> bool:
     if isinstance(f, ast.Name):
         return f.id == INVARIANT_REGISTRAR
     return isinstance(f, ast.Attribute) and f.attr == INVARIANT_REGISTRAR
+
+
+# The latency-attribution cause registrar (CL013): one name, matched as a
+# bare call or an attribute call (`attribution.register_cause`).
+CAUSE_REGISTRAR = "register_cause"
+
+# The registered cause taxonomy (CL013). Mirrors
+# observe/attribution.py's CAUSES table; tests/test_analysis.py asserts the
+# two cannot drift. A `{"cause": <literal>}` outside this tuple is a
+# free-text cause string.
+CAUSE_TAXONOMY = (
+    "quota_wait",
+    "priority_wait",
+    "topology_fragmentation",
+    "preemption_displacement",
+    "node_loss_recovery",
+    "control_plane_overhead",
+    "startup",
+)
+
+
+def _is_cause_registration(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id == CAUSE_REGISTRAR
+    return isinstance(f, ast.Attribute) and f.attr == CAUSE_REGISTRAR
+
+
+def _free_text_cause(node: ast.Dict) -> Optional[str]:
+    """The dict literal carries a `"cause"` key whose value is a string
+    constant outside the registered taxonomy; returns the rogue string."""
+    for key, value in zip(node.keys, node.values):
+        if (
+            isinstance(key, ast.Constant)
+            and key.value == "cause"
+            and isinstance(value, ast.Constant)
+            and isinstance(value.value, str)
+            and value.value not in CAUSE_TAXONOMY
+        ):
+            return value.value
+    return None
 
 
 # The store kinds whose unfiltered walk in scheduler/ is a CL007 finding:
@@ -229,6 +278,8 @@ def check_source(path: str, source: str, package_rel: Optional[str] = None) -> L
     in_metrics_module = rel.endswith("utils/metrics.py")
     # The one file allowed to register invariant rules (CL006).
     in_invariants_module = rel.endswith("observe/invariants.py")
+    # The one file allowed to register attribution causes (CL013).
+    in_attribution_module = rel.endswith("observe/attribution.py")
     # The wire modules may import each other's internals (one subsystem,
     # four files); everyone else goes through the httpapi facade's public
     # names.
@@ -276,6 +327,26 @@ def check_source(path: str, source: str, package_rel: Optional[str] = None) -> L
                 "observe/invariants.py; declare the rule there so the "
                 "INV rule catalog stays one greppable list",
             ))
+        if (
+            isinstance(node, ast.Call)
+            and not in_attribution_module
+            and _is_cause_registration(node)
+        ):
+            findings.append(Finding(
+                path, node.lineno, "CL013",
+                "attribution cause registration (register_cause) outside "
+                "observe/attribution.py; declare the cause there so the "
+                "taxonomy table stays one greppable list",
+            ))
+        if isinstance(node, ast.Dict) and not in_attribution_module:
+            rogue = _free_text_cause(node)
+            if rogue is not None:
+                findings.append(Finding(
+                    path, node.lineno, "CL013",
+                    f"free-text attribution cause {rogue!r}; use a cause id "
+                    f"from the registered taxonomy "
+                    f"(observe/attribution.py CAUSES)",
+                ))
         if (
             isinstance(node, ast.Call)
             and in_scheduler

@@ -182,6 +182,10 @@ class APIServer:
         # Event aggregation index (k8s parity): aggregation_key -> index in
         # _events, so identical repeats bump a count instead of appending.
         self._event_index: Dict[tuple, int] = {}
+        # Per-object read index: object_name -> indices into _events, so
+        # `events(object_name=...)` (the explain/attribution evidence read,
+        # issued once per job) is O(own events), not a full-list scan.
+        self._events_by_name: Dict[str, List[int]] = {}
         # Event retention bound (the k8s events-TTL analogue, count-shaped
         # for a virtual-clock store): the event list was the last unbounded
         # accumulator in the control plane — a week-long soak grows it
@@ -495,6 +499,14 @@ class APIServer:
         tl = self.timelines.timeline(namespace, name)
         return None if tl is None else tl.to_dict()
 
+    def get_timelines(self, limit: int = 256) -> List[Dict[str, Any]]:
+        """The newest retained timelines as wire-shaped dicts — the bulk
+        feed GET /timelines serves, and what the merged chrome-trace export
+        fans in per shard/replica. Capped: the LRU retains max_jobs, and a
+        wire response walking all of them at 10k-job scale would be a
+        self-inflicted LIST storm."""
+        return [tl.to_dict() for tl in self.timelines.timelines()[-limit:]]
+
     def record_spans(
         self,
         namespace: str,
@@ -793,6 +805,8 @@ class APIServer:
             event.first_timestamp = event.timestamp
         event.count = max(1, event.count)
         self._event_index[key] = len(self._events)
+        self._events_by_name.setdefault(event.object_name, []).append(
+            len(self._events))
         self._events.append(event)
         if len(self._events) > self._event_cap:
             drop = len(self._events) - (self._event_cap * 3) // 4
@@ -800,6 +814,9 @@ class APIServer:
             self._event_index = {
                 e.aggregation_key(): i for i, e in enumerate(self._events)
             }
+            self._events_by_name = {}
+            for i, e in enumerate(self._events):
+                self._events_by_name.setdefault(e.object_name, []).append(i)
             metrics.events_trimmed.inc(amount=drop)
 
     def record_event(self, event: Event) -> None:
@@ -827,11 +844,14 @@ class APIServer:
         self, object_name: Optional[str] = None, reason: Optional[str] = None
     ) -> List[Event]:
         with self._lock:
+            if object_name is not None:
+                pool = [self._events[i]
+                        for i in self._events_by_name.get(object_name, ())]
+            else:
+                pool = self._events
             return [
-                e
-                for e in self._events
-                if (object_name is None or e.object_name == object_name)
-                and (reason is None or e.reason == reason)
+                e for e in pool
+                if reason is None or e.reason == reason
             ]
 
 

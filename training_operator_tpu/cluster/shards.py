@@ -57,7 +57,9 @@ log = logging.getLogger(__name__)
 # routing table — the wire router, the in-process shard set, and INV011's
 # ownership check all import it, so they cannot disagree about where a
 # cluster-scoped object lives.
-CLUSTER_SCOPED_KINDS = frozenset({"Node", "PriorityClass", "ClusterQueue", "Lease"})
+CLUSTER_SCOPED_KINDS = frozenset({
+    "Node", "PriorityClass", "ClusterQueue", "Lease", "SLOPolicy",
+})
 
 
 def shard_for(kind: str, namespace: Optional[str], num_shards: int,

@@ -43,6 +43,7 @@ from typing import Any, Callable, Dict, Optional
 
 from training_operator_tpu.api import jobs as jobs_api
 from training_operator_tpu.cluster import objects as cluster_objects
+from training_operator_tpu.observe import slo as slo_api
 from training_operator_tpu.runtime import api as runtime_api
 from training_operator_tpu.tenancy import api as tenancy_api
 from training_operator_tpu.utils.locks import TrackedLock
@@ -72,6 +73,7 @@ KIND_REGISTRY: Dict[str, type] = {
         runtime_api.ClusterTrainingRuntime,
         tenancy_api.PriorityClass,
         tenancy_api.ClusterQueue,
+        slo_api.SLOPolicy,
     )
 }
 

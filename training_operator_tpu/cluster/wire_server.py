@@ -681,6 +681,10 @@ class ApiHTTPServer:
             self._promote(h)
         elif head == "timelines":
             self._timelines(h, method, parts[1:])
+        elif head == "slo" and method == "GET":
+            self._slo(h)
+        elif head == "explain" and method == "GET" and len(parts) == 3:
+            self._explain(h, seg_ns(parts[1]), parts[2])
         elif head == "version" and len(parts) == 4:
             rv = self.api.resource_version(parts[1], seg_ns(parts[2]), parts[3])
             h._send(200, {"resourceVersion": rv})
@@ -1143,11 +1147,41 @@ class ApiHTTPServer:
         else:
             raise NotFoundError("bad logs method")
 
+    def _slo(self, h) -> None:
+        """GET /slo: the burn-rate evaluation section on demand. Served
+        from the fleet plane's evaluator when one is attached (shares its
+        incident edge-detector, so polling /slo cannot double-fire
+        SLOBurnRate events); otherwise a transient, event-silent evaluation
+        — correct numbers, no incident side effects from a read."""
+        source = self.fleet_sources.slo
+        if source is not None:
+            h._send(200, source())
+            return
+        from training_operator_tpu.observe.slo import SLOEvaluator
+
+        h._send(200, SLOEvaluator(
+            self.api, self.now_fn, enable_events=False,
+        ).evaluate())
+
+    def _explain(self, h, ns: str, name: str) -> None:
+        """GET /explain/{ns}/{name}: per-job latency attribution, built
+        from the evidence this host already holds (timeline + Events +
+        PodGroup — all co-sharded by namespace, so the owning shard answers
+        alone)."""
+        from training_operator_tpu.observe.attribution import explain
+
+        h._send(200, explain(self.api, ns, name, now=self.now_fn()))
+
     def _timelines(self, h, method: str, parts: List[str]) -> None:
         """/timelines/{ns}/{name}: GET one job's lifecycle timeline from
         the ring; POST ingests spans a remote operator recorded (its
         manager's queue-wait/reconcile instrumentation runs in another
-        process but the ring lives with the store)."""
+        process but the ring lives with the store). A bare GET /timelines
+        lists the newest retained timelines — the per-shard feed the
+        merged chrome-trace export fans in."""
+        if not parts and method == "GET":
+            h._send(200, {"items": self.api.get_timelines()})
+            return
         if len(parts) != 2:
             raise NotFoundError("timelines route is /timelines/<ns>/<job>")
         ns, name = seg_ns(parts[0]), parts[1]

@@ -382,6 +382,33 @@ class ShardedRemoteAPIServer:
             namespace, name
         )
 
+    def get_timelines(self) -> List[Dict[str, Any]]:
+        """Fan out the bulk timeline feed: every shard's newest retained
+        timelines, each tagged with its source shard so the merged
+        chrome-trace export can lay processes out per shard."""
+        out: List[Dict[str, Any]] = []
+        for i, r in enumerate(self.shard_remotes):
+            for tl in r.get_timelines():
+                tagged = dict(tl)
+                tagged["shard"] = i
+                out.append(tagged)
+        return out
+
+    def explain(self, namespace: str, name: str) -> Dict[str, Any]:
+        """Per-job attribution from the OWNING shard: a namespace's
+        Timeline, Events, and PodGroup all hash to the same shard
+        (cluster/shards.py shard_for), so the shard that stores the job
+        holds its complete evidence — no cross-shard join needed."""
+        return self.shard_remote("Timeline", namespace).explain(
+            namespace, name
+        )
+
+    def get_slo(self) -> Dict[str, Any]:
+        """SLOPolicy is meta-shard-pinned (CLUSTER_SCOPED_KINDS) and the
+        windowed latency families live with each serving process; the meta
+        shard's evaluation is the authoritative policy view."""
+        return self.meta_remote.get_slo()
+
     # -- aggregation surfaces --------------------------------------------
 
     def get_fleet(self) -> Dict[str, Any]:

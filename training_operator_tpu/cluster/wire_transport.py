@@ -1091,6 +1091,21 @@ class RemoteAPIServer:
         rebuilds it only when the store version or audit generation moved."""
         return self._request("GET", "/fleet", channel=self._read_channel())
 
+    def get_slo(self) -> Dict[str, Any]:
+        """The host's SLO burn-rate section (GET /slo): per-objective
+        attainment/budget/burn plus per-queue attribution shares — the
+        same block GET /fleet embeds, fetchable without the full walk."""
+        return self._request("GET", "/slo", channel=self._read_channel())
+
+    def explain(self, namespace: str, name: str) -> Dict[str, Any]:
+        """One job's latency attribution report (GET /explain/{ns}/{name}):
+        time-to-running decomposed into the registered cause taxonomy,
+        live or post-mortem."""
+        return self._request(
+            "GET", f"/explain/{ns_seg(namespace)}/{quote_seg(name)}",
+            channel=self._read_channel(),
+        )
+
     # -- replication -------------------------------------------------------
 
     def get_wal(self, after: int = 0, limit: int = 1024,
@@ -1130,6 +1145,14 @@ class RemoteAPIServer:
             )
         except NotFoundError:
             return None
+
+    def get_timelines(self) -> List[Dict[str, Any]]:
+        """The host's newest retained timelines (bare GET /timelines) —
+        the per-process feed export_chrome_trace_merged fans in."""
+        payload = self._request(
+            "GET", "/timelines", channel=self._read_channel()
+        )
+        return list(payload.get("items", []))
 
     @property
     def timelines(self) -> "RemoteTimelines":

@@ -577,6 +577,12 @@ class OperatorManager:
         # wall wait carried in `wall` (workqueue stamps are wall-monotonic).
         wait = self.queue.waited(key)
         metrics.job_queue_wait_seconds.observe(wait)
+        # Windowed twin for the SLO burn-rate evaluator. Queue label is ""
+        # (the workqueue predates tenancy resolution); per-kind objectives
+        # still slice, and "*" objectives score the union.
+        metrics.slo_queue_wait_window.observe(
+            wait, "", kind, now=self.cluster.clock.now(),
+        )
         tracing = observe.enabled()
         now = self.cluster.clock.now() if tracing else 0.0
         if tracing:

@@ -630,6 +630,16 @@ class JobController:
                     if first_run:
                         start = created if created is not None else at
                         metrics.job_time_to_running_seconds.observe(max(0.0, at - start))
+                        # Windowed twin for the SLO burn-rate evaluator,
+                        # keyed by tenancy queue so per-queue objectives
+                        # slice without a store walk at evaluation time.
+                        pg = self.api.try_get("PodGroup", job.namespace, job.name)
+                        metrics.slo_time_to_running_window.observe(
+                            max(0.0, at - start),
+                            getattr(pg, "queue", "") or "",
+                            job.kind,
+                            now=at,
+                        )
                         tl.record_span(
                             job.namespace, job.name, job.uid, "time_to_running",
                             start=start, end=at, kind=job.kind,

@@ -17,12 +17,23 @@ the gang scheduler records into it. The wire exposes one job's timeline at
 registry text exposition at `GET /metrics.txt`.
 """
 
+from training_operator_tpu.observe.attribution import (  # noqa: F401
+    CAUSES,
+    aggregate_queue_shares,
+    attribute,
+    explain,
+    register_cause,
+    render_explain,
+)
 from training_operator_tpu.observe.describe import (  # noqa: F401
     find_job,
     phase_table,
     render_describe,
 )
-from training_operator_tpu.observe.export import export_chrome_trace  # noqa: F401
+from training_operator_tpu.observe.export import (  # noqa: F401
+    export_chrome_trace,
+    export_chrome_trace_merged,
+)
 from training_operator_tpu.observe.fleet import (  # noqa: F401
     FleetCollector,
     collect_fleet,
@@ -33,6 +44,14 @@ from training_operator_tpu.observe.invariants import (  # noqa: F401
     InvariantAuditor,
     InvariantViolationError,
     Violation,
+)
+from training_operator_tpu.observe.slo import (  # noqa: F401
+    SLOEvaluator,
+    SLOObjective,
+    SLOPolicy,
+    register_slo_admission,
+    render_slo,
+    validate_slo_policy,
 )
 from training_operator_tpu.observe.timeline import (  # noqa: F401
     JobTimeline,

@@ -1,0 +1,475 @@
+"""Per-job latency attribution: why was time-to-running spent where?
+
+The SLO engine (observe/slo.py) says WHETHER jobs are slow; this module
+says WHY. It joins three evidence streams that already exist — the job's
+timeline spans (PR 4), its PodGroup's tenancy state, and the lifecycle
+Events the scheduler/arbiter emit — and decomposes the job's
+time-to-running into a REGISTERED cause taxonomy:
+
+  quota_wait                gang held at the quota gate (QuotaExceeded)
+  priority_wait             waiting its turn in the priority-ordered solve
+  topology_fragmentation    no feasible placement found (Unschedulable)
+  preemption_displacement   displaced by the fair-share arbiter (Preempted)
+  node_loss_recovery        placement lost to a dead node (PlacementInvalidated
+                            / node_evict) and re-solved
+  control_plane_overhead    measured admission/queue/reconcile/solve/bind walls
+  startup                   residual (container start, image pull analogue)
+
+Causes must be drawn from this registry — codelint CL013 rejects free-text
+cause strings, so dashboards and the item-3 autoscaler can rely on the ids
+being a closed vocabulary.
+
+The decomposition is an interval sweep, not a guess: each evidence item
+opens an interval at its occurrence and closes at the next RECOVERY ANCHOR
+(a GangAdmitted event, a bind, the Running instant); overlapping claims
+resolve by fixed precedence (displacement > node loss > quota > topology >
+priority); the uncovered residual splits into measured control-plane wall
+time and startup. Rows therefore sum EXACTLY to the job's time-to-running
+— the acceptance property tests/test_slo.py pins.
+
+Works live ("why is my job not running yet": window = creation -> now) and
+post-mortem (window = the recorded time_to_running span). Surfaced as
+`TrainingClient.explain_job()`, `python -m training_operator_tpu explain
+<ns>/<job>`, and `GET /explain/{ns}/{name}` — which the sharded router
+serves from the job's owning store shard, where ALL its namespaced
+evidence (timeline + Events + PodGroup) lives by construction.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Cause taxonomy (the closed vocabulary CL013 enforces)
+# ---------------------------------------------------------------------------
+
+CAUSES: "OrderedDict[str, str]" = OrderedDict()
+
+
+def register_cause(cause_id: str, description: str) -> str:
+    """Register one attribution cause; returns the id so call sites bind
+    constants to registrations (free-text ids at use sites are a CL013
+    finding)."""
+    CAUSES[cause_id] = description
+    return cause_id
+
+
+CAUSE_QUOTA_WAIT = register_cause(
+    "quota_wait",
+    "held at the quota gate: queue usage + demand exceeded quota+borrowing",
+)
+CAUSE_PRIORITY_WAIT = register_cause(
+    "priority_wait",
+    "waiting for admission behind the priority-ordered solve queue",
+)
+CAUSE_TOPOLOGY_FRAGMENTATION = register_cause(
+    "topology_fragmentation",
+    "no feasible contiguous placement despite free capacity (Unschedulable)",
+)
+CAUSE_PREEMPTION_DISPLACEMENT = register_cause(
+    "preemption_displacement",
+    "displaced by the fair-share arbiter and re-queued (Preempted)",
+)
+CAUSE_NODE_LOSS_RECOVERY = register_cause(
+    "node_loss_recovery",
+    "placement invalidated by node loss / chaos and re-solved",
+)
+CAUSE_CONTROL_PLANE = register_cause(
+    "control_plane_overhead",
+    "measured admission + workqueue + reconcile + solve + bind wall time",
+)
+CAUSE_STARTUP = register_cause(
+    "startup",
+    "residual: container start and other unattributed ramp-up",
+)
+
+# Highest first — the pointwise winner where evidence intervals overlap
+# (being displaced outranks the quota gate you also happen to be behind).
+PRECEDENCE: Tuple[str, ...] = (
+    CAUSE_PREEMPTION_DISPLACEMENT,
+    CAUSE_NODE_LOSS_RECOVERY,
+    CAUSE_QUOTA_WAIT,
+    CAUSE_TOPOLOGY_FRAGMENTATION,
+    CAUSE_PRIORITY_WAIT,
+)
+
+# Spans whose wall time is the control plane's own measured cost within the
+# window (observe/describe.py PHASE_ORDER, minus the composite phases).
+_CONTROL_PLANE_SPANS = (
+    "admission", "queue_wait", "reconcile", "gang_solve", "bind",
+)
+
+# Event reasons -> the cause their occurrence evidences (scheduler/gang.py
+# + tenancy/arbiter.py vocabulary).
+_EVENT_CAUSES = {
+    "Preempted": CAUSE_PREEMPTION_DISPLACEMENT,
+    "PlacementInvalidated": CAUSE_NODE_LOSS_RECOVERY,
+    "QuotaExceeded": CAUSE_QUOTA_WAIT,
+    "Unschedulable": CAUSE_TOPOLOGY_FRAGMENTATION,
+}
+
+# Event reasons that close open evidence intervals: the gang is admitted
+# again (or bound), so whatever it was waiting on has resolved.
+_ANCHOR_REASONS = ("GangAdmitted",)
+
+
+def _get(item: Any, key: str, default: Any = None) -> Any:
+    """Field access over both dataclass Events and wire-decoded dicts."""
+    if isinstance(item, dict):
+        return item.get(key, default)
+    return getattr(item, key, default)
+
+
+def _event_instants(event: Any) -> List[float]:
+    """Occurrence instants of one (possibly aggregated) Event: first and
+    last timestamps. Intermediate occurrences of a count>2 aggregate are
+    unrecoverable — the interval sweep tolerates that by construction."""
+    last = float(_get(event, "timestamp", 0.0) or 0.0)
+    first = float(_get(event, "first_timestamp", 0.0) or 0.0) or last
+    return [first] if first == last else [first, last]
+
+
+def attribute(
+    timeline: Optional[Dict[str, Any]],
+    events: Optional[List[Any]] = None,
+    podgroup: Any = None,
+    now: float = 0.0,
+    created: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Decompose one job's time-to-running into the registered causes.
+
+    `timeline` is a JobTimeline dict (spans/marks); `events` the job's
+    lifecycle Events; `podgroup` its PodGroup (or None). Pure function of
+    its inputs — the deterministic core the wire route, the client, and the
+    per-queue aggregates all share."""
+    spans = list((timeline or {}).get("spans", ()))
+    marks = dict((timeline or {}).get("marks", {}))
+    events = events or []
+
+    # -- the attribution window: creation -> first Running ----------------
+    ttr_span = next(
+        (s for s in spans if s.get("name") == "time_to_running"), None
+    )
+    if ttr_span is not None:
+        t0, t1 = float(ttr_span["start"]), float(ttr_span["end"])
+        running = True
+    else:
+        candidates = [float(created)] if created is not None else []
+        if "created" in marks:
+            candidates.append(float(marks["created"]))
+        pg_created = getattr(
+            getattr(podgroup, "metadata", None), "creation_time", None
+        )
+        if pg_created is not None:
+            candidates.append(float(pg_created))
+        candidates.extend(float(s["start"]) for s in spans if s.get("start"))
+        t0 = min(candidates) if candidates else float(now)
+        t1 = float(now)
+        running = False
+    total = max(0.0, t1 - t0)
+
+    # -- recovery anchors: instants that close open evidence intervals ----
+    anchors = [t1]
+    for ev in events:
+        if _get(ev, "reason") in _ANCHOR_REASONS:
+            anchors.extend(_event_instants(ev))
+    for s in spans:
+        if s.get("name") in ("bind", "gang_solve"):
+            anchors.append(float(s["end"]))
+    if "running" in marks:
+        anchors.append(float(marks["running"]))
+    anchors = sorted(a for a in anchors if t0 <= a <= t1)
+
+    def close_after(t: float) -> float:
+        for a in anchors:
+            if a > t:
+                return a
+        return t1
+
+    # -- evidence intervals, clipped to the window -------------------------
+    intervals: Dict[str, List[Tuple[float, float]]] = {}
+    evidence: Dict[str, int] = {}
+
+    def claim(cause: str, lo: float, hi: float) -> None:
+        lo, hi = max(lo, t0), min(hi, t1)
+        if hi > lo:
+            intervals.setdefault(cause, []).append((lo, hi))
+            evidence[cause] = evidence.get(cause, 0) + 1
+
+    for ev in events:
+        cause = _EVENT_CAUSES.get(_get(ev, "reason", ""))
+        if cause is None:
+            continue
+        for te in _event_instants(ev):
+            if te < t0 or te > t1:
+                continue
+            claim(cause, te, close_after(te))
+    for s in spans:
+        if s.get("name") == "node_evict":
+            ts = float(s["start"])
+            if t0 <= ts <= t1:
+                claim(CAUSE_NODE_LOSS_RECOVERY, ts, close_after(ts))
+
+    # Pre-admission wait: the stretch before the gang's FIRST admission,
+    # claimable as priority_wait only when the job actually rode the
+    # priority-ordered gang queue (it has a PodGroup) — lowest precedence,
+    # so stronger evidence overlapping it wins pointwise.
+    if podgroup is not None:
+        first_admit = min(
+            (a for a in anchors if a < t1), default=t1
+        ) if anchors else t1
+        claim(CAUSE_PRIORITY_WAIT, t0, first_admit)
+
+    # -- precedence sweep: pointwise-highest cause wins --------------------
+    bounds = sorted({t0, t1, *(
+        b for ivs in intervals.values() for iv in ivs for b in iv
+    )})
+    seconds: Dict[str, float] = {}
+    for lo, hi in zip(bounds, bounds[1:]):
+        mid = (lo + hi) / 2.0
+        for cause in PRECEDENCE:
+            if any(a <= mid < b for a, b in intervals.get(cause, ())):
+                seconds[cause] = seconds.get(cause, 0.0) + (hi - lo)
+                break
+
+    # -- residual: measured control-plane walls, then startup --------------
+    covered = sum(seconds.values())
+    residual = max(0.0, total - covered)
+    cp_measured = sum(
+        (s.get("wall") or 0.0)
+        if (s.get("wall") or 0.0) > 0.0
+        else max(0.0, float(s.get("end", 0.0)) - float(s.get("start", 0.0)))
+        for s in spans
+        if s.get("name") in _CONTROL_PLANE_SPANS
+        and t0 <= float(s.get("end", 0.0)) <= t1
+    )
+    cp = min(residual, cp_measured)
+    if cp > 0.0:
+        seconds[CAUSE_CONTROL_PLANE] = cp
+        evidence[CAUSE_CONTROL_PLANE] = sum(
+            1 for s in spans if s.get("name") in _CONTROL_PLANE_SPANS
+        )
+    startup = residual - cp
+    if startup > 0.0:
+        seconds[CAUSE_STARTUP] = startup
+
+    rows = [
+        {
+            "cause": cause,
+            "seconds": secs,
+            "share": (secs / total) if total > 0 else 0.0,
+            "evidence": evidence.get(cause, 0),
+            "description": CAUSES.get(cause, ""),
+        }
+        for cause, secs in sorted(seconds.items(), key=lambda kv: -kv[1])
+    ]
+    return {
+        "namespace": (timeline or {}).get("namespace", ""),
+        "name": (timeline or {}).get("name", ""),
+        "running": running,
+        "window": [t0, t1],
+        "time_to_running_seconds": total,
+        "causes": rows,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Evidence fetch + surfaces
+# ---------------------------------------------------------------------------
+
+
+def _fetch_timeline(api, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+    getter = getattr(api, "get_timeline", None)
+    tl: Any = None
+    if callable(getter):
+        try:
+            tl = getter(namespace, name)
+        except Exception:
+            tl = None
+    if tl is None:
+        store = getattr(api, "timelines", None)
+        if store is not None and hasattr(store, "timeline"):
+            tl = store.timeline(namespace, name)
+    if tl is not None and hasattr(tl, "to_dict"):
+        tl = tl.to_dict()
+    return tl
+
+
+def _job_events(api, namespace: str, name: str) -> List[Any]:
+    try:
+        evs = api.events(object_name=name)
+    except Exception:
+        return []
+    return [
+        ev for ev in evs
+        if (_get(ev, "namespace", "") or "") in ("", namespace)
+    ]
+
+
+def _podgroup(api, namespace: str, name: str) -> Optional[Any]:
+    """Read-only PodGroup evidence: the no-copy `get_ref` where the store
+    offers it (attribution only reads attributes), `try_get` elsewhere."""
+    ref_get = getattr(api, "get_ref", None)
+    try:
+        if callable(ref_get):
+            return ref_get("PodGroup", namespace, name)
+        return api.try_get("PodGroup", namespace, name)
+    except Exception:
+        return None
+
+
+def _job_creation_time(api, namespace: str, name: str) -> Optional[float]:
+    """The submitting job's creation_time, probing every job kind (the
+    describe.find_job order). Prefers the store's no-copy `get_ref` read —
+    explain needs one float, not a deep clone of the job — and falls back
+    to `try_get` on surfaces without it (remote clients)."""
+    try:
+        from training_operator_tpu.api.jobs import JOB_KINDS
+    except Exception:
+        return None
+    ref_get = getattr(api, "get_ref", None)
+    for kind in ("TrainJob", *JOB_KINDS):
+        try:
+            job = (ref_get(kind, namespace, name) if callable(ref_get)
+                   else api.try_get(kind, namespace, name))
+        except Exception:
+            job = None
+        if job is not None:
+            meta = getattr(job, "metadata", None)
+            return getattr(meta, "creation_time", None)
+    return None
+
+
+def explain(api, namespace: str, name: str,
+            now: Optional[float] = None) -> Dict[str, Any]:
+    """Fetch one job's evidence (timeline + Events + PodGroup + creation
+    time) and attribute its time-to-running. Works against the in-process
+    APIServer, a RemoteAPIServer, or the sharded router — every surface
+    exposes the same read verbs."""
+    timeline = _fetch_timeline(api, namespace, name)
+    events = _job_events(api, namespace, name)
+    podgroup = _podgroup(api, namespace, name)
+    created = _job_creation_time(api, namespace, name)
+    if now is None:
+        store = getattr(api, "timelines", None)
+        if store is not None and hasattr(store, "now"):
+            now = store.now()
+        else:
+            server_time = getattr(api, "server_time", None)
+            if callable(server_time):
+                try:
+                    now = float(server_time())
+                except Exception:
+                    now = None
+    if now is None:
+        now = max(
+            [float(s.get("end", 0.0)) for s in (timeline or {}).get("spans", ())]
+            or [0.0]
+        )
+    report = attribute(
+        timeline, events, podgroup=podgroup, now=now, created=created
+    )
+    report["namespace"] = namespace
+    report["name"] = name
+    return report
+
+
+def render_explain(report: Dict[str, Any]) -> str:
+    """kubectl-describe-flavored text form of one attribution report."""
+    ns, name = report.get("namespace", ""), report.get("name", "")
+    total = report.get("time_to_running_seconds", 0.0)
+    state = (
+        "reached Running" if report.get("running")
+        else "NOT yet Running"
+    )
+    lines = [
+        f"Job:             {ns}/{name}",
+        f"State:           {state}",
+        f"Time accounted:  {total:.3f}s "
+        f"(window {report['window'][0]:.3f} -> {report['window'][1]:.3f})",
+        "Causes:",
+    ]
+    rows = report.get("causes", [])
+    if not rows:
+        lines.append("  (nothing to attribute — zero-length window)")
+    for row in rows:
+        lines.append(
+            f"  {row['cause']:<24} {row['seconds']:>10.3f}s "
+            f"{row['share']:>7.1%}  {row['description']}"
+        )
+    return "\n".join(lines)
+
+
+def aggregate_queue_shares(
+    api, now: float, limit: int = 64,
+    cache: Optional[Dict[Any, Any]] = None,
+) -> Dict[str, Dict[str, float]]:
+    """Per-queue attribution shares over the most recent retained
+    timelines: {queue: {cause: share}}, shares summing to 1 per queue.
+    Capped scan (newest `limit` jobs) — this rides the fleet tick, so it
+    must stay O(recent jobs), not O(history).
+
+    `cache` (caller-owned dict, e.g. the SLOEvaluator's) memoizes per-job
+    cause totals: once a job holds a closed time_to_running span its
+    attribution window is pinned — the report no longer depends on `now` —
+    so a repeat evaluation with unchanged evidence (same span/event
+    counts) reuses the cached decomposition instead of re-sweeping. The
+    cache is pruned to the jobs seen this pass, so it stays <= limit."""
+    store = getattr(api, "timelines", None)
+    if store is None or not hasattr(store, "timelines"):
+        return {}
+    timelines = store.timelines()[-limit:]
+    # One event pass, grouped by object name: this rides the fleet tick, so
+    # it must stay O(events + jobs), not O(jobs x events) as per-job
+    # `api.events(object_name=...)` scans would be.
+    by_name: Dict[str, List[Any]] = {}
+    try:
+        for ev in api.events():
+            by_name.setdefault(_get(ev, "object_name", ""), []).append(ev)
+    except Exception:
+        by_name = {}
+    totals: Dict[str, Dict[str, float]] = {}
+    seen: set = set()
+    for tl in timelines:
+        spans = getattr(tl, "sorted_spans", None)
+        raw_spans = spans() if callable(spans) else (tl.get("spans") or [])
+        ns = _get(tl, "namespace", "")
+        name = _get(tl, "name", "")
+        if not name:
+            continue
+        seen.add((ns, name))
+        podgroup = _podgroup(api, ns, name)
+        events = [
+            ev for ev in by_name.get(name, ())
+            if (_get(ev, "namespace", "") or "") in ("", ns)
+        ]
+        queue = getattr(podgroup, "queue", "") or "default"
+        pinned = any(
+            _get(s, "name", "") == "time_to_running" for s in raw_spans)
+        key = (len(raw_spans), len(events), queue) if pinned else None
+        hit = cache.get((ns, name)) if cache is not None else None
+        if hit is not None and hit[0] == key and key is not None:
+            causes = hit[1]
+        else:
+            d = tl.to_dict() if hasattr(tl, "to_dict") else tl
+            report = attribute(d, events, podgroup=podgroup, now=now)
+            causes = {
+                row["cause"]: row["seconds"] for row in report["causes"]}
+            if cache is not None and key is not None:
+                cache[(ns, name)] = (key, causes)
+        bucket = totals.setdefault(queue, {})
+        for cause, seconds in causes.items():
+            bucket[cause] = bucket.get(cause, 0.0) + seconds
+    if cache is not None:
+        for stale in [k for k in cache if k not in seen]:
+            del cache[stale]
+    shares: Dict[str, Dict[str, float]] = {}
+    for queue, bucket in totals.items():
+        denom = sum(bucket.values())
+        if denom <= 0:
+            continue
+        shares[queue] = {
+            cause: secs / denom for cause, secs in sorted(bucket.items())
+        }
+    return shares

@@ -75,3 +75,59 @@ def export_chrome_trace(
         with open(path, "w") as f:
             json.dump(doc, f)
     return doc
+
+
+def export_chrome_trace_merged(
+    sources: Dict[str, Union[TimelineStore, JobTimeline, Dict[str, Any], list]],
+    path: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Merge timelines from MANY processes — store shards, operator-shard
+    replicas — into one Trace Event document on one clock.
+
+    `sources` maps a process label ("shard-0", "replica-b", ...) to any
+    source `export_chrome_trace` accepts (the sharded router's
+    `get_timelines()` fan-out hands back exactly this shape). Each source
+    becomes one trace PROCESS (pid + process_name metadata); each job
+    within it becomes one named THREAD (tid + thread_name), so a job whose
+    spans landed on several shards/replicas reads as parallel rows under
+    distinct processes, aligned on the shared cluster clock — timestamps
+    are already comparable, no skew correction is applied or needed.
+    """
+    events: List[Dict[str, Any]] = []
+    for pid, label in enumerate(sorted(sources), start=1):
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": label},
+        })
+        for tid, tl in enumerate(_as_timeline_dicts(sources[label]), start=1):
+            job = f"{tl.get('namespace', '')}/{tl.get('name', '')}"
+            events.append({
+                "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                "args": {"name": job},
+            })
+            for span in tl.get("spans", []):
+                start = float(span.get("start", 0.0))
+                end = float(span.get("end", 0.0))
+                wall = float(span.get("wall", 0.0))
+                dur = wall if wall > 0.0 else max(0.0, end - start)
+                events.append({
+                    "ph": "X",
+                    "name": span.get("name", ""),
+                    "pid": pid,
+                    "tid": tid,
+                    "ts": round(start * 1e6, 3),
+                    "dur": round(dur * 1e6, 3),
+                    "args": dict(span.get("attrs", {})),
+                })
+            for mark, t in sorted(
+                tl.get("marks", {}).items(), key=lambda kv: kv[1]
+            ):
+                events.append({
+                    "ph": "i", "s": "p", "name": mark, "pid": pid, "tid": tid,
+                    "ts": round(float(t) * 1e6, 3), "args": {},
+                })
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return doc

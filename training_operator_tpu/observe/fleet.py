@@ -255,6 +255,13 @@ def collect_fleet(api, now: float,
     if sources.store_shards is not None:
         store_shard_plane = dict(sources.store_shards())
 
+    # SLO plane: one evaluator pass per collect — burn-rate scoring and the
+    # training_slo_* gauge republish happen inside evaluate(), the returned
+    # section rides the snapshot for GET /fleet and `top`.
+    slo_section = None
+    if sources.slo is not None:
+        slo_section = dict(sources.slo())
+
     # Gang-solver cycle stats (the training_solver_* counter families +
     # the solve-wall histogram), so `top` and the /fleet consumers see the
     # O(changed) plane without scraping /metrics separately.
@@ -298,6 +305,7 @@ def collect_fleet(api, now: float,
         **({"shards": shard_plane} if shard_plane is not None else {}),
         **({"store_shards": store_shard_plane}
            if store_shard_plane is not None else {}),
+        **({"slo": slo_section} if slo_section is not None else {}),
     }
 
 
@@ -571,6 +579,13 @@ def render_top(fleet: Dict[str, Any]) -> str:
             f"applied={repl.get('applied', 0)}  "
             f"bootstraps={repl.get('bootstraps', 0)}"
         )
+
+    slo = fleet.get("slo")
+    if slo is not None:
+        from training_operator_tpu.observe.slo import render_slo
+
+        lines.append("")
+        lines.append(render_slo(slo))
 
     violations = fleet.get("violations") or []
     lines.append("")

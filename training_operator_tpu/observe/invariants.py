@@ -158,6 +158,11 @@ class FleetSources:
     # history); a misrouted key is held by a shard the (kind, namespace)
     # map does not assign it to, so a router-side read would miss it.
     store_shards: Optional[Callable[[], Dict[str, Any]]] = None
+    # SLO engine (observe/slo.py): the evaluator's evaluate() — one call
+    # per fleet tick scores every stored SLOPolicy, republishes the
+    # training_slo_* gauges, and returns the `slo` section collect_fleet
+    # embeds. None when the deployment shape has no evaluator.
+    slo: Optional[Callable[[], Dict[str, Any]]] = None
 
 
 class AuditContext:

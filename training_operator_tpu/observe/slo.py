@@ -1,0 +1,420 @@
+"""SLO engine: objectives, multi-window burn-rate evaluation, incidents.
+
+The PR 4 timelines and PR 7 fleet gauges record raw durations; nothing in
+the system knew what "good" is or whether it is being attained. This module
+closes the read side of ROADMAP item 3: a cluster-scoped `SLOPolicy` kind
+declares per-queue/per-kind objectives over the two latencies users feel —
+`time_to_running` (creation -> Running condition) and `queue_wait` (manager
+workqueue enqueue -> pop) — and `SLOEvaluator` scores them against the
+sliding-window histogram feeds (utils/metrics.py slo_*_window families) the
+engine/controller transition paths populate.
+
+Burn-rate semantics follow multi-window Prometheus/SRE practice:
+
+  bad_fraction(w) = 1 - good(w) / count(w)        over window w
+  burn_rate(w)    = bad_fraction(w) / (1 - target)
+
+where `good` counts observations <= the objective's threshold (linear
+interpolation inside the straddling bucket; observations beyond the last
+finite bucket bound are scored bad — conservative). An objective is BURNING
+only when BOTH the fast and slow windows exceed `burn_threshold`: the fast
+window makes detection prompt, the slow window keeps a brief spike from
+paging. Each evaluation republishes:
+
+  training_slo_attainment_ratio{policy,objective,queue}   good fraction, slow window
+  training_slo_budget_remaining{policy,objective,queue}   1 - burn_slow, clamped to [0,1]
+  training_slo_burn_rate{policy,objective,queue,window}   per window (fast | slow)
+
+and emits ONE aggregated `SLOBurnRate` Warning Event per incident (the
+not-burning -> burning transition), k8s-events style: a breach persisting
+across evaluations is one incident, not one event per pass. The returned
+section dict is the `slo` block `GET /fleet` / `top` render, including the
+per-queue aggregate attribution shares (observe/attribution.py) the item-3
+autoscaler will consume.
+
+SLOPolicy is cluster-scoped and pinned to the meta store shard exactly like
+PriorityClass (cluster/shards.py CLUSTER_SCOPED_KINDS), so a sharded
+control plane evaluates one policy catalog, not N drifting ones.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from training_operator_tpu.api.jobs import ObjectMeta
+from training_operator_tpu.utils import metrics
+
+# Objective metric names -> the windowed family each is scored against.
+SLO_METRICS: Dict[str, Any] = {
+    "time_to_running": metrics.slo_time_to_running_window,
+    "queue_wait": metrics.slo_queue_wait_window,
+}
+
+# Default multi-window pair (5m fast / 1h slow — the classic page-window
+# shape, sized to the windowed families' 4h retention).
+DEFAULT_FAST_WINDOW = 300.0
+DEFAULT_SLOW_WINDOW = 3600.0
+
+
+@dataclass
+class SLOObjective:
+    """One scored objective inside an SLOPolicy.
+
+    `target` is the attainment goal (0.99 = 99% of observations within
+    `threshold_seconds`); the error budget is `1 - target`. Empty `queue` /
+    `kind` selectors match every queue / job kind (children are merged
+    before scoring, so an all-queues objective scores the union, not the
+    per-queue mean)."""
+
+    name: str = ""
+    metric: str = "time_to_running"
+    threshold_seconds: float = 0.0
+    target: float = 0.99
+    queue: str = ""
+    kind: str = ""
+    fast_window_seconds: float = DEFAULT_FAST_WINDOW
+    slow_window_seconds: float = DEFAULT_SLOW_WINDOW
+    burn_threshold: float = 1.0
+
+
+@dataclass
+class SLOPolicy:
+    """Cluster-scoped bundle of objectives (one team/fleet SLO document)."""
+
+    KIND = "SLOPolicy"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    objectives: List[SLOObjective] = field(default_factory=list)
+    description: str = ""
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return ""
+
+
+def validate_slo_policy(policy: SLOPolicy) -> None:
+    from training_operator_tpu.api.validation import (
+        ValidationError,
+        is_dns1035_label,
+    )
+
+    errs: List[str] = []
+    if not policy.metadata.name:
+        errs.append("metadata.name: required")
+    elif not is_dns1035_label(policy.metadata.name):
+        errs.append(
+            f"metadata.name: {policy.metadata.name!r} is not a DNS-1035 label"
+        )
+    if not policy.objectives:
+        errs.append("objectives: at least one objective is required")
+    seen: set = set()
+    for i, obj in enumerate(policy.objectives):
+        where = f"objectives[{i}]"
+        if not obj.name:
+            errs.append(f"{where}.name: required")
+        elif obj.name in seen:
+            errs.append(f"{where}.name: duplicate objective name {obj.name!r}")
+        else:
+            seen.add(obj.name)
+        if obj.metric not in SLO_METRICS:
+            errs.append(
+                f"{where}.metric: {obj.metric!r} must be one of "
+                f"{sorted(SLO_METRICS)}"
+            )
+        if not obj.threshold_seconds > 0:
+            errs.append(
+                f"{where}.thresholdSeconds: {obj.threshold_seconds} must be > 0"
+            )
+        if not 0.0 < obj.target < 1.0:
+            errs.append(
+                f"{where}.target: {obj.target} must be strictly between 0 and 1"
+            )
+        if not obj.fast_window_seconds > 0:
+            errs.append(
+                f"{where}.fastWindowSeconds: {obj.fast_window_seconds} must be > 0"
+            )
+        if not obj.slow_window_seconds > obj.fast_window_seconds:
+            errs.append(
+                f"{where}.slowWindowSeconds: {obj.slow_window_seconds} must "
+                f"exceed fastWindowSeconds ({obj.fast_window_seconds})"
+            )
+        if not obj.burn_threshold > 0:
+            errs.append(
+                f"{where}.burnThreshold: {obj.burn_threshold} must be > 0"
+            )
+    if errs:
+        raise ValidationError(errs)
+
+
+def _admit_slo_policy(policy: SLOPolicy) -> None:
+    # Cluster-scoped: namespace "" like PriorityClass, so the shard map and
+    # every lookup path agree on the key.
+    policy.metadata.namespace = ""
+    validate_slo_policy(policy)
+
+
+def register_slo_admission(api) -> None:
+    """Admission for SLOPolicy, on whichever APIServer stores it (a
+    malformed policy must not wedge the evaluator mid-fleet)."""
+    api.register_admission(SLOPolicy.KIND, _admit_slo_policy)
+
+
+# ---------------------------------------------------------------------------
+# Burn-rate evaluation
+# ---------------------------------------------------------------------------
+
+
+def _merge_views(views: List[List[Tuple[float, int]]]) -> List[Tuple[float, int]]:
+    """Sum same-layout cumulative bucket views (children of one family all
+    share the family's bucket tuple, so positional merge is exact)."""
+    if not views:
+        return []
+    if len(views) == 1:
+        return views[0]
+    merged = [[bound, 0] for bound, _ in views[0]]
+    for view in views:
+        for i, (_, cum) in enumerate(view):
+            merged[i][1] += cum
+    return [(bound, cum) for bound, cum in merged]
+
+
+def _good_count(view: List[Tuple[float, int]], threshold: float) -> float:
+    """Observations <= threshold, from a cumulative bucket view. Linear
+    interpolation inside the straddling bucket (Prometheus histogram_quantile
+    convention); thresholds past the last finite bound score only the finite
+    buckets as good — the +Inf residue is conservatively bad."""
+    if not view:
+        return 0.0
+    prev_bound, prev_cum = 0.0, 0
+    for bound, cum in view:
+        if bound == float("inf"):
+            return float(prev_cum)
+        if threshold <= bound:
+            if threshold == bound or bound <= prev_bound:
+                return float(cum)
+            frac = (threshold - prev_bound) / (bound - prev_bound)
+            return prev_cum + (cum - prev_cum) * max(0.0, min(1.0, frac))
+        prev_bound, prev_cum = bound, cum
+    return float(prev_cum)
+
+
+class SLOEvaluator:
+    """Scores every stored SLOPolicy against the windowed latency families
+    and republishes the training_slo_* gauges. One instance per control
+    plane (the fleet plane ticks it); incident state is in-memory — a
+    restart re-fires an ongoing incident's event, which is the right bias
+    (an unnoticed page beats a silently swallowed one)."""
+
+    def __init__(self, api, now_fn: Callable[[], float],
+                 enable_events: bool = True,
+                 queue_shares_interval: float = 60.0):
+        self.api = api
+        self.now = now_fn
+        self.enable_events = enable_events
+        # The per-queue attribution aggregate is a slow-moving advisory
+        # signal and the priciest part of the tick (it sweeps live-job
+        # timelines); refresh it at most this often on the evaluation
+        # clock, serving the cached copy in between.
+        self.queue_shares_interval = queue_shares_interval
+        self._shares: Optional[Dict[str, Dict[str, float]]] = None
+        self._shares_at: Optional[float] = None
+        # (policy, objective) keys currently burning — the once-per-incident
+        # edge detector for SLOBurnRate events.
+        self._burning: set = set()
+        # Gauge label tuples published last pass, per gauge — stale tuples
+        # (deleted policy/objective) are zeroed, FleetCollector-style, so a
+        # removed SLO doesn't freeze its last value on the scrape surface.
+        self._published: Dict[Any, set] = {}
+        # Per-job attribution memo for the queue-shares pass (see
+        # aggregate_queue_shares): finished jobs' decompositions are
+        # now-independent, so repeat evaluations reuse them.
+        self._attr_cache: Dict[Any, Any] = {}
+
+    # -- scoring -----------------------------------------------------------
+
+    def _matching_views(self, obj: SLOObjective, window_s: float,
+                        now: float) -> Tuple[List[Tuple[float, int]], int]:
+        family = SLO_METRICS[obj.metric]
+        views = []
+        for (queue, kind), child in family.children():
+            if obj.queue and queue != obj.queue:
+                continue
+            if obj.kind and kind != obj.kind:
+                continue
+            views.append(child.cumulative_buckets(window_s, now))
+        merged = _merge_views(views)
+        total = merged[-1][1] if merged else 0
+        return merged, total
+
+    def _score(self, obj: SLOObjective, now: float) -> Dict[str, Any]:
+        budget = 1.0 - obj.target
+        row: Dict[str, Any] = {
+            "objective": obj.name,
+            "metric": obj.metric,
+            "queue": obj.queue or "*",
+            "kind": obj.kind or "*",
+            "threshold_seconds": obj.threshold_seconds,
+            "target": obj.target,
+        }
+        burns = {}
+        for window_name, window_s in (
+            ("fast", obj.fast_window_seconds),
+            ("slow", obj.slow_window_seconds),
+        ):
+            view, total = self._matching_views(obj, window_s, now)
+            good = _good_count(view, obj.threshold_seconds) if total else 0.0
+            bad_fraction = 1.0 - (good / total) if total else 0.0
+            burns[window_name] = bad_fraction / budget
+            row[f"samples_{window_name}"] = total
+            if window_name == "slow":
+                row["attainment"] = (good / total) if total else 1.0
+        row["burn_fast"] = burns["fast"]
+        row["burn_slow"] = burns["slow"]
+        row["budget_remaining"] = max(0.0, min(1.0, 1.0 - burns["slow"]))
+        row["burning"] = bool(
+            row["samples_fast"]
+            and row["samples_slow"]
+            and burns["fast"] >= obj.burn_threshold
+            and burns["slow"] >= obj.burn_threshold
+        )
+        return row
+
+    # -- publication -------------------------------------------------------
+
+    def _set_gauges(self, policy_name: str, row: Dict[str, Any]) -> None:
+        key3 = (policy_name, row["objective"], row["queue"])
+        metrics.slo_attainment_ratio.set(*key3, value=row["attainment"])
+        metrics.slo_budget_remaining.set(*key3, value=row["budget_remaining"])
+        metrics.slo_burn_rate.set(*key3, "fast", value=row["burn_fast"])
+        metrics.slo_burn_rate.set(*key3, "slow", value=row["burn_slow"])
+
+    def _zero_stale(self, fresh: Dict[Any, set]) -> None:
+        for gauge, old_keys in self._published.items():
+            for key in old_keys - fresh.get(gauge, set()):
+                gauge.set(*key, value=0.0)
+        self._published = fresh
+
+    def _fire_incident(self, policy: SLOPolicy, row: Dict[str, Any],
+                       now: float) -> None:
+        from training_operator_tpu.cluster.objects import Event
+
+        self.api.record_event(Event(
+            object_kind=SLOPolicy.KIND,
+            object_name=policy.name,
+            namespace="",
+            event_type="Warning",
+            reason="SLOBurnRate",
+            message=(
+                f"objective {row['objective']!r} "
+                f"(queue={row['queue']}, kind={row['kind']}) burning at "
+                f"{row['burn_fast']:.2f}x/{row['burn_slow']:.2f}x over "
+                f"{int(row['windows'][0])}s/{int(row['windows'][1])}s windows "
+                f"(target {row['target']:.4g}, "
+                f"threshold {row['threshold_seconds']:.4g}s)"
+            ),
+            timestamp=now,
+        ))
+
+    # -- the tick ----------------------------------------------------------
+
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Score every policy; returns the `slo` fleet section."""
+        at = self.now() if now is None else now
+        # Rotate idle windows forward so a quiet queue's old breaches age
+        # out on the evaluation clock, not on its next observation.
+        for family in SLO_METRICS.values():
+            for _, child in family.children():
+                child.advance(at)
+
+        policies = sorted(
+            self.api.list(SLOPolicy.KIND), key=lambda p: p.metadata.name
+        )
+        rows: List[Dict[str, Any]] = []
+        fresh: Dict[Any, set] = {}
+        burning_now: set = set()
+        for policy in policies:
+            for obj in policy.objectives:
+                row = self._score(obj, at)
+                row["policy"] = policy.name
+                row["windows"] = (obj.fast_window_seconds,
+                                  obj.slow_window_seconds)
+                self._set_gauges(policy.name, row)
+                key3 = (policy.name, row["objective"], row["queue"])
+                fresh.setdefault(metrics.slo_attainment_ratio, set()).add(key3)
+                fresh.setdefault(metrics.slo_budget_remaining, set()).add(key3)
+                ring = fresh.setdefault(metrics.slo_burn_rate, set())
+                ring.add(key3 + ("fast",))
+                ring.add(key3 + ("slow",))
+                incident_key = (policy.name, row["objective"], row["queue"])
+                if row["burning"]:
+                    burning_now.add(incident_key)
+                    if (incident_key not in self._burning
+                            and self.enable_events):
+                        self._fire_incident(policy, row, at)
+                rows.append(row)
+        self._zero_stale(fresh)
+        self._burning = burning_now
+
+        section: Dict[str, Any] = {
+            "t": at,
+            "policies": len(policies),
+            "objectives": [
+                {k: v for k, v in row.items() if k != "windows"}
+                for row in rows
+            ],
+            "incidents": len(burning_now),
+        }
+        # Per-queue aggregate attribution shares — the autoscaler's "why is
+        # this queue slow" signal, riding the same section.
+        if (self._shares_at is None or at < self._shares_at
+                or at - self._shares_at >= self.queue_shares_interval):
+            try:
+                from training_operator_tpu.observe.attribution import (
+                    aggregate_queue_shares,
+                )
+
+                self._shares = aggregate_queue_shares(
+                    self.api, at, cache=self._attr_cache)
+            except Exception:
+                # Attribution is advisory; a malformed timeline must not
+                # take down the burn-rate surface with it.
+                pass
+            self._shares_at = at
+        if self._shares:
+            section["queues"] = self._shares
+        return section
+
+
+def render_slo(section: Dict[str, Any]) -> str:
+    """Human form of the `slo` section for `top` — one line per objective,
+    worst burn first."""
+    rows = sorted(
+        section.get("objectives", []),
+        key=lambda r: -float(r.get("burn_slow", 0.0)),
+    )
+    lines = [
+        f"SLO: {section.get('policies', 0)} policies, "
+        f"{len(rows)} objectives, {section.get('incidents', 0)} burning"
+    ]
+    for r in rows:
+        flag = " BURNING" if r.get("burning") else ""
+        lines.append(
+            f"  {r['policy']}/{r['objective']} "
+            f"[{r['metric']} queue={r['queue']} kind={r['kind']} "
+            f"<= {r['threshold_seconds']:g}s @ {r['target']:.4g}] "
+            f"attain {r['attainment']:.4f}  budget {r['budget_remaining']:.3f}  "
+            f"burn {r['burn_fast']:.2f}x/{r['burn_slow']:.2f}x "
+            f"(n={r['samples_slow']}){flag}"
+        )
+    queues = section.get("queues") or {}
+    for queue, shares in sorted(queues.items()):
+        top = sorted(shares.items(), key=lambda kv: -kv[1])[:3]
+        if top:
+            mix = ", ".join(f"{cause} {share:.0%}" for cause, share in top)
+            lines.append(f"  queue {queue}: waiting on {mix}")
+    return "\n".join(lines)

@@ -383,6 +383,26 @@ class TrainingClient:
 
         return render_describe(self.api, namespace or self.namespace, name)
 
+    def explain_job(
+        self, name: str, namespace: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """Why is (or was) this job not running: time-to-running decomposed
+        into the registered cause taxonomy (observe/attribution.py) — quota
+        wait, priority wait, topology fragmentation, preemption
+        displacement, node-loss recovery, control-plane overhead, startup.
+        Works live (window = creation -> now) and post-mortem. In remote
+        mode the report is built server-side (GET /explain/{ns}/{name} —
+        through the sharded router it comes from the job's owning shard,
+        where all its evidence lives); feed to render_explain() for text.
+        CLI twin: `python -m training_operator_tpu explain <ns>/<job>`."""
+        ns = namespace or self.namespace
+        remote = getattr(self.api, "explain", None)
+        if callable(remote):
+            return remote(ns, name)
+        from training_operator_tpu.observe import explain
+
+        return explain(self.api, ns, name)
+
     # -- node admin --------------------------------------------------------
 
     def cordon_node(self, name: str):
@@ -422,6 +442,29 @@ class TrainingClient:
 
     def list_cluster_queues(self) -> List[Any]:
         return self.api.list("ClusterQueue")
+
+    # -- SLO ---------------------------------------------------------------
+
+    def create_slo_policy(self, policy):
+        """Store an SLOPolicy (observe/slo.py) — cluster-scoped, admission-
+        validated, evaluated by the fleet plane's burn-rate engine."""
+        return self.api.create(policy)
+
+    def list_slo_policies(self) -> List[Any]:
+        return self.api.list("SLOPolicy")
+
+    def get_slo(self) -> Dict[str, Any]:
+        """The current SLO section: per-objective attainment / budget /
+        burn rates + per-queue attribution shares. Remote mode fetches the
+        host's GET /slo; in-process runs an event-silent evaluation."""
+        remote = getattr(self.api, "get_slo", None)
+        if callable(remote):
+            return remote()
+        from training_operator_tpu.observe import SLOEvaluator
+
+        return SLOEvaluator(
+            self.api, self.cluster.clock.now, enable_events=False,
+        ).evaluate()
 
     # -- static analysis ---------------------------------------------------
 

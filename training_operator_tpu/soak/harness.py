@@ -1179,6 +1179,44 @@ class SoakHarness:
         return sorted_vals[min(len(sorted_vals) - 1,
                                int(p * len(sorted_vals)))]
 
+    def _tier_attainment(self) -> Dict[str, Dict[str, Any]]:
+        """Per-chaos-tier SLO attainment: join each tier's Disruption rows
+        with the tracker's time-to-running records. A job counts against
+        every tier that hit it (a pod-killed job that also lost a node shows
+        up in both rows); the `undisrupted` row is the control group. The
+        per-job target is priority-aware — `high` jobs answer to the tighter
+        high_p99 target, everyone else to the normal p99."""
+        c = self.cfg
+        hit: Dict[str, set] = {}
+        for d in self.disruptions:
+            hit.setdefault(d.tier, set()).add(d.job)
+        disrupted_any = set().union(*hit.values()) if hit else set()
+        ran = {
+            name: r for name, r in self.tracker.jobs.items()
+            if r.running is not None
+        }
+        out: Dict[str, Dict[str, Any]] = {}
+        rows = sorted(hit.items()) + [
+            ("undisrupted", set(ran) - disrupted_any)]
+        for tier, names in rows:
+            pairs = [
+                (c.fleet(ran[n].running - ran[n].submitted),
+                 c.slo_high_p99_ttr_s if ran[n].priority == "high"
+                 else c.slo_p99_ttr_s)
+                for n in sorted(names) if n in ran
+            ]
+            ttrs = sorted(ttr for ttr, _ in pairs)
+            within = sum(1 for ttr, tgt in pairs if ttr <= tgt)
+            out[tier] = {
+                "jobs": len(names),
+                "ran": len(ttrs),
+                "p50_ttr_s": self._pct(ttrs, 0.50),
+                "p99_ttr_s": self._pct(ttrs, 0.99),
+                "attainment": (
+                    round(within / len(ttrs), 4) if ttrs else None),
+            }
+        return out
+
     def report(self, wall_s: float) -> Dict[str, Any]:
         c = self.cfg
         jobs = self.tracker.jobs
@@ -1215,6 +1253,7 @@ class SoakHarness:
             and slo["p99_ttr_s"] <= c.slo_p99_ttr_s
             and (not ttr_high or slo["high_p99_ttr_s"] <= c.slo_high_p99_ttr_s)
         )
+        slo["by_tier"] = self._tier_attainment()
         return {
             "nodes": self.node_count,
             "fleet_hours": c.sim_hours,

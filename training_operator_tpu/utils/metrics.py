@@ -244,6 +244,191 @@ class LabeledHistogram:
         return lines
 
 
+class SlidingWindowHistogram:
+    """Histogram over a bounded ring of per-window bucket snapshots.
+
+    The plain Histogram accumulates forever — fine for lifetime p50/p99,
+    useless for burn-rate math, which asks "what fraction of the LAST five
+    minutes breached the threshold". This variant partitions observations
+    into fixed-width, clock-aligned windows (index = floor(now / width)),
+    retains the most recent `num_windows` of them, and merges any suffix of
+    the ring on demand via `cumulative_buckets(window_seconds, now)` — the
+    same one-view rule as Histogram: render() and snapshot_items() both
+    derive from the full-retention merge, so text and JSON exposition
+    cannot disagree.
+
+    Time is always the caller's (the cluster's virtual clock) — the metric
+    itself never reads a wall clock, so soak/bench time compression works
+    unchanged. Observations with a stale `now` fold into the newest
+    retained window rather than resurrecting an evicted one.
+    """
+
+    METRIC_TYPE = "histogram"
+
+    def __init__(self, name: str, help_text: str,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 window_seconds: float = 60.0, num_windows: int = 240):
+        self.name = name
+        self.help = help_text
+        self.buckets: Tuple[float, ...] = tuple(sorted(buckets))
+        self.window_seconds = float(window_seconds)
+        self.num_windows = max(1, int(num_windows))
+        # window index -> [per-bucket counts (+Inf last), count, sum, min, max]
+        self._windows: Dict[int, list] = {}
+        self._lock = TrackedLock("metrics.metric")
+
+    def _idx(self, now: float) -> int:
+        return int(now // self.window_seconds)
+
+    def _evict(self, idx: int) -> None:
+        """Drop windows older than the retention ring. Caller holds lock."""
+        floor_idx = idx - self.num_windows + 1
+        for k in [k for k in self._windows if k < floor_idx]:
+            del self._windows[k]
+
+    def observe(self, value: float, now: float = 0.0) -> None:
+        idx = self._idx(now)
+        with self._lock:
+            if self._windows:
+                newest = max(self._windows)
+                if idx < newest:
+                    # Out-of-order observation: fold into the newest window
+                    # instead of resurrecting (or re-creating) an older one.
+                    idx = newest
+            win = self._windows.get(idx)
+            if win is None:
+                win = self._windows[idx] = [
+                    [0] * (len(self.buckets) + 1), 0, 0.0, math.inf, -math.inf,
+                ]
+                self._evict(idx)
+            win[0][bisect.bisect_left(self.buckets, value)] += 1
+            win[1] += 1
+            win[2] += value
+            if value < win[3]:
+                win[3] = value
+            if value > win[4]:
+                win[4] = value
+
+    def advance(self, now: float) -> None:
+        """Rotate the ring forward without observing — lets a periodic
+        evaluator expire idle windows so a quiet queue's old breaches age
+        out on schedule rather than on the next observation."""
+        with self._lock:
+            self._evict(self._idx(now))
+
+    def _merged(self, min_idx=None):
+        """Merge retained windows (>= min_idx when given) into one
+        (counts, count, sum, min, max) tuple. Caller holds lock."""
+        counts = [0] * (len(self.buckets) + 1)
+        count, total = 0, 0.0
+        lo, hi = math.inf, -math.inf
+        for k, win in self._windows.items():
+            if min_idx is not None and k < min_idx:
+                continue
+            for i, c in enumerate(win[0]):
+                counts[i] += c
+            count += win[1]
+            total += win[2]
+            if win[3] < lo:
+                lo = win[3]
+            if win[4] > hi:
+                hi = win[4]
+        return counts, count, total, lo, hi
+
+    def cumulative_buckets(self, window_seconds=None, now=None) -> List[Tuple[float, int]]:
+        """(upper_bound, cumulative_count) pairs over the trailing
+        `window_seconds` ending at `now` (both required together), else
+        over the full retention — THE bucket view burn-rate evaluation,
+        render(), and snapshot_items() all derive from."""
+        min_idx = None
+        if window_seconds is not None and now is not None:
+            span = max(1, int(math.ceil(window_seconds / self.window_seconds)))
+            min_idx = self._idx(now) - span + 1
+        with self._lock:
+            counts, _, _, _, _ = self._merged(min_idx)
+        return Histogram._cumulate(self.buckets, counts)
+
+    def snapshot_items(self) -> Dict[str, float]:
+        with self._lock:
+            counts, count, total, lo, hi = self._merged()
+        out: Dict[str, float] = {}
+        for bound, cum in Histogram._cumulate(self.buckets, counts):
+            out[f'{self.name}_bucket{{le="{Histogram._le(bound)}"}}'] = float(cum)
+        out[f"{self.name}_count"] = float(count)
+        out[f"{self.name}_sum"] = total
+        out[f"{self.name}_min"] = lo if count else 0.0
+        out[f"{self.name}_max"] = hi if count else 0.0
+        return out
+
+    def render(self) -> List[str]:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.METRIC_TYPE}",
+        ]
+        for key, v in self.snapshot_items().items():
+            lines.append(f"{key} {v}")
+        return lines
+
+
+class LabeledSlidingWindowHistogram:
+    """SlidingWindowHistogram family with label dimensions — the windowed
+    analogue of LabeledHistogram, sharing its splice/one-view exposition
+    discipline. `children()` hands the evaluator the live (labels, child)
+    pairs so per-policy selectors can merge matching children's windows."""
+
+    METRIC_TYPE = "histogram"
+
+    def __init__(self, name: str, help_text: str, label_names: Tuple[str, ...],
+                 buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 window_seconds: float = 60.0, num_windows: int = 240):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self.buckets: Tuple[float, ...] = tuple(sorted(buckets))
+        self.window_seconds = float(window_seconds)
+        self.num_windows = max(1, int(num_windows))
+        self._children: Dict[Tuple[str, ...], SlidingWindowHistogram] = {}
+        self._lock = TrackedLock("metrics.family")
+
+    def labels(self, *label_values: str) -> SlidingWindowHistogram:
+        if len(label_values) != len(self.label_names):
+            raise ValueError(f"{self.name}: expected labels {self.label_names}")
+        key = tuple(label_values)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = SlidingWindowHistogram(
+                    self.name, self.help, self.buckets,
+                    window_seconds=self.window_seconds,
+                    num_windows=self.num_windows,
+                )
+            return child
+
+    def observe(self, value: float, *label_values: str, now: float = 0.0) -> None:
+        self.labels(*label_values).observe(value, now=now)
+
+    def children(self) -> List[Tuple[Tuple[str, ...], SlidingWindowHistogram]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+    def snapshot_items(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for labels, child in self.children():
+            label_str = _label_str(self.label_names, labels)
+            for key, v in child.snapshot_items().items():
+                out[LabeledHistogram._splice(key, label_str)] = v
+        return out
+
+    def render(self) -> List[str]:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.METRIC_TYPE}",
+        ]
+        for key, v in self.snapshot_items().items():
+            lines.append(f"{key} {v}")
+        return lines
+
+
 class MetricsRegistry:
     def __init__(self) -> None:
         self._metrics: Dict[str, Counter] = {}
@@ -314,6 +499,38 @@ class MetricsRegistry:
                 existing = self._metrics[name] = Histogram(name, help_text, buckets)
             return existing
 
+    def sliding_histogram(self, name: str, help_text: str = "",
+                          buckets: Sequence[float] = DEFAULT_BUCKETS,
+                          labels: Tuple[str, ...] = (),
+                          window_seconds: float = 60.0,
+                          num_windows: int = 240):
+        with self._lock:
+            cls = LabeledSlidingWindowHistogram if labels else SlidingWindowHistogram
+            existing = self._existing(
+                name, cls, labels=tuple(labels) if labels else None,
+                buckets=buckets,
+            )
+            if existing is not None:
+                if (existing.window_seconds != float(window_seconds)
+                        or existing.num_windows != int(num_windows)):
+                    raise ValueError(
+                        f"metric {name!r} already registered with window "
+                        f"{existing.window_seconds}s x {existing.num_windows}, "
+                        f"not {float(window_seconds)}s x {int(num_windows)}"
+                    )
+                return existing
+            if labels:
+                existing = self._metrics[name] = LabeledSlidingWindowHistogram(
+                    name, help_text, tuple(labels), buckets,
+                    window_seconds=window_seconds, num_windows=num_windows,
+                )
+            else:
+                existing = self._metrics[name] = SlidingWindowHistogram(
+                    name, help_text, buckets,
+                    window_seconds=window_seconds, num_windows=num_windows,
+                )
+            return existing
+
     def render(self) -> str:
         out: List[str] = []
         for m in self._families():
@@ -326,7 +543,9 @@ class MetricsRegistry:
         bench/test can assert counter deltas without text parsing)."""
         out: Dict[str, float] = {}
         for m in self._families():
-            if isinstance(m, (Histogram, LabeledHistogram)):
+            if isinstance(m, (Histogram, LabeledHistogram,
+                              SlidingWindowHistogram,
+                              LabeledSlidingWindowHistogram)):
                 out.update(m.snapshot_items())
                 continue
             for labels, v in m.items():
@@ -799,6 +1018,44 @@ read_staleness_seconds = registry.histogram(
     "training_read_staleness_seconds",
     "Bounded staleness (X-Training-Staleness) of reads served by a standby",
     buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+)
+# SLO engine (observe/slo.py): the windowed observation feeds the burn-rate
+# evaluator slices, plus the attainment/budget/burn gauges it republishes.
+# The windowed families duplicate the lifetime histograms above on purpose:
+# burn-rate math needs "the last N minutes", the lifetime families keep the
+# run-wide envelope — merging them would force one view to lie. Retention is
+# 240 x 60s = 4h of cluster-clock history, enough for a 1h slow window with
+# room for soak's compressed days.
+slo_time_to_running_window = registry.sliding_histogram(
+    "training_slo_time_to_running_window_seconds",
+    "Cluster-clock time from job creation to the Running condition, "
+    "windowed for SLO burn-rate evaluation, by queue and kind",
+    labels=("queue", "kind"),
+)
+slo_queue_wait_window = registry.sliding_histogram(
+    "training_slo_queue_wait_window_seconds",
+    "Manager workqueue wait (enqueue -> pop), windowed for SLO burn-rate "
+    "evaluation, by queue and kind",
+    buckets=_FAST_BUCKETS,
+    labels=("queue", "kind"),
+)
+slo_attainment_ratio = registry.gauge(
+    "training_slo_attainment_ratio",
+    "Fraction of observations meeting the objective's threshold over its "
+    "slow window, by policy/objective/queue selector",
+    ("policy", "objective", "queue"),
+)
+slo_budget_remaining = registry.gauge(
+    "training_slo_budget_remaining",
+    "Error budget remaining over the slow window (1 at zero breaches, 0 at "
+    "or past full burn), by policy/objective/queue selector",
+    ("policy", "objective", "queue"),
+)
+slo_burn_rate = registry.gauge(
+    "training_slo_burn_rate",
+    "Error-budget burn rate (breach fraction / allowed fraction) per "
+    "evaluation window (fast | slow)",
+    ("policy", "objective", "queue", "window"),
 )
 # Concurrency-discipline plane (utils/locks.py runtime witness): one count
 # per lock-order cycle incident, labeled by the edge pair that closed it
